@@ -1,0 +1,65 @@
+"""horovod_tpu: a TPU-native distributed training framework.
+
+Brand-new framework with the capabilities of Horovod (reference:
+dalian-ai/horovod), re-designed for TPU: collectives are XLA programs over a
+`jax.sharding.Mesh` (ICI/DCN) instead of NCCL/MPI calls, fusion is trace-time
+bucketing instead of a runtime staging buffer, and the response cache is a
+compiled-executable cache. See SURVEY.md for the full design mapping.
+
+Public API mirrors `horovod.torch` / `horovod.tensorflow`
+(reference: horovod/torch/__init__.py, horovod/tensorflow/__init__.py).
+"""
+
+from horovod_tpu.common.types import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Status, Sum,
+)
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    DuplicateNameError, HorovodInternalError, HorovodTpuError,
+    HostsUpdatedInterrupt, TensorShapeMismatchError, VersionMismatchError,
+)
+from horovod_tpu.core.topology import (  # noqa: F401
+    ccl_built, cross_rank, cross_size, gloo_built, init, is_homogeneous,
+    is_initialized, local_rank, local_size, local_slot_ranks, mesh, mpi_built,
+    mpi_enabled, mpi_threads_supported, nccl_built, rank, shutdown, size,
+    tpu_built,
+)
+from horovod_tpu.core.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, get_process_set, global_process_set,
+    remove_process_set,
+)
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    allgather, allgather_async, allreduce, allreduce_async, alltoall,
+    alltoall_async, barrier, broadcast, broadcast_async, grouped_allgather,
+    grouped_allreduce, grouped_allreduce_async, grouped_reducescatter, poll,
+    reducescatter, reducescatter_async, synchronize,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu.optim.optimizer import (  # noqa: F401
+    DistributedOptimizer, DistributedGradientTransform,
+)
+from horovod_tpu.optim.functions import (  # noqa: F401
+    broadcast_object, broadcast_optimizer_state, broadcast_parameters,
+    broadcast_variables, allgather_object,
+)
+from horovod_tpu.core import join as _join_mod  # noqa: F401
+from horovod_tpu.core.join import join  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Start runtime timeline capture (reference: operations.cc:1077)."""
+    from horovod_tpu.profiler.timeline import Timeline
+    from horovod_tpu.core import topology
+    st = topology.state()
+    if st.timeline is None:
+        st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+    st.timeline.start()
+
+
+def stop_timeline() -> None:
+    """Stop timeline capture (reference: horovod_stop_timeline)."""
+    from horovod_tpu.core import topology
+    st = topology.state()
+    if st.timeline is not None:
+        st.timeline.stop()

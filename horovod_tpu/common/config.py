@@ -1,0 +1,191 @@
+"""Environment-knob registry.
+
+The reference configures everything through ~40 HOROVOD_* environment
+variables, with names centralized in horovod/common/common.h:118-151 and
+parsed at background-thread startup (horovod/common/operations.cc:430-650,
+horovod/common/utils/env_parser.cc). We keep the same knob names where the
+concept survives the TPU redesign, add TPU-specific ones under the same
+prefix, and parse them all in one place so `hvd.init()` has a single config
+snapshot (also required for the autotuner, which overrides a subset at
+runtime — reference horovod/common/parameter_manager.h:58-101).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+# Knob names (reference: horovod/common/common.h:118-151). Kept verbatim where
+# the concept survives; TPU-specific knobs are new but share the prefix.
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_ELASTIC = "HOROVOD_ELASTIC"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
+HOROVOD_DYNAMIC_PROCESS_SETS = "HOROVOD_DYNAMIC_PROCESS_SETS"
+HOROVOD_DISABLE_GROUP_FUSION = "HOROVOD_DISABLE_GROUP_FUSION"
+HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
+HOROVOD_ENABLE_ASYNC_COMPLETION = "HOROVOD_ENABLE_ASYNC_COMPLETION"
+HOROVOD_NUM_RANKS_PER_CHIP = "HOROVOD_NUM_RANKS_PER_CHIP"
+
+# Topology / launcher knobs (reference: injected by the launcher,
+# horovod/runner/gloo_run.py:69-75).
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+
+# TPU-native knobs (new).
+HOROVOD_TPU_MESH_SHAPE = "HOROVOD_TPU_MESH_SHAPE"          # e.g. "dcn:4,ici:8"
+HOROVOD_TPU_EMULATE_RANKS = "HOROVOD_TPU_EMULATE_RANKS"    # force N virtual ranks
+HOROVOD_TPU_DONATE_BUFFERS = "HOROVOD_TPU_DONATE_BUFFERS"  # in-place eager collectives
+HOROVOD_TPU_COMPILE_CACHE = "HOROVOD_TPU_COMPILE_CACHE"    # persistent compile cache dir
+
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_WARNING_SECONDS = 60.0
+
+
+@dataclasses.dataclass
+class Config:
+    """Snapshot of all knobs, taken at init().
+
+    The autotuner mutates `fusion_threshold_bytes` (and in the reference also
+    cycle time / cache / hierarchical flags, parameter_manager.h:58-101) at
+    runtime; everything else is fixed for the life of the process.
+    """
+
+    # Perf knobs
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    cycle_time_ms: float = 0.0          # TPU default 0: no background batching delay
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    disable_group_fusion: bool = False
+    donate_buffers: bool = False
+
+    # Timeline / autotune
+    timeline_path: str = ""
+    timeline_mark_cycles: bool = False
+    autotune: bool = False
+    autotune_log: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+
+    # Stall inspector
+    stall_check_disable: bool = False
+    stall_warning_seconds: float = DEFAULT_STALL_WARNING_SECONDS
+    stall_shutdown_seconds: float = 0.0
+
+    # Modes
+    elastic: bool = False
+    dynamic_process_sets: bool = False
+
+    # Topology overrides (launcher-injected)
+    rank: Optional[int] = None
+    size: Optional[int] = None
+    local_rank: Optional[int] = None
+    local_size: Optional[int] = None
+    cross_rank: Optional[int] = None
+    cross_size: Optional[int] = None
+    rendezvous_addr: str = ""
+    rendezvous_port: int = 0
+
+    # TPU
+    mesh_shape: str = ""
+    emulate_ranks: int = 0
+    compile_cache_dir: str = ""
+
+    @staticmethod
+    def from_env() -> "Config":
+        def opt_int(name: str) -> Optional[int]:
+            v = os.environ.get(name)
+            return int(v) if v not in (None, "") else None
+
+        return Config(
+            fusion_threshold_bytes=_env_int(
+                HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES),
+            cycle_time_ms=_env_float(HOROVOD_CYCLE_TIME, 0.0),
+            cache_capacity=_env_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
+            hierarchical_allreduce=_env_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            hierarchical_allgather=_env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
+            disable_group_fusion=_env_bool(HOROVOD_DISABLE_GROUP_FUSION),
+            donate_buffers=_env_bool(HOROVOD_TPU_DONATE_BUFFERS),
+            timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
+            timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            autotune=_env_bool(HOROVOD_AUTOTUNE),
+            autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG, ""),
+            autotune_warmup_samples=_env_int(HOROVOD_AUTOTUNE_WARMUP_SAMPLES, 3),
+            autotune_steps_per_sample=_env_int(HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, 10),
+            autotune_bayes_opt_max_samples=_env_int(
+                HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20),
+            autotune_gaussian_process_noise=_env_float(
+                HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8),
+            stall_check_disable=_env_bool(HOROVOD_STALL_CHECK_DISABLE),
+            stall_warning_seconds=_env_float(
+                HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECONDS),
+            stall_shutdown_seconds=_env_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
+            elastic=_env_bool(HOROVOD_ELASTIC),
+            dynamic_process_sets=_env_bool(HOROVOD_DYNAMIC_PROCESS_SETS),
+            rank=opt_int(HOROVOD_RANK),
+            size=opt_int(HOROVOD_SIZE),
+            local_rank=opt_int(HOROVOD_LOCAL_RANK),
+            local_size=opt_int(HOROVOD_LOCAL_SIZE),
+            cross_rank=opt_int(HOROVOD_CROSS_RANK),
+            cross_size=opt_int(HOROVOD_CROSS_SIZE),
+            rendezvous_addr=os.environ.get(HOROVOD_RENDEZVOUS_ADDR, ""),
+            rendezvous_port=_env_int(HOROVOD_RENDEZVOUS_PORT, 0),
+            mesh_shape=os.environ.get(HOROVOD_TPU_MESH_SHAPE, ""),
+            emulate_ranks=_env_int(HOROVOD_TPU_EMULATE_RANKS, 0),
+            compile_cache_dir=os.environ.get(HOROVOD_TPU_COMPILE_CACHE, ""),
+        )

@@ -1,0 +1,51 @@
+"""Framework exceptions.
+
+Reference: horovod/common/exceptions.py:18-52. Same three user-visible
+exception types drive the elastic retry loop (see horovod_tpu/elastic).
+"""
+
+from __future__ import annotations
+
+
+class HorovodTpuError(Exception):
+    """Base class for framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error raised when a collective fails.
+
+    In elastic mode this triggers state restore + re-initialization
+    (reference: horovod/common/elastic.py:151-175 retry loop).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Raised inside elastic training when the host set changed.
+
+    Carries whether the update requires an immediate reset.
+    Reference: horovod/common/exceptions.py:29-41.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class VersionMismatchError(HorovodTpuError):
+    """Launcher/worker version mismatch (reference exceptions.py:44-52)."""
+
+
+class TensorShapeMismatchError(HorovodTpuError):
+    """Ranks submitted mismatched shapes for the same named collective."""
+
+
+class DuplicateNameError(HorovodTpuError):
+    """Two in-flight eager collectives share a name.
+
+    Reference: DUPLICATE_NAME_ERROR status (horovod/common/common.h:230) and
+    duplicate detection in horovod/common/tensor_queue.cc.
+    """
+
+
+class StalledTensorError(HorovodTpuError):
+    """Stall inspector forced shutdown (reference stall_inspector.cc)."""

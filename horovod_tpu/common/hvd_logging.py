@@ -1,0 +1,55 @@
+"""Rank-prefixed logging.
+
+Reference: horovod/common/logging.cc — C++ macro logger with levels TRACE..
+FATAL, optional timestamps, rank prefix, controlled by HOROVOD_LOG_LEVEL /
+HOROVOD_LOG_HIDE_TIME. Here it is a thin layer over the std logging module
+with the same env contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": logging.DEBUG,   # python has no TRACE; map to DEBUG
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+_logger: logging.Logger | None = None
+
+
+class _RankFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        from horovod_tpu.core import topology
+        record.hvd_rank = topology.rank_or_none()
+        if record.hvd_rank is None:
+            record.hvd_rank = "-"
+        return True
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    if _logger is not None:
+        return _logger
+    logger = logging.getLogger("horovod_tpu")
+    level = _LEVELS.get(os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
+                        logging.WARNING)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        hide_time = os.environ.get("HOROVOD_LOG_HIDE_TIME", "").lower() in (
+            "1", "true", "yes")
+        fmt = "[%(levelname)s | rank %(hvd_rank)s] %(message)s" if hide_time else \
+            "%(asctime)s [%(levelname)s | rank %(hvd_rank)s] %(message)s"
+        handler.setFormatter(logging.Formatter(fmt))
+        handler.addFilter(_RankFilter())
+        logger.addHandler(handler)
+        logger.propagate = False
+    _logger = logger
+    return logger

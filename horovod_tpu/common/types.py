@@ -1,0 +1,190 @@
+"""Core value types shared across the framework.
+
+TPU-native re-design of the reference's common types (reference:
+horovod/common/common.h:170-360, horovod/common/message.h:43-70). Where the
+reference defines an abstract Tensor/OpContext hierarchy so four frameworks can
+share one C++ runtime, we have a single array language (JAX) — so the types
+here are the *semantic* ones: reduce ops, status, data types, and the
+per-tensor metadata used by the eager negotiation path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction operators for allreduce/reducescatter.
+
+    Mirrors the reference's ReduceOp enum (horovod/common/message.h:43-49).
+    """
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Horovod-style module-level aliases (reference: horovod/torch/mpi_ops.py).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+class StatusType(enum.IntEnum):
+    """Result classification (reference: horovod/common/common.h:175-182)."""
+
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class Status:
+    """Operation status (reference: horovod/common/common.h:184-228)."""
+
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def UnknownError(msg: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, msg)
+
+    @staticmethod
+    def PreconditionError(msg: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    @staticmethod
+    def Aborted(msg: str) -> "Status":
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def InvalidArgument(msg: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, msg)
+
+    @staticmethod
+    def InProgress() -> "Status":
+        return Status(StatusType.IN_PROGRESS)
+
+    def ok(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+
+class RequestType(enum.IntEnum):
+    """Collective request kinds (reference: horovod/common/message.h:61-70)."""
+
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+    REDUCESCATTER = 7
+
+
+# DataType registry. The reference enumerates wire dtypes
+# (horovod/common/message.h:23-41); ours is keyed on jnp dtypes with bf16 as a
+# first-class citizen (TPU-native), fp8 reserved for compression paths.
+_SUPPORTED_DTYPES: Tuple[Any, ...] = (
+    jnp.uint8,
+    jnp.int8,
+    jnp.uint16,
+    jnp.int16,
+    jnp.int32,
+    jnp.int64,
+    jnp.float16,
+    jnp.bfloat16,
+    jnp.float32,
+    jnp.float64,
+    jnp.bool_,
+)
+
+
+def check_supported_dtype(dtype: Any) -> None:
+    d = jnp.dtype(dtype)
+    if not any(d == jnp.dtype(s) for s in _SUPPORTED_DTYPES):
+        raise ValueError(f"Unsupported dtype for collective: {dtype}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """Static signature of a tensor participating in a collective."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+
+    @staticmethod
+    def of(array: Any) -> "TensorSpec":
+        return TensorSpec(tuple(int(s) for s in np.shape(array)),
+                          str(jnp.asarray(array).dtype))
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveKey:
+    """Cache key for a compiled eager collective.
+
+    Plays the role of the reference's ResponseCache key (tensor name + params,
+    horovod/common/response_cache.h) — but on TPU the cached object is a
+    compiled XLA executable rather than a negotiated Response: same-signature
+    collectives hit the jit cache and skip all negotiation.
+    """
+
+    request_type: RequestType
+    specs: Tuple[TensorSpec, ...]
+    reduce_op: ReduceOp
+    process_set_id: int
+    prescale_factor: float
+    postscale_factor: float
+    extra: Tuple[Any, ...] = ()
+
+
+@dataclasses.dataclass
+class TensorTableEntry:
+    """Host-side record for one in-flight eager collective tensor.
+
+    Reference: horovod/common/common.h:360-395. On TPU this only exists on the
+    eager/dynamic path: jitted step functions compile their collectives in.
+    """
+
+    name: str
+    request_type: RequestType
+    reduce_op: ReduceOp
+    spec: TensorSpec
+    process_set_id: int
+    root_rank: int = -1
+    callback: Optional[Any] = None
+
+
+def reduce_op_name(op: ReduceOp) -> str:
+    return ReduceOp(op).name
+
+
+def normalize_reduce_op(op: Any) -> ReduceOp:
+    if isinstance(op, ReduceOp):
+        return op
+    if isinstance(op, int):
+        return ReduceOp(op)
+    if isinstance(op, str):
+        return ReduceOp[op.upper()]
+    raise ValueError(f"Cannot interpret reduce op: {op!r}")

@@ -1,0 +1,53 @@
+"""Join: uneven-data termination consensus.
+
+Reference: EnqueueJoin (horovod/common/operations.cc:1991) — a joined rank
+keeps participating in negotiated collectives with zero tensors until every
+rank joined; hvd.join() returns the last rank to join.
+
+TPU redesign (SURVEY.md §7 "hard parts"): compiled SPMD programs cannot
+inject dynamic zero-tensors, so join becomes a *max-iteration consensus*:
+ranks agree up front (or at exhaustion time) on the maximum step count and
+pad with zero-contribution steps. `join_steps` is the TPU-native primitive;
+`join()` is the Horovod-parity call usable at end of an eager training loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.common import types as T
+from horovod_tpu.core import topology
+from horovod_tpu.core.process_sets import ProcessSet
+from horovod_tpu.ops import collectives
+
+
+def join_steps(local_steps: int,
+               process_set: Optional[ProcessSet] = None) -> int:
+    """Agree on the padded step count: max of every rank's local step count.
+
+    Training loops run `join_steps(n_local)` iterations; ranks whose data ran
+    out contribute zero gradients (`padded_batch_mask` below) — the compiled
+    equivalent of Horovod's zero-tensor JOIN responses.
+    """
+    out = collectives.allreduce(
+        np.asarray([local_steps], np.int64), op=T.ReduceOp.MAX,
+        process_set=process_set)
+    return int(np.asarray(out).reshape(-1)[0])
+
+
+def join(process_set: Optional[ProcessSet] = None) -> int:
+    """Barrier-style join for eager loops (reference hvd.join()).
+
+    Blocks until every rank has called join; returns the highest rank that
+    joined (the reference returns the *last* rank to join — with a fused
+    consensus there is no ordering, so the max rank is reported).
+    """
+    st = topology.state()
+    st.joined = True
+    out = collectives.allreduce(
+        np.asarray([topology.rank()], np.int64), op=T.ReduceOp.MAX,
+        process_set=process_set)
+    st.joined = False
+    return int(np.asarray(out).reshape(-1)[0])

@@ -1,0 +1,167 @@
+"""Process sets: collectives over subsets of ranks.
+
+Reference: horovod/common/process_set.cc/.h + horovod/common/process_sets.py.
+There, a ProcessSet bundles {controller, tensor queue, response cache, MPI/Gloo
+sub-communicator}. TPU-native redesign: a ProcessSet is a **sub-mesh** — a
+`jax.sharding.Mesh` over the member ranks' devices. Collectives for the set
+are compiled over that sub-mesh, so XLA emits ICI/DCN collectives scoped to
+exactly those chips (the role NCCL sub-communicators play in the reference).
+
+Dynamic add/remove (HOROVOD_DYNAMIC_PROCESS_SETS,
+horovod/common/operations.cc:771-788) is supported: in single-controller mode
+registration is immediate; in multi-process mode every process must call
+add_process_set with identical ranks (same contract as the reference, which
+coordinates registration in the background loop).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+
+GLOBAL_PROCESS_SET_ID = 0
+
+
+class ProcessSet:
+    """A subset of ranks collectives can be restricted to.
+
+    Mirrors horovod/common/process_sets.py ProcessSet: constructed from a
+    rank list, materialized (given an id + communicator) at init/registration.
+    """
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.ranks: Optional[List[int]] = (
+            sorted(set(int(r) for r in ranks)) if ranks is not None else None)
+        self.process_set_id: Optional[int] = None
+        self.mesh: Optional[Mesh] = None
+        self._axis = "hvd"
+
+    def included(self) -> bool:
+        """Is the current process a member? (reference: ProcessSet.included)"""
+        from horovod_tpu.core import topology
+        if self.ranks is None:
+            return True
+        mine = set(topology.local_slot_ranks())
+        return bool(mine & set(self.ranks))
+
+    def size(self) -> int:
+        if self.ranks is None:
+            from horovod_tpu.core import topology
+            return topology.size()
+        return len(self.ranks)
+
+    def rank_index(self, global_rank: int) -> int:
+        """Position of a global rank within this set."""
+        if self.ranks is None:
+            return global_rank
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            raise HorovodTpuError(
+                f"rank {global_rank} is not in process set {self.process_set_id}")
+
+    def __repr__(self) -> str:
+        return (f"ProcessSet(id={self.process_set_id}, "
+                f"ranks={self.ranks if self.ranks is not None else 'GLOBAL'})")
+
+
+# The module-level global set object (reference: process_sets.py global_process_set)
+global_process_set = ProcessSet(None)
+
+
+class ProcessSetTable:
+    """Registry with id reuse (reference: horovod/common/process_set.h:143)."""
+
+    def __init__(self, topo_state) -> None:
+        self._lock = threading.RLock()
+        self._topo = topo_state
+        self._table: Dict[int, ProcessSet] = {}
+        self._next_id = 1
+        self._free_ids: List[int] = []
+        # id 0 = global set over the full mesh
+        global_process_set.process_set_id = GLOBAL_PROCESS_SET_ID
+        global_process_set.ranks = None
+        global_process_set.mesh = topo_state.mesh
+        self._table[GLOBAL_PROCESS_SET_ID] = global_process_set
+
+    def _build_mesh(self, ranks: Sequence[int]) -> Mesh:
+        devs = [self._topo.devices[r] for r in ranks]
+        return Mesh(np.asarray(devs), ("hvd",))
+
+    def register(self, ps: ProcessSet) -> int:
+        with self._lock:
+            if ps.ranks is None:
+                ps.process_set_id = GLOBAL_PROCESS_SET_ID
+                ps.mesh = self._topo.mesh
+                return GLOBAL_PROCESS_SET_ID
+            bad = [r for r in ps.ranks if r < 0 or r >= self._topo.size]
+            if bad:
+                raise HorovodTpuError(f"process set ranks out of range: {bad}")
+            # Identical-ranks set already registered → return it (reference
+            # allows duplicates only transiently; we dedupe).
+            for sid, existing in self._table.items():
+                if existing.ranks == ps.ranks:
+                    ps.process_set_id = sid
+                    ps.mesh = existing.mesh
+                    return sid
+            sid = self._free_ids.pop() if self._free_ids else self._next_id
+            if sid == self._next_id:
+                self._next_id += 1
+            ps.process_set_id = sid
+            ps.mesh = self._build_mesh(ps.ranks)
+            self._table[sid] = ps
+            return sid
+
+    def remove(self, ps: ProcessSet) -> None:
+        with self._lock:
+            sid = ps.process_set_id
+            if sid in (None, GLOBAL_PROCESS_SET_ID):
+                raise HorovodTpuError("cannot remove the global process set")
+            if sid in self._table:
+                del self._table[sid]
+                self._free_ids.append(sid)
+            ps.process_set_id = None
+            ps.mesh = None
+
+    def get(self, process_set_id: int) -> ProcessSet:
+        with self._lock:
+            if process_set_id not in self._table:
+                raise HorovodTpuError(
+                    f"unknown process set id {process_set_id}")
+            return self._table[process_set_id]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._table)
+
+
+def _table() -> ProcessSetTable:
+    from horovod_tpu.core import topology
+    t = topology.state().process_set_table
+    assert t is not None
+    return t
+
+
+def add_process_set(ranks_or_ps) -> ProcessSet:
+    """Register a new process set after init (reference process_sets.py:123).
+
+    In multi-process mode all processes must call this with identical ranks.
+    """
+    ps = ranks_or_ps if isinstance(ranks_or_ps, ProcessSet) else ProcessSet(
+        ranks_or_ps)
+    _table().register(ps)
+    return ps
+
+
+def remove_process_set(ps: ProcessSet) -> None:
+    """Deregister (reference process_sets.py:145)."""
+    _table().remove(ps)
+
+
+def get_process_set(process_set_id: int) -> ProcessSet:
+    return _table().get(process_set_id)

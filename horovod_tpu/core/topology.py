@@ -1,0 +1,279 @@
+"""Process/device topology: init, rank/size, and the global device mesh.
+
+TPU-native replacement for the reference's init path
+(horovod/common/operations.cc:856 InitializeHorovodOnce +
+horovod/common/basics.py:51 HorovodBasics.init). Key re-design:
+
+* The reference spawns a background C++ thread per process that negotiates
+  tensor readiness every cycle. On TPU, collectives inside a jitted step are
+  compiled into the XLA program — there is nothing to negotiate. What remains
+  host-side is *topology*: which processes exist, which devices they own, and
+  the `jax.sharding.Mesh` every collective runs over.
+
+* A Horovod "rank" maps to a *device slot*, not a process. With the
+  canonical one-process-per-chip launch (our launcher mirrors
+  horovod/runner/gloo_run.py) rank == process index. Under a single
+  controller owning many devices (e.g. tests on an 8-device CPU mesh, or a
+  whole v5e host), each local device is a rank and per-rank tensors carry a
+  leading local-slot axis. This keeps Horovod's SPMD semantics while staying
+  idiomatic JAX.
+
+* Multi-process bootstrap goes through `jax.distributed.initialize`
+  (coordinator = our launcher's rendezvous, replacing the Gloo HTTP KV store
+  in horovod/common/gloo/gloo_context.cc).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.exceptions import HorovodTpuError
+
+_AXIS = "hvd"  # global mesh axis name used by every collective
+
+
+class _GlobalState:
+    """Singleton topology state (role of horovod/common/global_state.h)."""
+
+    def __init__(self) -> None:
+        self.initialized = False
+        self.config: Config = Config()
+        self.devices: List[jax.Device] = []
+        self.mesh: Optional[Mesh] = None
+        self.size: int = 0
+        self.rank: int = 0
+        self.local_size: int = 0
+        self.local_rank: int = 0
+        self.cross_size: int = 0
+        self.cross_rank: int = 0
+        self.local_slot_ranks: List[int] = []
+        self.process_index: int = 0
+        self.num_processes: int = 1
+        self.lock = threading.RLock()
+        # Set lazily by sibling modules to avoid import cycles.
+        self.process_set_table = None
+        self.timeline = None
+        self.parameter_manager = None
+        self.stall_inspector = None
+        self.joined = False
+
+    def reset(self) -> None:
+        self.__init__()
+
+
+_state = _GlobalState()
+
+
+def _canonical_devices() -> List[jax.Device]:
+    """All devices in rank order: sorted by (process_index, device id).
+
+    This makes each process's devices contiguous in rank space, so
+    local_rank arithmetic matches the reference launcher's slot model
+    (horovod/runner/gloo_run.py host allocation).
+    """
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+
+
+def _maybe_distributed_init(cfg: Config) -> None:
+    """Bootstrap multi-process JAX if the launcher injected a rendezvous.
+
+    Replaces the Gloo TCP rendezvous against the launcher HTTP store
+    (horovod/common/gloo/gloo_context.cc + http_store.cc). Our launcher
+    injects HOROVOD_RANK/SIZE and coordinator address; we hand them to
+    jax.distributed (the TPU-native control plane over DCN).
+    """
+    if cfg.rendezvous_addr and cfg.size is not None and cfg.size > 1:
+        if jax._src.distributed.global_state.client is None:  # not yet initialized
+            jax.distributed.initialize(
+                coordinator_address=f"{cfg.rendezvous_addr}:{cfg.rendezvous_port}",
+                num_processes=cfg.size,
+                process_id=cfg.rank or 0,
+            )
+
+
+def init(process_sets: Optional[Sequence] = None,
+         devices: Optional[Sequence[jax.Device]] = None) -> None:
+    """Initialize the framework (reference API: hvd.init(), basics.py:51).
+
+    Args:
+      process_sets: optional list of ProcessSet objects to register beyond
+        the global one (reference: horovod/common/process_sets.py).
+      devices: optional explicit device list (for tests / sub-slice runs).
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        cfg = Config.from_env()
+        _state.config = cfg
+        _maybe_distributed_init(cfg)
+
+        devs = list(devices) if devices is not None else _canonical_devices()
+        if not devs:
+            raise HorovodTpuError("no JAX devices visible")
+        _state.devices = devs
+        _state.size = len(devs)
+        _state.mesh = Mesh(np.asarray(devs), (_AXIS,))
+
+        pidx = jax.process_index()
+        pcount = jax.process_count()
+        _state.process_index = pidx
+        _state.num_processes = pcount
+        _state.local_slot_ranks = [
+            i for i, d in enumerate(devs) if d.process_index == pidx]
+        if not _state.local_slot_ranks and devices is not None:
+            # Explicit sub-slice that excludes this process: not a member.
+            _state.local_slot_ranks = []
+
+        # rank/local/cross, with launcher env taking precedence
+        # (reference: env injected per-slot in runner/gloo_run.py:69-75).
+        _state.rank = cfg.rank if cfg.rank is not None else (
+            _state.local_slot_ranks[0] if _state.local_slot_ranks else 0)
+        _state.local_size = cfg.local_size if cfg.local_size is not None else len(
+            _state.local_slot_ranks)
+        _state.local_rank = cfg.local_rank if cfg.local_rank is not None else 0
+        _state.cross_size = cfg.cross_size if cfg.cross_size is not None else pcount
+        _state.cross_rank = cfg.cross_rank if cfg.cross_rank is not None else pidx
+
+        if cfg.compile_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", cfg.compile_cache_dir)
+
+        # Register the global process set (+ user sets) now that mesh exists.
+        from horovod_tpu.core import process_sets as ps_mod
+        _state.process_set_table = ps_mod.ProcessSetTable(_state)
+        if process_sets:
+            for ps in process_sets:
+                _state.process_set_table.register(ps)
+
+        from horovod_tpu.common.hvd_logging import get_logger
+        get_logger().info(
+            "horovod_tpu initialized: size=%d local_size=%d processes=%d "
+            "platform=%s", _state.size, _state.local_size, pcount,
+            devs[0].platform)
+        _state.initialized = True
+
+
+def shutdown() -> None:
+    """Tear down (reference: horovod_shutdown, operations.cc:1009)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.timeline is not None:
+            _state.timeline.shutdown()
+        from horovod_tpu.ops import collectives as _coll
+        _coll.clear_compiled_cache()
+        _state.reset()
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise HorovodTpuError(
+            "horovod_tpu has not been initialized; call hvd.init() first.")
+    return _state
+
+
+def state() -> _GlobalState:
+    return _require_init()
+
+
+def raw_state() -> _GlobalState:
+    return _state
+
+
+def size() -> int:
+    """Total number of ranks (device slots). Reference: horovod_size."""
+    return _require_init().size
+
+
+def rank() -> int:
+    """This process's first rank. Reference: horovod_rank."""
+    return _require_init().rank
+
+
+def local_size() -> int:
+    return _require_init().local_size
+
+
+def local_rank() -> int:
+    return _require_init().local_rank
+
+
+def cross_size() -> int:
+    return _require_init().cross_size
+
+
+def cross_rank() -> int:
+    return _require_init().cross_rank
+
+
+def local_slot_ranks() -> List[int]:
+    """Ranks whose devices this process owns (len == #local devices)."""
+    return list(_require_init().local_slot_ranks)
+
+
+def mesh() -> Mesh:
+    """The global 1-D device mesh (axis name 'hvd')."""
+    m = _require_init().mesh
+    assert m is not None
+    return m
+
+
+def axis_name() -> str:
+    return _AXIS
+
+
+def is_homogeneous() -> bool:
+    """All processes own the same number of devices (reference:
+    horovod_is_homogeneous, used to gate hierarchical allreduce)."""
+    st = _require_init()
+    counts: dict = {}
+    for d in st.devices:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return len(set(counts.values())) <= 1
+
+
+def rank_or_none() -> Optional[int]:
+    return _state.rank if _state.initialized else None
+
+
+# Capability flags (reference: mpi_built()/nccl_built()/... in basics.py).
+def tpu_built() -> bool:
+    return True
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False

@@ -1,0 +1,70 @@
+"""Gradient compression hooks.
+
+Reference: horovod/tensorflow/compression.py:1-74 (Compression.none/.fp16).
+TPU addition: bf16 is the native reduced precision on the MXU/ICI, so it is
+the recommended compressor here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface for compressing tensors before a collective."""
+
+    @staticmethod
+    def compress(tensor: jax.Array) -> Tuple[jax.Array, Any]:
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: jax.Array, ctx: Any) -> jax.Array:
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference compression.py NoneCompressor)."""
+
+    @staticmethod
+    def compress(tensor: jax.Array) -> Tuple[jax.Array, Any]:
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: jax.Array, ctx: Any) -> jax.Array:
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: Any = jnp.float16
+
+    @classmethod
+    def compress(cls, tensor: jax.Array) -> Tuple[jax.Array, Any]:
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor: jax.Array, ctx: Any) -> jax.Array:
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast float tensors to fp16 on the wire (reference FP16Compressor)."""
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """Cast float tensors to bf16 on the wire — TPU-native default choice."""
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Option namespace (reference compression.py:66-74)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,76 @@
+"""Trace-time tensor fusion (the fusion-buffer analog).
+
+Reference: horovod/common/fusion_buffer_manager.cc + the MemcpyInFusionBuffer
+machinery (collective_operations.h:89-124) and the FuseResponses rules
+(controller.cc:901): only tensors with the same dtype fuse, and a fused
+payload stays under HOROVOD_FUSION_THRESHOLD bytes.
+
+TPU redesign: instead of a persistent 64-128MB device buffer plus batched D2D
+memcpy kernels (cuda_kernels.cu), fusion happens at trace time — flatten,
+concat into ≤-threshold buckets, run ONE collective per bucket, split back.
+XLA fuses the reshapes/concats into the collective's prologue/epilogue, which
+is exactly what the hand-written memcpy kernels were approximating.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def plan_buckets(shapes_dtypes: Sequence[Tuple[Tuple[int, ...], str]],
+                 threshold_bytes: int) -> List[List[int]]:
+    """Partition tensor indices into fusion buckets.
+
+    Same-dtype tensors are packed greedily in submission order until the
+    bucket would exceed `threshold_bytes` (FuseResponses greedy rule,
+    controller.cc:901-980). Returns a list of index lists.
+    """
+    buckets: List[List[int]] = []
+    open_bucket: dict = {}  # dtype -> (bucket_index, bytes_used)
+    for i, (shape, dtype) in enumerate(shapes_dtypes):
+        nbytes = int(np.prod(shape, dtype=np.int64)) * jnp.dtype(dtype).itemsize
+        cur = open_bucket.get(dtype)
+        if cur is not None and cur[1] + nbytes <= max(threshold_bytes, nbytes):
+            buckets[cur[0]].append(i)
+            open_bucket[dtype] = (cur[0], cur[1] + nbytes)
+        else:
+            buckets.append([i])
+            open_bucket[dtype] = (len(buckets) - 1, nbytes)
+    return buckets
+
+
+def fused_reduce_blocks(blocks: Sequence[jax.Array],
+                        reduce_fn: Callable[[jax.Array], jax.Array],
+                        threshold_bytes: int) -> Tuple[jax.Array, ...]:
+    """Reduce many (1, *shape) blocks with one collective per fusion bucket.
+
+    `reduce_fn` maps a (1, n) fused block to its reduced (1, n) result.
+    """
+    metas = [(tuple(b.shape[1:]), str(b.dtype)) for b in blocks]
+    buckets = plan_buckets(metas, threshold_bytes)
+    out: List[jax.Array] = [None] * len(blocks)  # type: ignore[list-item]
+    for idxs in buckets:
+        flats = [blocks[i].reshape(1, -1) for i in idxs]
+        sizes = [f.shape[1] for f in flats]
+        fused = flats[0] if len(flats) == 1 else jnp.concatenate(flats, axis=1)
+        red = reduce_fn(fused)
+        off = 0
+        for i, n in zip(idxs, sizes):
+            piece = red[:, off:off + n]
+            out[i] = piece.reshape(blocks[i].shape).astype(blocks[i].dtype)
+            off += n
+    return tuple(out)
+
+
+def flatten_and_bucket(tree, threshold_bytes: int):
+    """Bucket an arbitrary pytree of arrays (used by DistributedOptimizer).
+
+    Returns (leaves, treedef, buckets) where buckets index into leaves.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    metas = [(tuple(np.shape(l)), str(jnp.asarray(l).dtype)) for l in leaves]
+    return leaves, treedef, plan_buckets(metas, threshold_bytes)

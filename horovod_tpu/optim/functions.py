@@ -1,0 +1,90 @@
+"""State broadcast/gather utilities.
+
+Reference: horovod/torch/functions.py — broadcast_parameters (:30),
+broadcast_optimizer_state (:62), broadcast_object (:201) — and
+hvd.broadcast_variables / allgather_object on the TF side
+(horovod/tensorflow/__init__.py).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.core import topology
+from horovod_tpu.core.process_sets import ProcessSet, global_process_set
+from horovod_tpu.ops import collectives
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast a pytree of arrays from root to all ranks.
+
+    Reference: broadcast_parameters (torch/functions.py:30). Returns the
+    synchronized pytree (JAX is functional — no in-place mutation).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = [collectives.broadcast(l, root_rank=root_rank,
+                                 process_set=process_set) for l in leaves]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0,
+                              process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast optax optimizer state (reference torch/functions.py:62 —
+    there it must walk torch param groups; an optax state is just a pytree)."""
+    return broadcast_parameters(opt_state, root_rank=root_rank,
+                                process_set=process_set)
+
+
+# TF-parity name (hvd.broadcast_variables).
+broadcast_variables = broadcast_parameters
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast an arbitrary picklable object (torch/functions.py:201).
+
+    Wire format mirrors the reference: broadcast the byte length first, then
+    the pickled payload as a uint8 tensor.
+    """
+    del name
+    ps = process_set or global_process_set
+    if topology.rank() == root_rank or jax.process_count() == 1:
+        payload = pickle.dumps(obj)
+        buf = np.frombuffer(payload, dtype=np.uint8)
+    else:
+        buf = np.zeros((0,), dtype=np.uint8)
+    length = collectives.broadcast(
+        np.asarray([buf.size], np.int64), root_rank=root_rank,
+        process_set=ps)
+    n = int(np.asarray(length).reshape(-1)[0])
+    if buf.size != n:
+        buf = np.zeros((n,), dtype=np.uint8)
+    data = collectives.broadcast(buf, root_rank=root_rank, process_set=ps)
+    data = np.asarray(data).astype(np.uint8).tobytes()
+    return pickle.loads(data)
+
+
+def allgather_object(obj: Any,
+                     process_set: Optional[ProcessSet] = None) -> list:
+    """Gather one picklable object per rank (reference: allgather_object,
+    torch/mpi_ops.py). Uses the uneven allgather path for the payloads."""
+    ps = process_set or global_process_set
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    gathered = collectives.allgather(payload, process_set=ps)
+    sizes = collectives.allgather(
+        np.asarray([payload.size], np.int64), process_set=ps)
+    sizes = [int(s) for s in np.asarray(sizes).reshape(-1)]
+    flat = np.asarray(gathered).astype(np.uint8).tobytes()
+    out, off = [], 0
+    for s in sizes:
+        out.append(pickle.loads(flat[off:off + s]))
+        off += s
+    return out

@@ -1,0 +1,120 @@
+"""Chrome-trace timeline.
+
+Reference: horovod/common/timeline.cc (678 LoC) — rank 0 writes
+about:tracing JSON from a dedicated writer thread fed by a lock-free queue;
+spans follow NEGOTIATE_* → QUEUE → <op activity> per named tensor; runtime
+start/stop via horovod_start_timeline (operations.cc:1077);
+HOROVOD_TIMELINE[=DYNAMIC] + HOROVOD_TIMELINE_MARK_CYCLES env knobs.
+
+TPU redesign: there is no negotiation phase to trace for compiled
+collectives; the interesting host-side spans are ENQUEUE (eager call),
+COMPILE (executable-cache miss) and EXECUTE. Device-side detail comes from
+`jax.profiler` (XPlane); `start_jax_trace` bridges the two. The writer-thread
++ queue structure is preserved so tracing never blocks the hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+# Chrome trace phase constants
+_PH_COMPLETE = "X"
+_PH_INSTANT = "i"
+_PH_METADATA = "M"
+
+
+class Timeline:
+    """Async Chrome-trace writer (reference TimelineWriter, timeline.h:28)."""
+
+    def __init__(self, path: str, mark_cycles: bool = False) -> None:
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._active = False
+        self._t0 = time.monotonic_ns()
+        self._lock = threading.Lock()
+        self._pending_spans: dict = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._active:
+                return
+            self._active = True
+            self._thread = threading.Thread(
+                target=self._writer_loop, name="hvd-timeline", daemon=True)
+            self._thread.start()
+            self._emit({"ph": _PH_METADATA, "pid": 0, "name": "process_name",
+                        "args": {"name": "horovod_tpu"}})
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._active:
+                return
+            self._active = False
+            self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def shutdown(self) -> None:
+        self.stop()
+
+    # -- recording ---------------------------------------------------------
+    def _now_us(self) -> float:
+        return (time.monotonic_ns() - self._t0) / 1e3
+
+    def _emit(self, event: dict) -> None:
+        if self._active:
+            self._queue.put(event)
+
+    def record_instant(self, name: str, activity: str) -> None:
+        self._emit({"ph": _PH_INSTANT, "pid": 0, "tid": 0, "s": "t",
+                    "ts": self._now_us(), "name": f"{activity}:{name}"})
+
+    def span_begin(self, name: str, activity: str) -> None:
+        self._pending_spans[(name, activity)] = self._now_us()
+
+    def span_end(self, name: str, activity: str) -> None:
+        t0 = self._pending_spans.pop((name, activity), None)
+        if t0 is None:
+            return
+        t1 = self._now_us()
+        self._emit({"ph": _PH_COMPLETE, "pid": 0, "tid": 0, "ts": t0,
+                    "dur": t1 - t0, "name": activity, "args": {"tensor": name}})
+
+    def mark_cycle(self) -> None:
+        if self.mark_cycles:
+            self.record_instant("cycle", "CYCLE_START")
+
+    # -- writer thread (reference TimelineWriter::WriterLoop) --------------
+    def _writer_loop(self) -> None:
+        events = []
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                break
+            events.append(ev)
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        os.replace(tmp, self.path)
+
+
+def start_jax_trace(log_dir: str) -> None:
+    """Bridge to device-side profiling (jax.profiler / XPlane): the TPU
+    counterpart of the reference's NVTX ranges (common/nvtx_op_range.cc)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_jax_trace() -> None:
+    import jax
+    jax.profiler.stop_trace()

@@ -1,0 +1,41 @@
+"""Test fixture: an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference runs its
+collective suites under `mpirun -np 2` over loopback; we emulate an 8-rank
+TPU slice with XLA's host-platform device-count flag so every collective runs
+through the real shard_map/XLA path — no fake communication backend.
+
+Note: this image's sitecustomize registers the axon TPU PJRT plugin and
+pins jax_platforms via jax.config, so env vars alone don't switch platforms —
+we must override through jax.config as well, before any backend is touched.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd():
+    """Initialized framework handle; shuts down after the test."""
+    import horovod_tpu as hvd_mod
+    hvd_mod.init()
+    yield hvd_mod
+    hvd_mod.shutdown()
+
+
+@pytest.fixture(scope="session")
+def hvd_session():
+    import horovod_tpu as hvd_mod
+    hvd_mod.init()
+    return hvd_mod

@@ -1,0 +1,58 @@
+"""Topology / init tests (reference analog: test/parallel/test_torch.py
+rank/size assertions + test/single basics)."""
+
+import numpy as np
+import pytest
+
+
+def test_init_size_rank(hvd):
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.local_slot_ranks() == list(range(8))
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+
+
+def test_mesh(hvd):
+    m = hvd.mesh()
+    assert m.axis_names == ("hvd",)
+    assert m.devices.size == 8
+
+
+def test_double_init_is_noop(hvd):
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_uninitialized_raises():
+    import horovod_tpu as hvd_mod
+    hvd_mod.shutdown()
+    with pytest.raises(hvd_mod.HorovodTpuError):
+        hvd_mod.size()
+
+
+def test_built_flags(hvd):
+    assert hvd.tpu_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_built()
+    assert hvd.is_homogeneous()
+
+
+def test_process_set_registration(hvd):
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    assert ps.process_set_id is not None and ps.process_set_id > 0
+    assert ps.size() == 4
+    assert ps.rank_index(2) == 2
+    # duplicate ranks dedupe to the same set
+    ps2 = hvd.add_process_set([0, 1, 2, 3])
+    assert ps2.process_set_id == ps.process_set_id
+    hvd.remove_process_set(ps)
+    with pytest.raises(hvd_error(hvd)):
+        hvd.get_process_set(99)
+
+
+def hvd_error(hvd):
+    return hvd.HorovodTpuError
