@@ -1,0 +1,102 @@
+"""Headline benchmark: ResNet-50 synthetic training throughput (images/sec).
+
+Mirrors the reference's synthetic benchmark
+(examples/pytorch/pytorch_synthetic_benchmark.py — ResNet-50, random data,
+images/sec; docs/benchmarks.rst reproduction recipe). Runs on whatever
+devices are visible (the driver provides one real TPU chip) through the
+framework's own data-parallel train-step path: gradients bucketed and
+psum'd inside one compiled XLA program (optim/optimizer.py).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline compares images/sec/chip against the reference's published
+per-GPU throughput, 1656.8/16 ≈ 103.55 images/sec (ResNet-101,
+tf_cnn_benchmarks, 4×4 Pascal P100 — docs/benchmarks.rst:40-42; the closest
+published absolute number in the reference tree, see BASELINE.md).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.core import topology
+from horovod_tpu.models import resnet
+from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
+
+BASELINE_PER_CHIP = 1656.8 / 16  # images/sec/GPU, reference docs/benchmarks.rst:40-42
+
+
+def main():
+    hvd.init()
+    mesh = topology.mesh()
+    k = hvd.size()
+    on_cpu = jax.devices()[0].platform == "cpu"
+
+    # Per-chip batch 128 bf16 on TPU; tiny smoke config on CPU.
+    per_chip = 8 if on_cpu else 128
+    img = 32 if on_cpu else 224
+    steps, warmup = (3, 1) if on_cpu else (30, 5)
+    batch = per_chip * k
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+
+    params, stats = resnet.init(jax.random.PRNGKey(0), depth=50,
+                                num_classes=1000, dtype=dtype)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+
+    def local_step(params, stats, opt_state, batch):
+        def loss(p):
+            return resnet.loss_fn(p, stats, batch, depth=50, train=True,
+                                  axis_name="hvd")
+        (l, new_stats), grads = jax.value_and_grad(loss, has_aux=True)(params)
+        grads = reduce_gradients_in_jit(grads, num_ranks=k)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, lax.pmean(l, "hvd")
+
+    step = jax.jit(
+        jax.shard_map(local_step, mesh=mesh,
+                      in_specs=(P(), P(), P(), P("hvd")),
+                      out_specs=(P(), P(), P(), P()),
+                      check_vma=False),
+        donate_argnums=(0, 1, 2))
+
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        rng.standard_normal((batch, img, img, 3), np.float32).astype(dtype),
+        NamedSharding(mesh, P("hvd")))
+    labels = jax.device_put(rng.integers(0, 1000, (batch,)),
+                            NamedSharding(mesh, P("hvd")))
+    data = (images, labels)
+
+    # NOTE: completion is forced by a host readback of the final loss —
+    # through the remote-device tunnel, block_until_ready can return before
+    # compute finishes, but a D2H transfer cannot.
+    for _ in range(warmup):
+        params, stats, opt_state, l = step(params, stats, opt_state, data)
+    float(l)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, stats, opt_state, l = step(params, stats, opt_state, data)
+    float(l)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    per_chip_ips = ips / k
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(per_chip_ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip_ips / BASELINE_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,15 @@ class ProcessSet:
             raise HorovodTpuError(
                 f"rank {global_rank} is not in process set {self.process_set_id}")
 
+    @property
+    def cache_token(self):
+        """Identity token for compiled-executable cache keys. Includes the
+        rank tuple, not just the id: ProcessSetTable recycles ids
+        (reference: process_set.h id reuse), so an id alone could alias a
+        removed set's executables compiled over different devices."""
+        return (self.process_set_id,
+                tuple(self.ranks) if self.ranks is not None else None)
+
     def __repr__(self) -> str:
         return (f"ProcessSet(id={self.process_set_id}, "
                 f"ranks={self.ranks if self.ranks is not None else 'GLOBAL'})")

@@ -1,0 +1,11 @@
+"""Model zoo: the benchmark models the reference exercises
+(examples/pytorch/pytorch_mnist.py, examples/*/\\*_synthetic_benchmark.py —
+MNIST MLP/convnet, ResNet-50) plus the transformer flagship used for
+long-context and multi-axis parallelism (absent from the reference; this
+framework treats it as first-class, SURVEY.md §5).
+
+Models are plain functional JAX: `init(key, ...) -> params` pytrees and
+pure `apply` functions — idiomatic for pjit/shard_map, no framework layer.
+"""
+
+from horovod_tpu.models import mlp, resnet, transformer  # noqa: F401

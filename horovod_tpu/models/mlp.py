@@ -1,0 +1,37 @@
+"""MNIST-scale MLP — the reference's smallest end-to-end config
+(examples/pytorch/pytorch_mnist.py uses a small convnet; the MLP plays the
+same role as the minimal DistributedOptimizer smoke model)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init(key: jax.Array, sizes: Sequence[int] = (784, 512, 256, 10),
+         dtype=jnp.float32):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, wk = jax.random.split(key)
+        w = jax.random.normal(wk, (sizes[i], sizes[i + 1]), dtype) * \
+            (2.0 / sizes[i]) ** 0.5
+        b = jnp.zeros((sizes[i + 1],), dtype)
+        params.append({"w": w, "b": b})
+    return params
+
+
+def apply(params, x: jax.Array) -> jax.Array:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params, batch: Tuple[jax.Array, jax.Array]) -> jax.Array:
+    x, y = batch
+    logits = apply(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))

@@ -1,0 +1,156 @@
+"""ResNet v1.5 (50/101/152) — the reference's headline benchmark model
+(docs/benchmarks.rst:40-42 reports ResNet-101 images/sec under
+tf_cnn_benchmarks; examples/pytorch/pytorch_synthetic_benchmark.py defaults
+to resnet50).
+
+TPU-first choices:
+  * NHWC layout + bf16-friendly convs — XLA tiles NHWC convs onto the MXU.
+  * BatchNorm is functional: apply() returns (logits, new_batch_stats);
+    cross-replica stat sync is layered on via ops/sync_batch_norm.
+  * No Python control flow on data — the whole net is one traced graph.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+STAGE_BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * \
+        (2.0 / fan_in) ** 0.5
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_stats(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def batch_norm(x, p, stats, train: bool, momentum=0.9, eps=1e-5,
+               axis_name=None):
+    """Functional BN. With `axis_name`, batch stats are psum-synced across
+    that mesh axis (the role of hvd.SyncBatchNormalization,
+    reference: tensorflow/sync_batch_norm.py, torch/sync_batch_norm.py)."""
+    if train:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        meansq = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            meansq = lax.pmean(meansq, axis_name)
+        var = meansq - jnp.square(mean)
+        new_stats = {"mean": stats["mean"] * momentum + mean * (1 - momentum),
+                     "var": stats["var"] * momentum + var * (1 - momentum)}
+    else:
+        mean, var = stats["mean"], stats["var"]
+        new_stats = stats
+    inv = lax.rsqrt(var + eps).astype(x.dtype)
+    out = (x - mean.astype(x.dtype)) * inv * p["scale"] + p["bias"]
+    return out, new_stats
+
+
+def init(key: jax.Array, depth: int = 50, num_classes: int = 1000,
+         dtype=jnp.float32) -> Tuple[Dict, Dict]:
+    """Returns (params, batch_stats)."""
+    blocks = STAGE_BLOCKS[depth]
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    key, k0 = jax.random.split(key)
+    params["stem"] = {"conv": _conv_init(k0, 7, 7, 3, 64, dtype),
+                      "bn": _bn_init(64, dtype)}
+    stats["stem"] = _bn_stats(64)
+    cin = 64
+    for s, n in enumerate(blocks):
+        width = 64 * (2 ** s)
+        cout = width * 4
+        for b in range(n):
+            name = f"s{s}b{b}"
+            stride = 2 if (b == 0 and s > 0) else 1
+            key, k1, k2, k3, k4 = jax.random.split(key, 5)
+            blk = {
+                "conv1": _conv_init(k1, 1, 1, cin, width, dtype),
+                "bn1": _bn_init(width, dtype),
+                "conv2": _conv_init(k2, 3, 3, width, width, dtype),
+                "bn2": _bn_init(width, dtype),
+                "conv3": _conv_init(k3, 1, 1, width, cout, dtype),
+                "bn3": _bn_init(cout, dtype),
+            }
+            st = {"bn1": _bn_stats(width), "bn2": _bn_stats(width),
+                  "bn3": _bn_stats(cout)}
+            if b == 0:
+                blk["proj"] = _conv_init(k4, 1, 1, cin, cout, dtype)
+                blk["bnp"] = _bn_init(cout, dtype)
+                st["bnp"] = _bn_stats(cout)
+            params[name] = blk
+            stats[name] = st
+            cin = cout
+    key, kf = jax.random.split(key)
+    params["fc"] = {"w": jax.random.normal(kf, (cin, num_classes), dtype) *
+                    cin ** -0.5,
+                    "b": jnp.zeros((num_classes,), dtype)}
+    return params, stats
+
+
+def apply(params, stats, x: jax.Array, depth: int = 50, train: bool = True,
+          axis_name=None) -> Tuple[jax.Array, Dict]:
+    """x: (N, H, W, 3) NHWC. Returns (logits, new_batch_stats)."""
+    bn = functools.partial(batch_norm, train=train, axis_name=axis_name)
+    new_stats: Dict[str, Any] = {}
+    h = _conv(x, params["stem"]["conv"], stride=2)
+    h, new_stats["stem"] = bn(h, params["stem"]["bn"], stats["stem"])
+    h = jax.nn.relu(h)
+    h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    blocks = STAGE_BLOCKS[depth]
+    for s, n in enumerate(blocks):
+        for b in range(n):
+            name = f"s{s}b{b}"
+            blk, st = params[name], stats[name]
+            stride = 2 if (b == 0 and s > 0) else 1
+            ns = {}
+            y = _conv(h, blk["conv1"])
+            y, ns["bn1"] = bn(y, blk["bn1"], st["bn1"])
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv2"], stride=stride)
+            y, ns["bn2"] = bn(y, blk["bn2"], st["bn2"])
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv3"])
+            y, ns["bn3"] = bn(y, blk["bn3"], st["bn3"])
+            if "proj" in blk:
+                sc = _conv(h, blk["proj"], stride=stride)
+                sc, ns["bnp"] = bn(sc, blk["bnp"], st["bnp"])
+            else:
+                sc = h
+            h = jax.nn.relu(y + sc)
+            new_stats[name] = ns
+    h = jnp.mean(h, axis=(1, 2))
+    logits = h @ params["fc"]["w"] + params["fc"]["b"]
+    return logits, new_stats
+
+
+def loss_fn(params, stats, batch, depth: int = 50, train: bool = True,
+            axis_name=None):
+    """Cross-entropy; returns (loss, new_stats)."""
+    x, y = batch
+    logits, new_stats = apply(params, stats, x, depth=depth, train=train,
+                              axis_name=axis_name)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    return loss, new_stats

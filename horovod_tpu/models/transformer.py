@@ -1,0 +1,355 @@
+"""Transformer LM flagship — the multi-axis-parallel model of the framework.
+
+The reference has no model of its own (it wraps torch/TF models) and no
+TP/PP/SP/EP (SURVEY.md §2.6). This flagship exercises every mesh axis the
+framework supports, in one compiled XLA program per train step:
+
+  dp/ep — batch sharding; gradients psum'd over these axes (the Horovod
+          DistributedOptimizer role, reference torch/optimizer.py:36).
+  tp    — attention heads + FFN hidden sharded; row-parallel outputs psum'd.
+  sp    — sequence sharded; ring attention (parallel/ring_attention.py) or
+          Ulysses all_to_all attention (parallel/ulysses.py).
+  pp    — layer stack sharded into stages; GPipe microbatch schedule
+          (parallel/pipeline.py).
+  ep    — MoE FFN experts sharded; all_to_all token dispatch
+          (parallel/moe.py). When num_experts == 0 the FFN is dense.
+
+Everything is static-shape, scan-based, bf16-capable — MXU/XLA-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.parallel import moe as moe_mod
+from horovod_tpu.parallel import pipeline as pp_mod
+from horovod_tpu.parallel import ulysses as ulysses_mod
+from horovod_tpu.parallel.ring_attention import (
+    blockwise_attention_reference, ring_attention)
+from horovod_tpu.parallel.mesh import AXIS_ORDER, mesh_axis_sizes
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_layers: int = 4
+    max_seq: int = 2048
+    num_experts: int = 0          # 0 → dense FFN; >0 → MoE every layer
+    capacity_factor: float = 2.0
+    attn: str = "ring"            # "ring" | "ulysses" | "local"
+    microbatches: int = 1         # pipeline microbatches (≥ pp size ideal)
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
+    """Global (unsharded) parameter pytree."""
+    D, H, dh, F, L, V = (cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff,
+                         cfg.n_layers, cfg.vocab)
+    dt = cfg.dtype
+    ks = jax.random.split(key, 12)
+
+    def norm(k, shape, fan_in):
+        return jax.random.normal(k, shape, dt) * fan_in ** -0.5
+
+    layers: Dict[str, Any] = {
+        "ln1_scale": jnp.ones((L, D), dt), "ln1_bias": jnp.zeros((L, D), dt),
+        "wq": norm(ks[0], (L, D, H, dh), D),
+        "wk": norm(ks[1], (L, D, H, dh), D),
+        "wv": norm(ks[2], (L, D, H, dh), D),
+        "wo": norm(ks[3], (L, H, dh, D), H * dh),
+        "ln2_scale": jnp.ones((L, D), dt), "ln2_bias": jnp.zeros((L, D), dt),
+    }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layers.update({
+            "router": norm(ks[4], (L, D, E), D),
+            "we1": norm(ks[5], (L, E, D, F), D),
+            "we2": norm(ks[6], (L, E, F, D), F),
+        })
+    else:
+        layers.update({
+            "w1": norm(ks[4], (L, D, F), D),
+            "b1": jnp.zeros((L, F), dt),
+            "w2": norm(ks[5], (L, F, D), F),
+            "b2": jnp.zeros((L, D), dt),
+        })
+    return {
+        "embed": norm(ks[7], (V, D), 1.0) * 0.02 * D ** 0.5,
+        "pos": norm(ks[8], (cfg.max_seq, D), 1.0) * 0.02,
+        "layers": layers,
+        "lnf_scale": jnp.ones((D,), dt), "lnf_bias": jnp.zeros((D,), dt),
+        "unembed": norm(ks[9], (D, V), D),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpec tree matching init()'s structure (in_specs for
+    shard_map; also the NamedSharding layout for device_put)."""
+    lp = {
+        "ln1_scale": P("pp", None), "ln1_bias": P("pp", None),
+        "wq": P("pp", None, "tp", None),
+        "wk": P("pp", None, "tp", None),
+        "wv": P("pp", None, "tp", None),
+        "wo": P("pp", "tp", None, None),
+        "ln2_scale": P("pp", None), "ln2_bias": P("pp", None),
+    }
+    if cfg.num_experts:
+        lp.update({
+            "router": P("pp", None, None),
+            "we1": P("pp", "ep", None, None),
+            "we2": P("pp", "ep", None, None),
+        })
+    else:
+        lp.update({
+            "w1": P("pp", None, "tp"), "b1": P("pp", "tp"),
+            "w2": P("pp", "tp", None), "b2": P("pp", None),
+        })
+    return {
+        "embed": P(), "pos": P(), "layers": lp,
+        "lnf_scale": P(), "lnf_bias": P(), "unembed": P(),
+    }
+
+
+def grad_reduce_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Per-leaf mesh axes whose partial gradients must be psum'd — the
+    compiled counterpart of Horovod's gradient allreduce, generalised to a
+    multi-axis mesh (reference: torch/optimizer.py hooks psum over the one
+    world communicator)."""
+    # The tp axis computes the loss redundantly on every member, so per-rank
+    # reverse AD yields d(Σ_r L_r)/dθ_r = tp·dL/dθ in aggregate. The exact
+    # correction (verified leaf-by-leaf against a single-device oracle in
+    # tests/test_parallel.py) is: divide EVERY gradient by tp, and
+    # additionally pmean replicated-over-tp leaves — i.e. add 'tp' to their
+    # psum axes — to mix each rank's local-heads contribution.
+    data_axes = ("dp", "ep", "sp", "tp")    # replicated-over-tp layer params
+    glob = ("dp", "ep", "sp", "pp", "tp")   # replicated-over-everything
+    tp_sharded = ("dp", "ep", "sp")         # tp-sharded weights: no tp psum
+    lp = {"ln1_scale": data_axes, "ln1_bias": data_axes,
+          "ln2_scale": data_axes, "ln2_bias": data_axes,
+          "wq": tp_sharded, "wk": tp_sharded, "wv": tp_sharded,
+          "wo": tp_sharded}
+    if cfg.num_experts:
+        lp.update({"router": data_axes,
+                   "we1": ("dp", "sp", "tp"),   # expert-sharded over ep
+                   "we2": ("dp", "sp", "tp")})
+    else:
+        lp.update({"w1": tp_sharded, "b1": tp_sharded, "w2": tp_sharded,
+                   "b2": data_axes})
+    return {"embed": glob, "pos": glob, "layers": lp,
+            "lnf_scale": glob, "lnf_bias": glob, "unembed": glob}
+
+
+def _ln(x, scale, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+
+
+def _layer(x: jax.Array, lp: Dict[str, Any], cfg: TransformerConfig):
+    """One transformer block on per-shard activations x: (B, S_loc, D)."""
+    h = _ln(x, lp["ln1_scale"], lp["ln1_bias"])
+    q = jnp.einsum("bsd,dhk->bhsk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bhsk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bhsk", h, lp["wv"])
+    if cfg.attn == "ring":
+        a = ring_attention(q, k, v, "sp", causal=True)
+    elif cfg.attn == "ulysses":
+        a = ulysses_mod.ulysses_attention(q, k, v, "sp", causal=True)
+    else:
+        a = blockwise_attention_reference(q, k, v, causal=True)
+    o = jnp.einsum("bhsk,hkd->bsd", a, lp["wo"])
+    o = lax.psum(o, "tp")                    # row-parallel combine
+    x = x + o
+
+    h2 = _ln(x, lp["ln2_scale"], lp["ln2_bias"])
+    if cfg.num_experts:
+        B, S, D = h2.shape
+        flat = h2.reshape(B * S, D)
+        out = moe_mod.moe_ffn(flat, lp["router"], lp["we1"], lp["we2"],
+                              axis_name="ep",
+                              capacity_factor=cfg.capacity_factor)
+        f = out.reshape(B, S, D)
+    else:
+        u = jnp.einsum("bsd,df->bsf", h2, lp["w1"]) + lp["b1"]
+        u = jax.nn.gelu(u)
+        f = jnp.einsum("bsf,fd->bsd", u, lp["w2"])
+        f = lax.psum(f, "tp") + lp["b2"]
+    return x + f
+
+
+def _forward_local(params, tokens, cfg: TransformerConfig) -> jax.Array:
+    """Per-shard forward to logits. tokens: (B_loc, S_loc) int32, batch
+    sharded over (dp, ep), sequence over sp, run under shard_map. With
+    pp > 1 only the last stage's logits are real (zeros elsewhere)."""
+    sp_idx = lax.axis_index("sp")
+    B, S = tokens.shape
+    D = cfg.d_model
+
+    x = params["embed"][tokens]
+    pos = lax.dynamic_slice_in_dim(params["pos"], sp_idx * S, S, axis=0)
+    x = (x + pos[None]).astype(cfg.dtype)
+
+    def stage_fn(stage_params, act):
+        def body(a, lp):
+            return _layer(a, lp, cfg), None
+        out, _ = lax.scan(body, act, stage_params)
+        return out
+
+    M = cfg.microbatches
+    if lax.axis_size("pp") > 1 and M <= 1:
+        raise HorovodTpuError(
+            "pp > 1 requires microbatches > 1 (stages exchange activations "
+            "only through the pipeline schedule)")
+    if M > 1:
+        if B % M:
+            raise HorovodTpuError(f"local batch {B} not divisible by "
+                                  f"microbatches {M}")
+        xm = x.reshape(M, B // M, S, D)
+        ym = pp_mod.pipeline_apply(stage_fn, params["layers"], xm, "pp")
+        x = ym.reshape(B, S, D)
+    else:
+        x = stage_fn(params["layers"], x)
+
+    x = _ln(x, params["lnf_scale"], params["lnf_bias"])
+    return jnp.einsum("bsd,dv->bsv", x, params["unembed"])
+
+
+def _local_loss(params, tokens, targets, cfg: TransformerConfig):
+    """Per-shard loss contribution (see NOTE below on psum placement)."""
+    pp_size = lax.axis_size("pp")
+    B, S = tokens.shape
+    logits = _forward_local(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    local_sum = jnp.sum(nll)
+    # Only the last pipeline stage holds real outputs (pipeline_apply emits
+    # zeros elsewhere); mask others out of the loss.
+    is_last = (lax.axis_index("pp") == pp_size - 1).astype(jnp.float32)
+    local_sum = local_sum * is_last
+    n_tokens = (B * S * lax.axis_size("dp") * lax.axis_size("ep")
+                * lax.axis_size("sp"))
+    # NOTE: this is the LOCAL contribution to the global mean loss — it is
+    # deliberately NOT psum'd here. The transpose of psum multiplies
+    # cotangents by the axis size, so differentiating a psum'd loss per-rank
+    # then psum-ing gradients again would overcount by ∏ axis sizes.
+    # build_loss_and_grads psums gradients (and the reported loss value)
+    # explicitly instead.
+    #
+    # The tp axis computes this loss redundantly on every member. Reverse AD
+    # differentiates the implicit sum of per-rank losses, which (a) leaves
+    # gradients of REPLICATED leaves exact — each rank only differentiates
+    # its own copy's paths, and the tp-peer contributions arriving through
+    # the psum transposes complete the chain rule — but (b) overcounts
+    # gradients of tp-SHARDED leaves by tp, since a shard feeds every
+    # redundant loss copy. build_loss_and_grads rescales the sharded leaves.
+    return local_sum / n_tokens
+
+
+def psum_axes(x, axes):
+    for a in axes:
+        x = lax.psum(x, a)
+    return x
+
+
+def build_loss_and_grads(cfg: TransformerConfig, mesh: Mesh):
+    """shard_map'd (params, tokens, targets) -> (loss, grads) with gradient
+    psums compiled in. The multi-axis generalisation of
+    optim/optimizer.py:reduce_gradients_in_jit."""
+    specs = param_specs(cfg)
+    raxes = grad_reduce_axes(cfg)
+    bspec = P(("dp", "ep"), "sp")
+
+    def fn(params, tokens, targets):
+        local_mean, grads = jax.value_and_grad(
+            lambda p: _local_loss(p, tokens, targets, cfg))(params)
+        tp_size = lax.axis_size("tp")
+        # See grad_reduce_axes: /tp everywhere (redundant loss copies), psum
+        # per-leaf axes (includes 'tp' for replicated-over-tp leaves).
+        grads = jax.tree_util.tree_map(
+            lambda g, ax: psum_axes(g / tp_size, ax), grads, raxes)
+        loss = psum_axes(local_mean, ("dp", "ep", "sp", "pp"))
+        return loss, grads
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=(specs, bspec, bspec),
+                         out_specs=(P(), specs), check_vma=False)
+
+
+def build_forward(cfg: TransformerConfig, mesh: Mesh):
+    """Jittable (params, tokens) -> logits over the mesh (inference path)."""
+    specs = param_specs(cfg)
+    bspec = P(("dp", "ep"), "sp")
+
+    def fn(params, tokens):
+        logits = _forward_local(params, tokens, cfg)
+        # With pp > 1 only the last stage holds real logits (zeros
+        # elsewhere); psum over pp collapses them to the real values.
+        return lax.psum(logits, "pp")
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=(specs, bspec),
+                         out_specs=P(("dp", "ep"), "sp", None),
+                         check_vma=False)
+
+
+def build_train_step(cfg: TransformerConfig, mesh: Mesh,
+                     optimizer: optax.GradientTransformation):
+    """Full jitted train step over the mesh. Forward/backward/gradient
+    collectives run inside shard_map; the optax update runs under GSPMD,
+    which propagates param shardings through the elementwise update."""
+    lg = build_loss_and_grads(cfg, mesh)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, targets):
+        loss, grads = lg(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def shard_params(params, cfg: TransformerConfig, mesh: Mesh):
+    """Place a global param pytree onto the mesh per param_specs."""
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def validate_cfg_for_mesh(cfg: TransformerConfig, mesh: Mesh) -> None:
+    ax = mesh_axis_sizes(mesh)
+    checks = [
+        (cfg.n_layers % (ax["pp"],)[0] == 0, "n_layers % pp"),
+        (cfg.n_heads % ax["tp"] == 0, "n_heads % tp"),
+        (cfg.d_ff % ax["tp"] == 0, "d_ff % tp"),
+        (cfg.num_experts % ax["ep"] == 0 if cfg.num_experts else True,
+         "num_experts % ep"),
+        # pp > 1 REQUIRES the microbatch pipeline: without it stages never
+        # exchange activations and each stage silently trains only its own
+        # layer slice on raw embeddings.
+        (ax["pp"] == 1 or cfg.microbatches > 1,
+         "pp > 1 requires microbatches > 1"),
+    ]
+    if cfg.attn == "ulysses":
+        checks.append((cfg.n_heads // ax["tp"] % ax["sp"] == 0,
+                       "heads/tp % sp for ulysses"))
+    for ok, what in checks:
+        if not ok:
+            raise HorovodTpuError(f"config/mesh mismatch: {what}")

@@ -106,6 +106,10 @@ def _resolve_ps(process_set: Optional[ProcessSet]) -> ProcessSet:
     return ps
 
 
+def pidx_of() -> int:
+    return jax.process_index()
+
+
 def _local_member_count(ps: ProcessSet) -> int:
     """How many of this process's devices are in the set."""
     pidx = jax.process_index()
@@ -143,10 +147,15 @@ def _to_global(x: Any, ps: ProcessSet) -> Tuple[jax.Array, bool]:
         local = jnp.broadcast_to(arr[None], (max(L, 1),) + arr.shape)
     if jax.process_count() == 1:
         return jax.device_put(local, sharding), stacked
+    # Multi-process: assemble the global array from per-slot ON-DEVICE
+    # shards — no device→host→device round trip on the hot path.
     k = ps.size()
     global_shape = (k,) + tuple(local.shape[1:])
-    return jax.make_array_from_process_local_data(
-        sharding, np.asarray(local), global_shape), stacked
+    my_devs = [d for d in mesh.devices.flat if d.process_index == pidx_of()]
+    shards = [jax.device_put(local[i:i + 1], d)
+              for i, d in enumerate(my_devs)]
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, shards), stacked
 
 
 def _from_global(y: jax.Array, stacked: bool) -> jax.Array:
@@ -230,7 +239,7 @@ def allreduce(tensor: Any,
     rop = _normalize_op(average, op)
     g, stacked = _to_global(tensor, ps)
     k = ps.size()
-    key = ("ar", g.shape, str(g.dtype), int(rop), ps.process_set_id,
+    key = ("ar", g.shape, str(g.dtype), int(rop), ps.cache_token,
            float(prescale_factor), float(postscale_factor), bool(donate))
     fn = _cache.get_or_build(key, lambda: _builder_allreduce(
         ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
@@ -258,7 +267,7 @@ def grouped_allreduce(tensors: Sequence[Any],
     gs, stackeds = zip(*[_to_global(t, ps) for t in tensors])
     k = ps.size()
     key = ("gar", tuple((g.shape, str(g.dtype)) for g in gs), int(rop),
-           ps.process_set_id, float(prescale_factor), float(postscale_factor),
+           ps.cache_token, float(prescale_factor), float(postscale_factor),
            topology.state().config.fusion_threshold_bytes,
            topology.state().config.disable_group_fusion)
     cfg = topology.state().config
@@ -299,7 +308,7 @@ def broadcast(tensor: Any, root_rank: int,
     g, stacked = _to_global(tensor, ps)
     root = ps.rank_index(root_rank)
     k = ps.size()
-    key = ("bc", g.shape, str(g.dtype), root, ps.process_set_id)
+    key = ("bc", g.shape, str(g.dtype), root, ps.cache_token)
 
     def build() -> Callable:
         def body(block):
@@ -335,7 +344,7 @@ def allgather(tensor: Any, name: Optional[str] = None,
     else:
         sizes = _exchange_sizes(int(g.shape[1]), ps)
     max_d0 = max(sizes) if sizes else 0
-    key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.process_set_id)
+    key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.cache_token)
 
     def build() -> Callable:
         total = sum(sizes)
@@ -359,28 +368,16 @@ def allgather(tensor: Any, name: Optional[str] = None,
 
     if len(set(sizes)) > 1 and not stacked:
         # Uneven: each rank pads its own tensor to max_d0 before the shared
-        # program runs (shapes must agree across the SPMD program).
+        # program runs (shapes must agree across the SPMD program). After
+        # the pre-pad, `build`'s in-program pad is a no-op and the cache key
+        # (which includes the padded shape + per-rank sizes) distinguishes
+        # this case — the same builder serves both paths.
         pad = max_d0 - (g.shape[1])
         if pad > 0:
             g = jnp.concatenate(
                 [g, jnp.zeros((g.shape[0], pad) + g.shape[2:], g.dtype)], axis=1)
-        key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.process_set_id)
-
-        def build_uneven() -> Callable:
-            def body(block):
-                x = block[0]
-                gathered = lax.all_gather(x, _AXIS, axis=0)
-                pieces = [lax.slice_in_dim(gathered[i], 0, sizes[i], axis=0)
-                          for i in range(k)]
-                return jnp.concatenate(pieces, axis=0)[None]
-
-            fn = jax.shard_map(body, mesh=ps.mesh, in_specs=P(_AXIS),
-                               out_specs=P(_AXIS), check_vma=False)
-            return jax.jit(fn)
-
-        fn = _cache.get_or_build(key, build_uneven)
-    else:
-        fn = _cache.get_or_build(key, build)
+        key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.cache_token)
+    fn = _cache.get_or_build(key, build)
     _timeline_span(name or "allgather", "ALLGATHER")
     return _from_global(fn(g), stacked)
 
@@ -404,7 +401,7 @@ def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
     k = ps.size()
     d0 = int(g.shape[1])
     even = (d0 % k == 0)
-    key = ("rs", g.shape, str(g.dtype), int(rop), even, ps.process_set_id,
+    key = ("rs", g.shape, str(g.dtype), int(rop), even, ps.cache_token,
            float(prescale_factor), float(postscale_factor))
 
     def build() -> Callable:
@@ -515,7 +512,7 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
     recv_splits = splits_matrix[:, :]  # [src, dst]
     max_chunk = int(splits_matrix.max()) if splits_matrix.size else 0
     key = ("a2a", g.shape, str(g.dtype),
-           tuple(map(tuple, splits_matrix.tolist())), ps.process_set_id)
+           tuple(map(tuple, splits_matrix.tolist())), ps.cache_token)
 
     def build() -> Callable:
         sm = jnp.asarray(splits_matrix)
@@ -564,7 +561,7 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     full-mesh rendezvous; block_until_ready makes it synchronous host-side.
     """
     ps = _resolve_ps(process_set)
-    key = ("barrier", ps.process_set_id)
+    key = ("barrier", ps.cache_token)
 
     def build() -> Callable:
         def body(block):
@@ -638,7 +635,7 @@ def _exchange_sizes(d0: int, ps: ProcessSet) -> Tuple[int, ...]:
 def _exchange_rows(my_row: np.ndarray, ps: ProcessSet) -> np.ndarray:
     """Gather one small int row per rank → (k, len(row)) matrix on host."""
     k = ps.size()
-    key = ("xrow", my_row.shape, ps.process_set_id)
+    key = ("xrow", my_row.shape, ps.cache_token)
 
     def build() -> Callable:
         def body(block):

@@ -56,7 +56,9 @@ def broadcast_object(obj: Any, root_rank: int = 0,
     """
     del name
     ps = process_set or global_process_set
-    if topology.rank() == root_rank or jax.process_count() == 1:
+    # Root check must cover every device slot this process owns (a root
+    # rank can be a non-first slot of a multi-device process).
+    if root_rank in topology.local_slot_ranks() or jax.process_count() == 1:
         payload = pickle.dumps(obj)
         buf = np.frombuffer(payload, dtype=np.uint8)
     else:

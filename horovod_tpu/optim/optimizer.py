@@ -255,7 +255,12 @@ def build_train_step(loss_fn: Callable,
     Returns step(params, opt_state, batch) -> (params, opt_state, loss).
     """
     m = mesh if mesh is not None else topology.mesh()
-    k = int(np.prod([m.shape[a] for a in m.axis_names]))
+    if _AXIS not in m.axis_names:
+        raise HorovodTpuError(
+            f"build_train_step requires a mesh with axis '{_AXIS}'")
+    # Averaging divisor = the size of the axis actually psum'd over — NOT
+    # the whole mesh (a multi-axis mesh would silently scale gradients).
+    k = int(m.shape[_AXIS])
     bspec = batch_spec if batch_spec is not None else P(_AXIS)
 
     def local_step(params, opt_state, batch):

@@ -1,0 +1,18 @@
+"""Parallelism strategies over the TPU device mesh.
+
+The reference is pure data-parallel (SURVEY.md §2.6); its only substrate for
+other strategies is `alltoall` + process sets. Here TP/PP/SP(ring)/EP are
+first-class, built on `jax.sharding.Mesh` axes + XLA collectives over
+ICI/DCN — the TPU-native generalisation of Horovod's process-set sub-
+communicators (reference: horovod/common/process_set.h).
+"""
+
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec, build_mesh, mesh_axis_sizes,
+)
+from horovod_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention, blockwise_attention_reference,
+)
+from horovod_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from horovod_tpu.parallel.moe import moe_ffn  # noqa: F401
+from horovod_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,82 @@
+"""Multi-axis device mesh construction.
+
+Replaces the reference's flat rank space + process sets
+(horovod/common/process_set.cc) with a named-axis `jax.sharding.Mesh`:
+
+  dp — data parallel (gradient psum; Horovod's whole purpose)
+  pp — pipeline stages (ppermute ring between stages)
+  tp — tensor parallel (sharded matmuls, psum on row-parallel outputs)
+  sp — sequence/context parallel (ring attention over this axis)
+  ep — expert parallel (all_to_all token dispatch)
+
+Axis ordering puts dp outermost so that, on a real pod, dp rides DCN across
+slices while tp/sp (the latency-sensitive axes) stay on ICI — mirroring the
+reference's hierarchical allreduce split (nccl_operations.cc:308: NCCL
+within node, MPI across).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+
+# Canonical axis order: latency-tolerant axes first (outermost / DCN),
+# latency-sensitive last (innermost / ICI neighbours).
+AXIS_ORDER: Tuple[str, ...] = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes per named parallelism axis; 1 = axis unused (but still present
+    so the same compiled program works at any configuration)."""
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    @property
+    def total(self) -> int:
+        return int(math.prod(self.sizes()))
+
+    @staticmethod
+    def infer(n_devices: int, tp: int = 1, sp: int = 1, pp: int = 1,
+              ep: int = 1) -> "MeshSpec":
+        """Fix the model axes; give every remaining device to dp."""
+        inner = tp * sp * pp * ep
+        if n_devices % inner:
+            raise HorovodTpuError(
+                f"n_devices={n_devices} not divisible by tp*sp*pp*ep={inner}")
+        return MeshSpec(dp=n_devices // inner, pp=pp, ep=ep, sp=sp, tp=tp)
+
+
+def build_mesh(spec: MeshSpec,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with all five named axes from a flat device list.
+
+    Device order follows the same canonical (process_index, id) sort as the
+    global topology (core/topology.py:_canonical_devices) so innermost axes
+    land on devices that are ICI neighbours on real hardware.
+    """
+    devs = list(devices) if devices is not None else sorted(
+        jax.devices(), key=lambda d: (d.process_index, d.id))
+    if spec.total != len(devs):
+        raise HorovodTpuError(
+            f"mesh spec {spec.sizes()} needs {spec.total} devices, "
+            f"got {len(devs)}")
+    arr = np.asarray(devs).reshape(spec.sizes())
+    return Mesh(arr, AXIS_ORDER)
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

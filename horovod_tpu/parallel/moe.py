@@ -1,0 +1,72 @@
+"""Expert parallelism: top-1 routed mixture-of-experts FFN over the `ep` axis.
+
+Not present in the reference (SURVEY.md §2.6 — `alltoall` is the substrate
+it exposes for users to build this). TPU-native design: experts are sharded
+one-group-per-rank over `ep`; tokens are dispatched with a capacity-bounded
+one-hot einsum + `lax.all_to_all` (compiled onto ICI), processed by the
+local experts' batched matmuls (MXU-friendly: one big einsum over
+[experts_local, capacity, d]), and combined back with the transposed
+all_to_all. Static shapes throughout — capacity bounds make the program
+shape-stable for XLA, with overflow tokens dropped (standard Switch-style
+routing).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_ffn(x: jax.Array,
+            router_w: jax.Array,
+            w1: jax.Array,
+            w2: jax.Array,
+            axis_name: str = "ep",
+            capacity_factor: float = 1.25) -> jax.Array:
+    """Top-1 MoE feed-forward.
+
+    Per-shard shapes:
+      x: (T, D) local tokens (flatten batch*seq before calling)
+      router_w: (D, E) with E = total experts across the axis
+      w1: (E_local, D, F), w2: (E_local, F, D) — this rank's experts
+    Returns (T, D).
+    """
+    P = lax.axis_size(axis_name)
+    T, D = x.shape
+    E_local = w1.shape[0]
+    E = E_local * P
+    assert router_w.shape[1] == E, "router width must equal total experts"
+
+    logits = x @ router_w                       # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)         # (T,)
+    gate = jnp.max(probs, axis=-1)              # (T,)
+
+    cap = max(1, int(capacity_factor * T / E))
+    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)          # (T, E)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot          # slot per token
+    keep = (pos < cap) & (onehot > 0)
+    slot = jnp.clip(pos.astype(jnp.int32), 0, cap - 1)
+    # dispatch[t, e, c] = 1 iff token t goes to expert e at slot c.
+    dispatch = keep.astype(x.dtype)[:, :, None] * \
+        jax.nn.one_hot(slot, cap, dtype=x.dtype)               # (T, E, cap)
+
+    xs = jnp.einsum("td,tec->ecd", x, dispatch)                # (E, cap, D)
+    # Re-shard: chunk e∈[p*E_local,(p+1)*E_local) goes to rank p; received
+    # slabs (one per source rank) stack along capacity → (E_local, P*cap, D)
+    # where capacity segment s holds rank s's tokens.
+    xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=1,
+                        tiled=True)
+
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xs, w1))
+    ys = jnp.einsum("ecf,efd->ecd", h, w2)                     # (E_local, P*cap, D)
+
+    # Inverse re-shard: capacity segment s returns to rank s; received
+    # expert groups stack along axis 0 in rank (= global expert) order.
+    ys = lax.all_to_all(ys, axis_name, split_axis=1, concat_axis=0,
+                        tiled=True)                            # (E, cap, D)
+    out = jnp.einsum("tec,ecd->td", dispatch, ys)
+    return out * gate[:, None]

@@ -1,0 +1,51 @@
+"""Launcher package (reference: horovod/runner/ — horovodrun CLI, gloo/mpi
+drivers, elastic driver, interactive run API).
+
+`horovod_tpu.runner.run` is the interactive API (reference:
+horovod.run, runner/__init__.py:95): launch `fn` on np workers and return
+the per-rank results, shipped back through the rendezvous KV store
+(reference: launch.py:663-686 task-result plumbing).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import pickle
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from horovod_tpu.runner.launch import launch_static, run_commandline  # noqa: F401
+
+
+def run(fn: Callable[[], Any], np: int = 1,
+        hosts: Optional[str] = None,
+        extra_env: Optional[dict] = None,
+        use_current_interpreter: bool = True) -> List[Any]:
+    """Run `fn` on np worker processes; return [fn() result per rank].
+
+    Reference: horovod.run (runner/__init__.py:95). The function is pickled
+    to a spool file; each worker executes it under an initialized framework
+    and PUTs its pickled result into the launcher's KV store.
+    """
+    import cloudpickle  # vendored with torch; fall back to pickle
+
+    payload = cloudpickle.dumps(fn)
+    with tempfile.NamedTemporaryFile("wb", suffix=".pkl",
+                                     delete=False) as f:
+        f.write(payload)
+        fn_path = f.name
+    out_dir = tempfile.mkdtemp(prefix="hvd_tpu_results_")
+    env = dict(extra_env or {})
+    env["HOROVOD_RUN_FUNC_FILE"] = fn_path
+    env["HOROVOD_RUN_RESULT_DIR"] = out_dir
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.task_runner"]
+    rc = launch_static(np, hosts or f"localhost:{np}", cmd, env)
+    if rc != 0:
+        raise RuntimeError(f"interactive run failed with exit code {rc}")
+    results = []
+    for rank in range(np):
+        with open(os.path.join(out_dir, f"rank_{rank}.pkl"), "rb") as f:
+            results.append(pickle.load(f))
+    return results

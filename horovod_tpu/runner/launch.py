@@ -1,0 +1,207 @@
+"""`horovodrun`-equivalent CLI launcher.
+
+Reference: horovod/runner/launch.py (arg parsing :286-595, _run_static :596,
+run_controller :747) + horovod/runner/gloo_run.py (per-slot process spawn
+with injected env :69-75,205-208) + runner/common/util/config_parser.py
+(flag → HOROVOD_* env mapping).
+
+TPU redesign: there is no mpirun/gloo controller choice — workers always
+bootstrap through `jax.distributed.initialize` against the launcher's
+rendezvous (the role of the Gloo HTTP KV store), and collectives are XLA
+programs. The launcher's job is slot allocation, env injection, process
+supervision, and (elastic mode) driving re-rendezvous.
+
+Usage:
+  python -m horovod_tpu.runner.launch -np 4 python train.py
+  python -m horovod_tpu.runner.launch -np 8 -H h1:4,h2:4 python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+from typing import Dict, List, Optional
+
+from horovod_tpu.common import config as C
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner import safe_exec
+from horovod_tpu.runner.rendezvous import RendezvousServer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="horovodrun-tpu",
+        description="Launch distributed TPU training "
+                    "(reference CLI: horovodrun, runner/launch.py:286)")
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="number of worker processes (one per chip)")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='host slots, e.g. "h1:4,h2:4" (default: localhost)')
+    p.add_argument("--network-interface", default=None,
+                   help="NIC for the coordinator address")
+    p.add_argument("--start-timeout", type=int, default=600)
+    p.add_argument("--disable-cache", action="store_true",
+                   help="disable the compiled-collective cache")
+    p.add_argument("--fusion-threshold-mb", type=int, default=None,
+                   help="gradient fusion bucket size "
+                        "(reference: HOROVOD_FUSION_THRESHOLD)")
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None,
+                   help="Chrome-trace timeline path "
+                        "(reference: HOROVOD_TIMELINE)")
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--log-level", default=None,
+                   choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "FATAL"])
+    p.add_argument("--verbose", action="store_true")
+    # Elastic (reference: launch.py:689 _run_elastic)
+    p.add_argument("--host-discovery-script", default=None,
+                   help="elastic mode: script printing 'host:slots' lines")
+    p.add_argument("--min-num-proc", type=int, default=None)
+    p.add_argument("--max-num-proc", type=int, default=None)
+    p.add_argument("--slots-per-host", type=int, default=None)
+    p.add_argument("--elastic-timeout", type=int, default=600)
+    p.add_argument("--reset-limit", type=int, default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    return p
+
+
+def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
+    """Flag → HOROVOD_* env (reference: config_parser.set_env_from_args)."""
+    env: Dict[str, str] = {}
+    if args.fusion_threshold_mb is not None:
+        env[C.HOROVOD_FUSION_THRESHOLD] = str(
+            args.fusion_threshold_mb * 1024 * 1024)
+    if args.cycle_time_ms is not None:
+        env[C.HOROVOD_CYCLE_TIME] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env[C.HOROVOD_CACHE_CAPACITY] = str(args.cache_capacity)
+    if args.disable_cache:
+        env[C.HOROVOD_CACHE_CAPACITY] = "0"
+    if args.timeline_filename:
+        env[C.HOROVOD_TIMELINE] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env[C.HOROVOD_TIMELINE_MARK_CYCLES] = "1"
+    if args.autotune:
+        env[C.HOROVOD_AUTOTUNE] = "1"
+    if args.autotune_log_file:
+        env[C.HOROVOD_AUTOTUNE_LOG] = args.autotune_log_file
+    if args.log_level:
+        env[C.HOROVOD_LOG_LEVEL] = args.log_level
+    return env
+
+
+def _local_ip(interface: Optional[str] = None) -> str:
+    if interface:
+        try:
+            import fcntl
+            import struct
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            return socket.inet_ntoa(fcntl.ioctl(
+                s.fileno(), 0x8915,  # SIOCGIFADDR
+                struct.pack("256s", interface[:15].encode()))[20:24])
+        except OSError:
+            pass
+    return socket.gethostbyname(socket.gethostname())
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname(),
+                        socket.getfqdn())
+
+
+def make_worker_cmd(slot: hosts_mod.SlotInfo, command: List[str],
+                    base_env: Dict[str, str]) -> (List[str], Dict[str, str]):
+    env = dict(os.environ)
+    env.update(base_env)
+    env.update(slot.to_env())
+    if _is_local(slot.hostname):
+        return list(command), env
+    # Remote: ssh with env inlined (reference: gloo_run.py get_remote_command).
+    env_str = " ".join(f"{k}={v}" for k, v in {**base_env,
+                                               **slot.to_env()}.items())
+    remote = f"cd {os.getcwd()} && env {env_str} " + " ".join(command)
+    return ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote], \
+        dict(os.environ)
+
+
+def launch_static(np: int, host_spec: str, command: List[str],
+                  extra_env: Dict[str, str],
+                  coordinator_ip: Optional[str] = None,
+                  stdout=None) -> int:
+    """Spawn one worker per slot, wait, propagate failure (reference:
+    launch.py _run_static + gloo_run.launch_gloo)."""
+    host_list = hosts_mod.parse_hosts(host_spec)
+    slots = hosts_mod.get_host_assignments(host_list, np)
+
+    rdv = RendezvousServer()
+    rdv_port = rdv.start()
+    ip = coordinator_ip or _local_ip()
+    coord_port = _free_port()
+
+    base_env = dict(extra_env)
+    base_env.update({
+        C.HOROVOD_RENDEZVOUS_ADDR: ip,
+        C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
+        "HOROVOD_COORDINATOR_ADDR": f"{ip}:{coord_port}",
+        C.HOROVOD_CONTROLLER: "tpu",
+    })
+
+    workers = []
+    try:
+        for slot in slots:
+            cmd, env = make_worker_cmd(slot, command, base_env)
+            workers.append(safe_exec.WorkerProcess(
+                slot.rank, cmd, env, stdout=stdout))
+        codes = safe_exec.wait_all(workers)
+    finally:
+        for w in workers:
+            w.terminate()
+        rdv.stop()
+    bad = [(i, c) for i, c in enumerate(codes) if c != 0]
+    if bad:
+        print(f"horovodrun-tpu: workers failed: {bad}", file=sys.stderr)
+        return bad[0][1] or 1
+    return 0
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("no training command given", file=sys.stderr)
+        return 2
+
+    if args.host_discovery_script:
+        from horovod_tpu.elastic.driver import run_elastic
+        return run_elastic(args, command, args_to_env(args))
+
+    np = args.num_proc
+    hosts = args.hosts or f"localhost:{np or 1}"
+    if np is None:
+        np = sum(h.slots for h in hosts_mod.parse_hosts(hosts))
+    return launch_static(np, hosts, command, args_to_env(args),
+                         coordinator_ip=None)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""HTTP key-value rendezvous server.
+
+Reference: horovod/runner/http/http_server.py (KVStoreServer /
+RendezvousServer) — the store C++ Gloo bootstraps against
+(common/gloo/http_store.cc). Here it bootstraps `jax.distributed` workers
+and serves the elastic driver's scopes (rank_and_size / worker_addresses,
+reference runner/elastic/rendezvous.py:22-45).
+
+Protocol (same shape as the reference):
+  PUT  /<scope>/<key>   body = value bytes
+  GET  /<scope>/<key>   200 + bytes | 404
+  DELETE /<scope>/<key>
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    store: Dict[str, bytes] = {}
+    lock = threading.Lock()
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _key(self) -> str:
+        return self.path.lstrip("/")
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        with self.lock:
+            self.store[self._key()] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        with self.lock:
+            val = self.store.get(self._key())
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_DELETE(self):
+        with self.lock:
+            self.store.pop(self._key(), None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class RendezvousServer:
+    """Threaded KV store (reference: RendezvousServer, http_server.py:259)."""
+
+    def __init__(self, port: int = 0):
+        handler = type("Handler", (_KVHandler,),
+                       {"store": {}, "lock": threading.Lock()})
+        self._handler = handler
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        with self._handler.lock:
+            self._handler.store[f"{scope}/{key}"] = value
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._handler.lock:
+            return self._handler.store.get(f"{scope}/{key}")
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class KVClient:
+    """Worker-side client (reference: http_client.py read_data_from_kvstore)."""
+
+    def __init__(self, addr: str, port: int):
+        self.base = f"http://{addr}:{port}"
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        import urllib.request
+        req = urllib.request.Request(f"{self.base}/{scope}/{key}",
+                                     data=value, method="PUT")
+        urllib.request.urlopen(req, timeout=30).read()
+
+    def get(self, scope: str, key: str,
+            timeout: float = 30.0) -> Optional[bytes]:
+        import time
+        import urllib.error
+        import urllib.request
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return urllib.request.urlopen(
+                    f"{self.base}/{scope}/{key}", timeout=10).read()
+            except urllib.error.HTTPError as e:
+                if e.code != 404 or time.monotonic() > deadline:
+                    if e.code == 404:
+                        return None
+                    raise
+                time.sleep(0.05)

@@ -1,0 +1,87 @@
+"""Worker process execution with streamed, rank-prefixed output.
+
+Reference: horovod/common/util/safe_shell_exec.py — fork/exec with streamed
+stdout/stderr, index-prefixed lines, and termination of the whole tree on
+failure.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class WorkerProcess:
+    def __init__(self, index: int, cmd: List[str], env: Dict[str, str],
+                 prefix_output: bool = True,
+                 stdout=None):
+        self.index = index
+        self.cmd = cmd
+        self._stdout = stdout or sys.stdout
+        self._prefix = prefix_output
+        self.proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, bufsize=1,
+            start_new_session=True)  # own process group for tree-kill
+        self._pump = threading.Thread(target=self._pump_output, daemon=True)
+        self._pump.start()
+
+    def _pump_output(self):
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            if self._prefix:
+                self._stdout.write(f"[{self.index}]<stdout>: {line}")
+            else:
+                self._stdout.write(line)
+            self._stdout.flush()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        rc = self.proc.wait(timeout=timeout)
+        self._pump.join(timeout=5)
+        return rc
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def terminate(self, grace: float = 5.0) -> None:
+        """SIGTERM the process group, SIGKILL after grace (reference:
+        safe_shell_exec terminate tree)."""
+        if self.proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.1)
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def wait_all(workers: List[WorkerProcess],
+             kill_on_failure: bool = True) -> List[int]:
+    """Wait for all workers; on any non-zero exit, terminate the rest
+    (reference: gloo_run.py behavior — one failure kills the job)."""
+    codes: List[Optional[int]] = [None] * len(workers)
+    while any(c is None for c in codes):
+        for i, w in enumerate(workers):
+            if codes[i] is None:
+                rc = w.poll()
+                if rc is not None:
+                    codes[i] = rc
+                    if rc != 0 and kill_on_failure:
+                        for j, other in enumerate(workers):
+                            if j != i and codes[j] is None:
+                                other.terminate()
+        time.sleep(0.1)
+    return [c for c in codes if c is not None]
