@@ -1,0 +1,57 @@
+"""Elastic MNIST: fault-tolerant training with commit/restore.
+
+Mirrors the reference's elastic examples (examples/elastic/pytorch/
+pytorch_mnist_elastic.py): wrap training in @hvd.elastic.run with a state
+object committed every few batches; on worker failure the state rolls back,
+on host changes training continues with the new world.
+
+Run under the elastic launcher:
+  python -m horovod_tpu.runner.launch --host-discovery-script ./discover.sh \
+      --min-num-proc 1 -- python examples/elastic_mnist.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import JaxState
+from horovod_tpu.elastic import run as elastic_run
+from horovod_tpu.models import mlp
+
+
+def main():
+    hvd.init()
+    params = mlp.init(jax.random.PRNGKey(0))
+    opt = optax.adam(1e-3)
+    hvd_opt = hvd.DistributedOptimizer(opt)
+    state = JaxState(params=params, opt_state=hvd_opt.init(params),
+                     epoch=0, batch=0)
+
+    rng = np.random.default_rng(hvd.rank())
+    grad_fn = jax.jit(jax.value_and_grad(mlp.loss_fn))
+
+    @elastic_run
+    def train(state):
+        while state.epoch < 3:
+            for b in range(state.batch, 20):
+                x = jnp.asarray(rng.standard_normal((32, 784), np.float32))
+                y = jnp.asarray(rng.integers(0, 10, (32,)))
+                loss, grads = grad_fn(state.params, (x, y))
+                state.params, state.opt_state = hvd_opt.step(
+                    grads, state.params, state.opt_state)
+                state.batch = b
+                if b % 5 == 0:
+                    state.commit()
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch} done, loss {float(loss):.4f}")
+            state.batch = 0
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""ResNet-50 synthetic benchmark.
+
+Mirrors examples/pytorch/pytorch_synthetic_benchmark.py /
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py from the reference:
+random data, fixed image shape, prints images/sec per iteration batch.
+
+Run:  python examples/synthetic_benchmark.py --batch-size 32 --num-iters 5
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.core import topology
+from horovod_tpu.models import resnet
+from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "resnet101", "resnet152"])
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-rank batch size")
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    mesh = topology.mesh()
+    k = hvd.size()
+    depth = int(args.model.replace("resnet", ""))
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+    params, stats = resnet.init(jax.random.PRNGKey(0), depth=depth,
+                                dtype=dtype)
+    opt = optax.sgd(0.01 * k, momentum=0.9)
+    opt_state = opt.init(params)
+
+    from horovod_tpu.ops.compression import Compression
+    compression = Compression.fp16 if args.fp16_allreduce else \
+        Compression.none
+
+    def local_step(params, stats, opt_state, batch):
+        def loss(p):
+            return resnet.loss_fn(p, stats, batch, depth=depth, train=True,
+                                  axis_name="hvd")
+        (l, ns), g = jax.value_and_grad(loss, has_aux=True)(params)
+        g = reduce_gradients_in_jit(g, num_ranks=k, compression=compression)
+        updates, opt_state = opt.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, ns, opt_state, lax.pmean(l, "hvd")
+
+    step = jax.jit(
+        jax.shard_map(local_step, mesh=mesh,
+                      in_specs=(P(), P(), P(), P("hvd")),
+                      out_specs=(P(), P(), P(), P()), check_vma=False),
+        donate_argnums=(0, 1, 2))
+
+    rng = np.random.default_rng(0)
+    n = args.batch_size * k
+    data = (
+        jax.device_put(rng.standard_normal(
+            (n, args.image_size, args.image_size, 3),
+            np.float32).astype(dtype), NamedSharding(mesh, P("hvd"))),
+        jax.device_put(rng.integers(0, 1000, (n,)),
+                       NamedSharding(mesh, P("hvd"))),
+    )
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch {args.batch_size}/rank, "
+              f"{k} rank(s), dtype {args.dtype}")
+
+    for _ in range(args.num_warmup_batches):
+        params, stats, opt_state, l = step(params, stats, opt_state, data)
+    float(l)
+
+    img_secs = []
+    for it in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, stats, opt_state, l = step(params, stats, opt_state,
+                                               data)
+        float(l)  # host readback forces completion
+        dt = time.perf_counter() - t0
+        ips = n * args.num_batches_per_iter / dt
+        img_secs.append(ips)
+        if hvd.rank() == 0:
+            print(f"Iter #{it}: {ips:.1f} img/sec total")
+    if hvd.rank() == 0:
+        print(f"Img/sec per rank: {np.mean(img_secs) / k:.1f} "
+              f"+- {1.96 * np.std(img_secs) / k:.1f}")
+
+
+if __name__ == "__main__":
+    main()

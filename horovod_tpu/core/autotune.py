@@ -1,0 +1,253 @@
+"""Autotuning: online search over performance knobs.
+
+Reference: horovod/common/parameter_manager.cc (544 LoC) + optim/
+bayesian_optimization.cc + gaussian_process.cc — rank 0 scores each sample
+window in bytes/sec, proposes the next knob setting by GP + expected
+improvement, broadcasts it, and freezes the best after
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES.
+
+TPU redesign: the tunables that survive are trace-time knobs — the fusion
+bucket threshold (drives how many psums a grouped reduce compiles to) and
+buffer donation. Cycle time and hierarchical flags have no meaning when
+collectives are compiled. Changing the threshold recompiles (cache miss),
+so the tuner holds each sample longer than the reference's per-cycle
+cadence; scores are steady-state bytes/sec within a sample window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# Gaussian process regression (reference: common/optim/gaussian_process.cc —
+# RBF kernel + cholesky solve; Eigen there, numpy here).
+# --------------------------------------------------------------------------
+
+class GaussianProcess:
+    def __init__(self, length_scale: float = 1.0, noise: float = 0.8,
+                 sigma_f: float = 1.0):
+        self.l = length_scale
+        self.noise = noise
+        self.sigma_f = sigma_f
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._L: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.sigma_f ** 2 * np.exp(-0.5 * d2 / self.l ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        self._x = np.atleast_2d(x)
+        self._y = np.asarray(y, np.float64)
+        k = self._kernel(self._x, self._x) + \
+            self.noise ** 2 * np.eye(len(self._x))
+        self._L = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, self._y))
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.atleast_2d(x)
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._alpha
+        v = np.linalg.solve(self._L, ks.T)
+        var = np.clip(self.sigma_f ** 2 - (v ** 2).sum(0), 1e-12, None)
+        return mu, np.sqrt(var)
+
+
+class BayesianOptimization:
+    """EI acquisition over [0,1]^d (reference:
+    bayesian_optimization.cc NextSample)."""
+
+    def __init__(self, dims: int, noise: float = 0.8, seed: int = 0):
+        self.dims = dims
+        self.gp = GaussianProcess(length_scale=0.3, noise=noise)
+        self._rng = np.random.default_rng(seed)
+        self.xs: List[np.ndarray] = []
+        self.ys: List[float] = []
+
+    def register(self, x: np.ndarray, y: float) -> None:
+        self.xs.append(np.asarray(x, np.float64))
+        self.ys.append(float(y))
+
+    def next_sample(self) -> np.ndarray:
+        if len(self.xs) < 2:
+            return self._rng.uniform(size=self.dims)
+        # Standardize scores before fitting: raw bytes/sec is ~1e9 while the
+        # GP prior has sigma_f=1 — unnormalized, EI underflows to all-zeros
+        # and the search degenerates to uniform random (the reference scales
+        # scores for the same reason).
+        ys = np.asarray(self.ys, np.float64)
+        mu0, sd0 = ys.mean(), ys.std()
+        yn = (ys - mu0) / (sd0 if sd0 > 0 else 1.0)
+        ymax = yn.max()
+        self.gp.fit(np.stack(self.xs), yn)
+        cand = self._rng.uniform(size=(256, self.dims))
+        mu, sd = self.gp.predict(cand)
+        z = (mu - ymax - 0.01) / sd
+        # Expected improvement (standard closed form).
+        from math import erf, sqrt
+        cdf = 0.5 * (1 + np.vectorize(erf)(z / sqrt(2)))
+        pdf = np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+        ei = (mu - ymax - 0.01) * cdf + sd * pdf
+        return cand[int(np.argmax(ei))]
+
+
+# --------------------------------------------------------------------------
+# Parameter manager
+# --------------------------------------------------------------------------
+
+_MB = 1024 * 1024
+_THRESH_LOG2_MIN = math.log2(1 * _MB)
+_THRESH_LOG2_MAX = math.log2(256 * _MB)
+
+
+@dataclasses.dataclass
+class _Sample:
+    x: np.ndarray
+    bytes: float = 0.0
+    seconds: float = 0.0
+    steps: int = 0
+    # Steps to discard before scoring: the first call after a threshold
+    # change pays retrace+recompile, which would bias every new candidate
+    # ~100x worse than the warm incumbent.
+    skip: int = 0
+
+
+class ParameterManager:
+    """Online knob tuner (reference: parameter_manager.h — warmup discard,
+    per-sample scoring, GP proposal, freeze best).
+
+    Drive it from the gradient-reduction hot path:
+        pm.record(total_bytes, seconds)   # per reduction
+        if pm.update():                   # True when knobs changed
+            <invalidate compiled cache>
+    Reads/writes config.fusion_threshold_bytes.
+    """
+
+    def __init__(self, config, process_set=None):
+        self.cfg = config
+        self.enabled = bool(config.autotune)
+        self.warmup_remaining = config.autotune_warmup_samples
+        self.steps_per_sample = config.autotune_steps_per_sample
+        self.max_samples = config.autotune_bayes_opt_max_samples
+        self.bayes = BayesianOptimization(
+            dims=1, noise=config.autotune_gaussian_process_noise)
+        self._current = _Sample(x=self._to_unit(
+            config.fusion_threshold_bytes))
+        self._samples_done = 0
+        self._frozen = False
+        self._log_rows: List[Tuple] = []
+
+    # -- knob encoding ------------------------------------------------------
+    @staticmethod
+    def _to_unit(threshold_bytes: int) -> np.ndarray:
+        u = (math.log2(max(threshold_bytes, 1)) - _THRESH_LOG2_MIN) / \
+            (_THRESH_LOG2_MAX - _THRESH_LOG2_MIN)
+        return np.asarray([min(max(u, 0.0), 1.0)])
+
+    @staticmethod
+    def _from_unit(x: np.ndarray) -> int:
+        log2b = _THRESH_LOG2_MIN + float(x[0]) * \
+            (_THRESH_LOG2_MAX - _THRESH_LOG2_MIN)
+        return int(2 ** log2b)
+
+    # -- hot-path hooks -----------------------------------------------------
+    def record(self, nbytes: float, seconds: float) -> None:
+        if not self.enabled or self._frozen:
+            return
+        s = self._current
+        if s.skip > 0:
+            s.skip -= 1
+            return
+        s.bytes += nbytes
+        s.seconds += seconds
+        s.steps += 1
+
+    def update(self) -> bool:
+        """Advance the tuner; returns True when the threshold changed (the
+        caller must clear its compiled-executable cache).
+
+        Multi-process: rank 0 tunes and the result is broadcast, so every
+        rank applies the SAME threshold — divergent thresholds would bucket
+        gradients differently per rank and deadlock the collectives
+        (reference: SynchronizeParameters, rank 0 tunes + broadcasts).
+        """
+        if not self.enabled or self._frozen:
+            return False
+        s = self._current
+        if s.steps < self.steps_per_sample:
+            return False
+        score = s.bytes / max(s.seconds, 1e-12)  # bytes/sec (reference metric)
+        if self.warmup_remaining > 0:
+            self.warmup_remaining -= 1
+            self._current = _Sample(x=s.x)
+            return False
+        import jax
+
+        if jax.process_count() > 1:
+            new_x, self._frozen = self._coordinate_multiprocess(s.x, score)
+        else:
+            self.bayes.register(s.x, score)
+            self._log_rows.append((self._from_unit(s.x), score))
+            self._samples_done += 1
+            if self._samples_done >= self.max_samples:
+                new_x = self.bayes.xs[int(np.argmax(self.bayes.ys))]
+                self._frozen = True
+            else:
+                new_x = self.bayes.next_sample()
+        changed = self._apply(new_x)
+        self._current = _Sample(x=np.asarray(new_x),
+                                skip=1 if changed else 0)
+        self._maybe_log()
+        return changed
+
+    def _coordinate_multiprocess(self, x: np.ndarray, score: float):
+        """Rank 0 runs the GP on its own timings and broadcasts the
+        decision; other ranks follow."""
+        from horovod_tpu.core import topology
+        from horovod_tpu.optim.functions import broadcast_object
+        if topology.rank() == 0:
+            self.bayes.register(x, score)
+            self._log_rows.append((self._from_unit(x), score))
+            self._samples_done += 1
+            if self._samples_done >= self.max_samples:
+                new_x = self.bayes.xs[int(np.argmax(self.bayes.ys))]
+                frozen = True
+            else:
+                new_x, frozen = self.bayes.next_sample(), False
+            decision = (np.asarray(new_x).tolist(), frozen)
+        else:
+            decision = None
+        new_x_list, frozen = broadcast_object(decision, root_rank=0)
+        return np.asarray(new_x_list), frozen
+
+    def _apply(self, x: np.ndarray) -> bool:
+        new_thresh = self._from_unit(x)
+        changed = new_thresh != self.cfg.fusion_threshold_bytes
+        self.cfg.fusion_threshold_bytes = new_thresh
+        return changed
+
+    def _maybe_log(self) -> None:
+        if self.cfg.autotune_log:
+            try:
+                with open(self.cfg.autotune_log, "a") as f:
+                    th, score = self._log_rows[-1]
+                    f.write(f"{th}\t{score:.3e}\t"
+                            f"{'frozen' if self._frozen else 'tuning'}\n")
+            except OSError:
+                pass
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    def best_threshold(self) -> int:
+        return self.cfg.fusion_threshold_bytes

@@ -90,13 +90,60 @@ def _maybe_distributed_init(cfg: Config) -> None:
     injects HOROVOD_RANK/SIZE and coordinator address; we hand them to
     jax.distributed (the TPU-native control plane over DCN).
     """
-    if cfg.rendezvous_addr and cfg.size is not None and cfg.size > 1:
-        if jax._src.distributed.global_state.client is None:  # not yet initialized
-            jax.distributed.initialize(
-                coordinator_address=f"{cfg.rendezvous_addr}:{cfg.rendezvous_port}",
-                num_processes=cfg.size,
-                process_id=cfg.rank or 0,
-            )
+    if cfg.size is None or cfg.size <= 1:
+        return
+    if jax._src.distributed.global_state.client is not None:
+        return
+    # The jax.distributed coordinator must be BOUND BY RANK 0 on rank 0's
+    # host. An explicit HOROVOD_COORDINATOR_ADDR env wins (single-host
+    # launches); otherwise rank 0 picks a port on its own host and
+    # publishes it through the HTTP KV rendezvous, which works for
+    # multi-host, Spark, and Ray launches where the launcher cannot know
+    # rank 0's address. Keyed per elastic round so resets re-rendezvous.
+    coord = os.environ.get("HOROVOD_COORDINATOR_ADDR", "")
+    if not coord:
+        if not cfg.rendezvous_addr:
+            return  # no rendezvous: single-process mode
+        from horovod_tpu.runner.launch import _free_port, _local_ip
+        from horovod_tpu.runner.rendezvous import KVClient
+        kv = KVClient(cfg.rendezvous_addr, cfg.rendezvous_port)
+        key = f"r{os.environ.get('HOROVOD_ELASTIC_ROUND', '0')}"
+        if (cfg.rank or 0) == 0:
+            coord = f"{_local_ip()}:{_free_port()}"
+            kv.put("jax_coordinator", key, coord.encode())
+        else:
+            data = kv.get("jax_coordinator", key, timeout=300.0)
+            if data is None:
+                raise HorovodTpuError(
+                    "timed out waiting for rank 0 to publish the "
+                    "jax.distributed coordinator address")
+            coord = data.decode()
+    try:  # cross-process CPU collectives need the gloo impl; harmless
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=cfg.size,
+        process_id=cfg.rank or 0,
+    )
+
+
+def _apply_cpu_emulation(n: int) -> None:
+    """HOROVOD_TPU_EMULATE_RANKS=N: emulate an N-chip slice with XLA's
+    host-platform device count (dev/test mode; mirrors how the reference's
+    parallel suites run real collectives over loopback, SURVEY.md §4).
+    Must run before the first JAX backend touch; env vars alone are not
+    enough when a site plugin pins the platform, so jax.config is set too.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 
 def init(process_sets: Optional[Sequence] = None,
@@ -113,6 +160,8 @@ def init(process_sets: Optional[Sequence] = None,
             return
         cfg = Config.from_env()
         _state.config = cfg
+        if cfg.emulate_ranks > 0:
+            _apply_cpu_emulation(cfg.emulate_ranks)
         _maybe_distributed_init(cfg)
 
         devs = list(devices) if devices is not None else _canonical_devices()
@@ -152,12 +201,57 @@ def init(process_sets: Optional[Sequence] = None,
             for ps in process_sets:
                 _state.process_set_table.register(ps)
 
+        if cfg.autotune:
+            from horovod_tpu.core.autotune import ParameterManager
+            _state.parameter_manager = ParameterManager(cfg)
+        if not cfg.stall_check_disable:
+            try:
+                from horovod_tpu import native as native_mod
+                if native_mod.available():
+                    _state.stall_inspector = native_mod.NativeStallInspector(
+                        cfg.stall_warning_seconds,
+                        cfg.stall_shutdown_seconds)
+            except Exception:
+                _state.stall_inspector = None
+
         from horovod_tpu.common.hvd_logging import get_logger
         get_logger().info(
             "horovod_tpu initialized: size=%d local_size=%d processes=%d "
             "platform=%s", _state.size, _state.local_size, pcount,
             devs[0].platform)
         _state.initialized = True
+        # The watcher loop gates on _state.initialized — start it only
+        # after the flag flips or it exits on its first slice.
+        if _state.stall_inspector is not None:
+            _start_stall_watch(_state.stall_inspector, cfg)
+
+
+def _start_stall_watch(si, cfg: Config) -> None:
+    """Background checker that surfaces stalled collectives (reference:
+    CheckForStalledTensors runs in the coordinator's loop; here a watcher
+    thread polls the native inspector)."""
+    import time as _time
+
+    from horovod_tpu.common.hvd_logging import get_logger
+
+    def watch() -> None:
+        while _state.initialized and _state.stall_inspector is si:
+            stalled, shut = si.check()
+            if stalled:
+                get_logger().warning(
+                    "One or more collectives stalled for over %.0fs: %s — "
+                    "some ranks may not have reached them "
+                    "(HOROVOD_STALL_CHECK_TIME_SECONDS)",
+                    cfg.stall_warning_seconds, ", ".join(stalled))
+            if shut:
+                get_logger().error(
+                    "Stall exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; "
+                    "aborting")
+                os._exit(1)
+            _time.sleep(max(cfg.stall_warning_seconds / 2.0, 1.0))
+
+    threading.Thread(target=watch, name="hvd-stall-watch",
+                     daemon=True).start()
 
 
 def shutdown() -> None:

@@ -1,0 +1,148 @@
+"""Data loaders: per-rank sharding + background prefetch.
+
+Reference: horovod/data/data_loader_base.py — `BaseDataLoader` and
+`AsyncDataLoaderMixin` (:48-135, background-thread prefetch queue) — plus
+the ElasticSampler's shard-by-rank semantics (torch/elastic/sampler.py).
+
+TPU notes: the prefetch thread overlaps host-side batch assembly with
+device steps (JAX dispatch is async, so one queue depth of prefetch hides
+most input latency); `ShardedDataset` shards by (rank, size) the way every
+reference example does (`dataset.shard(num_shards=hvd.size(),
+index=hvd.rank())`).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+
+class BaseDataLoader:
+    """Iterable loader contract (reference: data_loader_base.py:20).
+
+    Subclasses may define __len__; the base deliberately does not — a
+    raising __len__ would break list(loader), which probes len() as a
+    preallocation hint.
+    """
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Any]:
+        return self._iterate()
+
+
+class AsyncDataLoaderMixin:
+    """Background-thread prefetch (reference: data_loader_base.py:48).
+
+    Mix in BEFORE the loader class:
+        class MyAsyncLoader(AsyncDataLoaderMixin, MyLoader): ...
+    `async_loader_queue_size=0` disables prefetch (synchronous passthrough).
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 4, **kwargs):
+        self.async_loader_queue_size = async_loader_queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self._closing = False
+        super().__init__(*args, **kwargs)
+
+    def close_async_loader(self) -> None:
+        """Reference: close_async_loader (:73) — drain and join."""
+        self._closing = True
+        if self._queue is not None:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._started = False
+
+    def _async_worker(self) -> None:
+        """Producer thread (reference: _async_worker :95)."""
+        try:
+            for batch in super()._iterate():
+                if self._closing:
+                    break
+                self._queue.put(batch)
+        finally:
+            self._queue.put(None)  # end-of-epoch sentinel
+
+    def _iterate(self) -> Iterator[Any]:
+        if self.async_loader_queue_size <= 0:
+            yield from super()._iterate()
+            return
+        self._queue = queue.Queue(self.async_loader_queue_size)
+        self._closing = False
+        self._thread = threading.Thread(target=self._async_worker,
+                                        daemon=True)
+        self._thread.start()
+        while True:
+            batch = self._queue.get()
+            if batch is None:
+                break
+            yield batch
+        self._thread.join(timeout=10)
+        self._thread = None
+
+
+class ShardedDataset(BaseDataLoader):
+    """Shard an indexable dataset by rank (reference pattern:
+    torch DistributedSampler / elastic sampler shard semantics —
+    torch/elastic/sampler.py). Supports set_epoch for reshuffling and
+    record skipping for elastic mid-epoch resume
+    (ElasticSampler.record_batch)."""
+
+    def __init__(self, data, rank: int, size: int, batch_size: int = 1,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = True):
+        import numpy as np
+        self.data = data
+        self.rank = rank
+        self.size = size
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.processed_indices: int = 0
+        self._np = np
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.processed_indices = 0
+
+    def record_batch(self) -> None:
+        """Mark one batch consumed (for elastic resume)."""
+        self.processed_indices += self.batch_size
+
+    def _indices(self):
+        np = self._np
+        n = len(self.data)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        # Pad to a multiple of size*batch so every rank sees equal batches.
+        per = self.size * self.batch_size
+        if self.drop_last:
+            idx = idx[: (n // per) * per]
+        else:
+            pad = (-n) % per
+            idx = np.concatenate([idx, idx[:pad]])
+        mine = idx[self.rank::self.size]
+        return mine[self.processed_indices:]
+
+    def __len__(self) -> int:
+        return len(self._indices()) // self.batch_size
+
+    def _iterate(self):
+        mine = self._indices()
+        for i in range(0, len(mine) - self.batch_size + 1, self.batch_size):
+            batch_idx = mine[i:i + self.batch_size]
+            yield [self.data[int(j)] for j in batch_idx]

@@ -1,0 +1,64 @@
+"""Elastic (fault-tolerant, resizable) training.
+
+Reference: horovod/common/elastic.py (run_fn retry loop :151-175) +
+horovod/runner/elastic/ (driver, discovery, registration) + per-framework
+State objects. See state.py / driver.py for the TPU redesign notes.
+
+Worker-side usage (mirrors hvd.elastic.run):
+
+    state = hvd.elastic.JaxState(params=params, opt_state=opt_state, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        ...
+        state.commit()
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+from horovod_tpu.elastic.state import JaxState, ObjectState, State  # noqa: F401
+from horovod_tpu.elastic.discovery import (  # noqa: F401
+    FixedHosts, HostDiscovery, HostDiscoveryScript, HostManager,
+)
+from horovod_tpu.elastic.driver import ElasticDriver  # noqa: F401
+from horovod_tpu.elastic.registration import WorkerStateRegistry  # noqa: F401
+
+
+def _reset() -> None:
+    """Re-initialize topology after a host change (reference: the Gloo ring
+    rebuild in common/elastic.py reset(); here: mesh rebuild — a full
+    jax.distributed re-init happens via process restart by the driver)."""
+    from horovod_tpu.core import topology
+    topology.shutdown()
+    topology.init()
+
+
+def run(func: Callable) -> Callable:
+    """Elastic retry decorator (reference: common/elastic.py run_fn :151).
+
+    HorovodInternalError  → restore last commit, reset, retry.
+    HostsUpdatedInterrupt → reset, sync from rank 0, continue.
+    """
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        skip_sync = False
+        while True:
+            if not skip_sync:
+                state.sync()
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                skip_sync = bool(getattr(e, "skip_sync", False))
+            _reset()
+            state.on_reset()
+
+    return wrapper

@@ -1,0 +1,279 @@
+"""Elastic driver: worker lifecycle across host changes.
+
+Reference: horovod/runner/elastic/driver.py ElasticDriver —
+`_discover_hosts` poll thread (:188), `_update_host_assignments` (:240 —
+recompute rank assignments PRESERVING running workers' host/local_rank
+slots), `_start_worker_process` (:289), `_handle_worker_exit` (:304),
+`wait_for_available_slots` (:153).
+
+TPU note: a topology change means a new `jax.distributed` ring, so a reset
+restarts worker processes (fast thanks to the persistent XLA compile
+cache) — the reference instead rebuilds only the Gloo ring in-process.
+Worker state survives through the elastic State sync (state.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.elastic.discovery import HostManager
+from horovod_tpu.elastic.registration import WorkerStateRegistry
+from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
+
+
+@dataclasses.dataclass
+class _Worker:
+    slot: SlotInfo
+    handle: object  # launcher-provided process handle
+    round_id: int
+
+
+class ElasticDriver:
+    """Drives discovery → assignment → worker (re)start rounds.
+
+    `spawn_fn(slot, round_id) -> handle` and `stop_fn(handle)` are injected
+    so unit tests can drive the driver with mocks (reference test strategy:
+    test/single/test_elastic_driver.py uses mock worker clients).
+    """
+
+    def __init__(self,
+                 host_manager: HostManager,
+                 spawn_fn: Callable[[SlotInfo, int], object],
+                 stop_fn: Callable[[object], None],
+                 min_num_proc: int = 1,
+                 max_num_proc: Optional[int] = None,
+                 discovery_interval: float = 1.0,
+                 reset_limit: Optional[int] = None):
+        self.hosts = host_manager
+        self.spawn_fn = spawn_fn
+        self.stop_fn = stop_fn
+        self.min_num_proc = min_num_proc
+        self.max_num_proc = max_num_proc
+        self.discovery_interval = discovery_interval
+        self.reset_limit = reset_limit
+        self.registry = WorkerStateRegistry()
+
+        self._workers: Dict[int, _Worker] = {}   # rank -> worker
+        self._round = 0
+        self._resets = 0
+        self._shutdown = threading.Event()
+        self._host_change = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.RLock()
+
+    # ---------------------------------------------------------------- hosts
+    def wait_for_available_slots(self, min_np: int,
+                                 timeout: float = 600.0) -> None:
+        """Block until discovery finds ≥ min_np slots (reference :153)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.hosts.update_available_hosts()
+            if self.hosts.available_slots() >= min_np:
+                return
+            time.sleep(self.discovery_interval)
+        raise HorovodTpuError(
+            f"timed out waiting for {min_np} slots "
+            f"(have {self.hosts.available_slots()})")
+
+    def _discover_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                if self.hosts.update_available_hosts():
+                    self._host_change.set()
+            except Exception as e:  # discovery script hiccup: log, retry
+                print(f"elastic: discovery error: {e}", file=sys.stderr)
+            self._shutdown.wait(self.discovery_interval)
+
+    # ---------------------------------------------------------- assignments
+    def compute_assignments(self) -> List[SlotInfo]:
+        hosts = self.hosts.current_hosts
+        total = sum(h.slots for h in hosts)
+        np = min(total, self.max_num_proc) if self.max_num_proc else total
+        if np < self.min_num_proc:
+            raise HorovodTpuError(
+                f"available slots {np} < min_num_proc {self.min_num_proc}")
+        # Preserve running workers' placement: order hosts so that hosts
+        # currently running workers come first, in their existing order
+        # (reference :240 — existing workers keep their slots; new hosts
+        # append).
+        with self._lock:
+            running_hosts = []
+            for w in sorted(self._workers.values(),
+                            key=lambda w: w.slot.rank):
+                if w.slot.hostname not in running_hosts:
+                    running_hosts.append(w.slot.hostname)
+        by_name = {h.hostname: h for h in hosts}
+        ordered: List[HostInfo] = [by_name[h] for h in running_hosts
+                                   if h in by_name]
+        ordered += [h for h in hosts if h.hostname not in running_hosts]
+        return get_host_assignments(ordered, np)
+
+    # -------------------------------------------------------------- workers
+    def _start_round(self) -> None:
+        slots = self.compute_assignments()
+        with self._lock:
+            self._round += 1
+            round_id = self._round
+            self.registry.reset(len(slots))
+            # Stop workers whose (host, local_rank) no longer exists.
+            keep = {(s.hostname, s.local_rank): s for s in slots}
+            for rank, w in list(self._workers.items()):
+                key = (w.slot.hostname, w.slot.local_rank)
+                if key not in keep:
+                    self.stop_fn(w.handle)
+                    del self._workers[rank]
+            # (Re)spawn everything for the new ring: rank/size changed for
+            # everyone, so every worker restarts into the new rendezvous.
+            for w in list(self._workers.values()):
+                self.stop_fn(w.handle)
+            self._workers = {}
+            for slot in slots:
+                handle = self.spawn_fn(slot, round_id)
+                self._workers[slot.rank] = _Worker(slot, handle, round_id)
+
+    def handle_worker_exit(self, rank: int, exit_code: int,
+                           host_failure: bool = False) -> None:
+        """Reference :304 — non-zero exit blacklists the host and triggers
+        a reset round."""
+        with self._lock:
+            w = self._workers.pop(rank, None)
+        if w is None:
+            return
+        if exit_code == 0:
+            self.registry.record_success(rank)
+            return
+        self.registry.record_failure(rank)
+        if host_failure:
+            self.hosts.blacklist(w.slot.hostname)
+        self._host_change.set()
+
+    # ------------------------------------------------------------------ run
+    def start(self) -> None:
+        self.wait_for_available_slots(self.min_num_proc)
+        self._start_round()
+        self._thread = threading.Thread(target=self._discover_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def maybe_reset(self) -> bool:
+        """Process a pending host change; returns True if a reset happened.
+
+        If the usable host set dropped below min_num_proc (e.g. the only
+        host was just blacklisted), the reset stays PENDING: the flag is
+        re-armed and the caller keeps polling until discovery finds slots
+        again or its elastic timeout expires (reference:
+        wait_for_available_slots gating each rendezvous round).
+        """
+        if not self._host_change.is_set():
+            return False
+        self._host_change.clear()
+        self._resets += 1
+        if self.reset_limit is not None and self._resets > self.reset_limit:
+            raise HorovodTpuError(
+                f"elastic reset limit {self.reset_limit} exceeded "
+                f"(reference: launch.py --reset-limit)")
+        try:
+            self._start_round()
+        except HorovodTpuError:
+            self._resets -= 1
+            self._host_change.set()
+            return False
+        return True
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            for w in self._workers.values():
+                self.stop_fn(w.handle)
+            self._workers = {}
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def world_size(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def current_slots(self) -> List[SlotInfo]:
+        with self._lock:
+            return [w.slot for w in sorted(self._workers.values(),
+                                           key=lambda w: w.slot.rank)]
+
+
+def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
+    """CLI entry for elastic mode (reference: launch.py:689 _run_elastic +
+    gloo_run.py:303 launch_gloo_elastic)."""
+    import os
+
+    from horovod_tpu.common import config as C
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.runner import safe_exec
+    from horovod_tpu.runner.launch import _free_port, _local_ip, \
+        make_worker_cmd
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    hm = HostManager(HostDiscoveryScript(
+        args.host_discovery_script,
+        default_slots=args.slots_per_host or 1))
+    rdv = RendezvousServer()
+    rdv_port = rdv.start()
+    ip = _local_ip()
+
+    def spawn(slot: SlotInfo, round_id: int):
+        # No pre-picked jax.distributed coordinator: rank 0 of each round
+        # publishes its own address through the KV store, keyed by
+        # HOROVOD_ELASTIC_ROUND (core/topology.py _maybe_distributed_init)
+        # — correct even when rank 0 lands on a remote host after a reset.
+        env = dict(extra_env)
+        env.update({
+            C.HOROVOD_RENDEZVOUS_ADDR: ip,
+            C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
+            C.HOROVOD_ELASTIC: "1",
+            "HOROVOD_ELASTIC_ROUND": str(round_id),
+        })
+        cmd, full_env = make_worker_cmd(slot, command, env)
+        return safe_exec.WorkerProcess(slot.rank, cmd, full_env)
+
+    driver = ElasticDriver(
+        hm, spawn, lambda h: h.terminate(),
+        min_num_proc=args.min_num_proc or 1,
+        max_num_proc=args.max_num_proc,
+        reset_limit=args.reset_limit)
+    driver.start()
+    idle_since = None
+    try:
+        while True:
+            driver.maybe_reset()
+            with driver._lock:
+                workers = dict(driver._workers)
+            done = {r: w.handle.poll() for r, w in workers.items()}
+            exited = {r: c for r, c in done.items() if c is not None}
+            for r, c in exited.items():
+                driver.handle_worker_exit(r, c, host_failure=(c != 0))
+            if workers and all(c == 0 for c in done.values()
+                               if c is not None) \
+                    and all(c is not None for c in done.values()):
+                return 0
+            if driver.world_size == 0:
+                # No workers: either a reset is pending (waiting for hosts
+                # to clear cooldown / reappear) or the job is dead. Bounded
+                # by --elastic-timeout (reference: launch.py:689 settings).
+                if not driver._host_change.is_set():
+                    return 1
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > args.elastic_timeout:
+                    print("elastic: timed out waiting for hosts",
+                          file=sys.stderr)
+                    return 1
+            else:
+                idle_since = None
+            time.sleep(0.5)
+    finally:
+        driver.stop()
+        rdv.stop()

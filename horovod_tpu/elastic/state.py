@@ -1,0 +1,130 @@
+"""Elastic state objects: in-memory checkpoints + rank-0 sync.
+
+Reference: horovod/common/elastic.py State/ObjectState (:99-147),
+horovod/torch/elastic/state.py TorchState. The contract:
+
+  commit()  — snapshot now (user-called at a consistent point)
+  restore() — roll back to the last commit (after HorovodInternalError)
+  sync()    — broadcast rank 0's state to everyone (after a reset, so
+              rejoining workers pick up the survivors' state)
+  on_reset()/register_reset_callbacks — user hooks after a topology change
+
+JAX redesign: state is pytrees (params/opt_state/arbitrary objects); save =
+host snapshot (device_get), sync = broadcast_parameters/broadcast_object
+over the current mesh.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from horovod_tpu.optim.functions import broadcast_object, broadcast_parameters
+
+
+class State:
+    """Base elastic state (reference: common/elastic.py:99)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: List[Callable[[], None]] = []
+        self._known_attrs = set()
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+            self._known_attrs.add(k)
+        self.commit()
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    # -- to be specialized --------------------------------------------------
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        """Snapshot current values (reference: State.commit =
+        save + check_host_updates)."""
+        self.save()
+
+
+class ObjectState(State):
+    """State of picklable attributes (reference: common/elastic.py
+    ObjectState). save() deep-copies to host; sync() broadcasts rank 0's
+    snapshot with broadcast_object."""
+
+    def __init__(self, **kwargs):
+        self._saved: Dict[str, Any] = {}
+        super().__init__(**kwargs)
+
+    def _values(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._known_attrs}
+
+    def save(self) -> None:
+        self._saved = copy.deepcopy(
+            {k: jax.device_get(v) if _is_pytree_of_arrays(v) else v
+             for k, v in self._values().items()})
+
+    def restore(self) -> None:
+        for k, v in copy.deepcopy(self._saved).items():
+            setattr(self, k, v)
+
+    def sync(self) -> None:
+        synced = broadcast_object(self._values(), root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+            self._known_attrs.add(k)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Model/optimizer pytree state (reference: TorchState,
+    torch/elastic/state.py:27 — there: module/optimizer state dicts).
+
+    Array pytrees passed as kwargs are synced with broadcast_parameters
+    (collective, stays on device); everything else falls back to
+    broadcast_object.
+    """
+
+    def __init__(self, params: Any = None, opt_state: Any = None, **kwargs):
+        self.params = params
+        self.opt_state = opt_state
+        self._saved_trees: Dict[str, Any] = {}
+        super().__init__(**kwargs)
+        self._known_attrs -= {"params", "opt_state"}
+
+    def save(self) -> None:
+        self._saved_trees = {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+        super().save()
+
+    def restore(self) -> None:
+        self.params = self._saved_trees.get("params")
+        self.opt_state = self._saved_trees.get("opt_state")
+        super().restore()
+
+    def sync(self) -> None:
+        if self.params is not None:
+            self.params = broadcast_parameters(self.params, root_rank=0)
+        if self.opt_state is not None:
+            self.opt_state = broadcast_parameters(self.opt_state, root_rank=0)
+        super().sync()
+
+
+def _is_pytree_of_arrays(v: Any) -> bool:
+    leaves = jax.tree_util.tree_leaves(v)
+    return bool(leaves) and all(
+        isinstance(l, (jax.Array, np.ndarray)) for l in leaves)

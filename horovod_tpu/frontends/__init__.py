@@ -1,0 +1,8 @@
+"""Framework frontends.
+
+The reference ships native bindings per framework (horovod/torch/,
+horovod/tensorflow/, horovod/mxnet/). Here the core IS a framework-level
+API (JAX), so frontends are thin adapters: they convert foreign tensors at
+the boundary and reuse the eager collective engine. Import-gated — each
+frontend needs its framework installed only when used.
+"""

@@ -1,0 +1,199 @@
+"""PyTorch frontend: the `horovod.torch` API surface over the TPU engine.
+
+Reference: horovod/torch/mpi_ops.py (sync+async collectives),
+horovod/torch/optimizer.py `DistributedOptimizer`,
+horovod/torch/functions.py broadcast helpers.
+
+Torch tensors cross the boundary as numpy (zero-copy on CPU); the
+collective itself runs as a compiled XLA program over the mesh. This gives
+reference-API users a drop-in surface:
+
+    import horovod_tpu.frontends.torch as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(torch.optim.SGD(model.parameters(), lr),
+                                   named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.common import types as T
+from horovod_tpu.core.topology import (  # noqa: F401
+    init, is_initialized, local_rank, local_size, rank, shutdown, size,
+)
+from horovod_tpu.core.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, global_process_set, remove_process_set,
+)
+from horovod_tpu.ops import collectives as C
+
+Average = T.ReduceOp.AVERAGE
+Sum = T.ReduceOp.SUM
+Adasum = T.ReduceOp.ADASUM
+
+
+def _torch():
+    import torch
+    return torch
+
+
+def _to_np(t) -> np.ndarray:
+    torch = _torch()
+    if isinstance(t, torch.Tensor):
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _like(arr, ref):
+    torch = _torch()
+    out = torch.from_numpy(np.ascontiguousarray(np.asarray(arr)))
+    if isinstance(ref, torch.Tensor):
+        return out.to(dtype=ref.dtype, device=ref.device)
+    return out
+
+
+def allreduce(tensor, average: Optional[bool] = None, name=None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None):
+    """Reference: hvd.allreduce (torch/mpi_ops.py:260)."""
+    out = C.allreduce(_to_np(tensor), average=average, name=name, op=op,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set)
+    return _like(out, tensor)
+
+
+def allreduce_(tensor, **kw):
+    """In-place variant (reference: allreduce_)."""
+    result = allreduce(tensor, **kw)
+    tensor.copy_(result)
+    return tensor
+
+
+def grouped_allreduce(tensors, **kw):
+    outs = C.grouped_allreduce([_to_np(t) for t in tensors], **kw)
+    return [_like(o, t) for o, t in zip(outs, tensors)]
+
+
+def broadcast(tensor, root_rank: int, name=None,
+              process_set: Optional[ProcessSet] = None):
+    out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
+                      process_set=process_set)
+    return _like(out, tensor)
+
+
+def broadcast_(tensor, root_rank: int, **kw):
+    tensor.copy_(broadcast(tensor, root_rank, **kw))
+    return tensor
+
+
+def allgather(tensor, name=None, process_set: Optional[ProcessSet] = None):
+    out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
+    return _like(out, tensor)
+
+
+def reducescatter(tensor, op=Average,
+                  process_set: Optional[ProcessSet] = None, **kw):
+    out = C.reducescatter(_to_np(tensor), op=op, process_set=process_set,
+                          **kw)
+    return _like(out, tensor)
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set: Optional[ProcessSet] = None):
+    out, recv = C.alltoall(_to_np(tensor), splits=splits, name=name,
+                           process_set=process_set)
+    return _like(out, tensor), _like(recv, tensor).long()
+
+
+def barrier(process_set: Optional[ProcessSet] = None):
+    C.barrier(process_set=process_set)
+
+
+# Async API parity: dispatch is synchronous through numpy, so the handle is
+# the result (reference handles: torch/handle_manager.h).
+def allreduce_async(tensor, **kw):
+    return allreduce(tensor, **kw)
+
+
+def synchronize(handle):
+    return handle
+
+
+def poll(handle) -> bool:
+    return True
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Reference: torch/functions.py:30 — in-place sync of a state_dict or
+    named_parameters iterable."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    torch = _torch()
+    for _, p in items:
+        if isinstance(p, torch.Tensor):
+            p.data.copy_(broadcast(p.data, root_rank))
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Reference: torch/functions.py:62."""
+    from horovod_tpu.optim.functions import broadcast_object
+    state = optimizer.state_dict()
+    synced = broadcast_object(state, root_rank=root_rank)
+    optimizer.load_state_dict(synced)
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None):
+    from horovod_tpu.optim.functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank, name=name)
+
+
+class DistributedOptimizer:
+    """Reference: torch/optimizer.py:36 `_DistributedOptimizer` — allreduce
+    gradients before each step. Hook-free variant: gradients are averaged
+    in `step()` (grouped/fused), matching the semantics of the reference's
+    synchronize()+step path."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=None, backward_passes_per_step: int = 1,
+                 op=Average, gradient_predivide_factor: float = 1.0,
+                 process_set: Optional[ProcessSet] = None):
+        self.opt = optimizer
+        self.op = op
+        self.process_set = process_set
+        self._bpps = backward_passes_per_step
+        self._count = 0
+
+    def __getattr__(self, name):
+        return getattr(self.opt, name)
+
+    def step(self, closure=None):
+        self._count += 1
+        if self._count % self._bpps == 0:
+            params_with_grad = [
+                p for group in self.opt.param_groups
+                for p in group["params"] if p.grad is not None]
+            if params_with_grad:
+                grads = [p.grad.data for p in params_with_grad]
+                reduced = grouped_allreduce(grads, op=self.op,
+                                            process_set=self.process_set)
+                for p, g in zip(params_with_grad, reduced):
+                    p.grad.data.copy_(g)
+        return self.opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        return self.opt.zero_grad(*a, **kw)
+
+    def synchronize(self):
+        pass
+
+    def state_dict(self):
+        return self.opt.state_dict()
+
+    def load_state_dict(self, sd):
+        self.opt.load_state_dict(sd)

@@ -575,7 +575,14 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     L = max(1, _local_member_count(ps))
     ones = np.ones((L, 1), np.int32)
     g, _ = _to_global(ones if L > 1 else ones[0], ps)
-    jax.block_until_ready(fn(g))
+    _timeline_span("barrier", "BARRIER")
+    # Blocking point: if another rank never arrives we hang here — exactly
+    # what the stall inspector watches (reference: stall_inspector.cc).
+    _stall_submit("barrier")
+    try:
+        jax.block_until_ready(fn(g))
+    finally:
+        _stall_done("barrier")
 
 
 def synchronize(handle: Any) -> Any:
@@ -583,7 +590,11 @@ def synchronize(handle: Any) -> Any:
 
     JAX arrays are futures under async dispatch, so the handle IS the result.
     """
-    return jax.block_until_ready(handle)
+    _stall_submit("synchronize")
+    try:
+        return jax.block_until_ready(handle)
+    finally:
+        _stall_done("synchronize")
 
 
 def poll(handle: Any) -> bool:
@@ -647,9 +658,26 @@ def _exchange_rows(my_row: np.ndarray, ps: ProcessSet) -> np.ndarray:
 
     fn = _cache.get_or_build(key, build)
     g, _ = _to_global(my_row.astype(np.int64), ps)
-    out = fn(g)
-    shard = out.addressable_shards[0].data[0]
-    return np.asarray(shard)
+    # Host readback blocks until every rank contributed — stall watchpoint.
+    _stall_submit("exchange_rows")
+    try:
+        out = fn(g)
+        shard = out.addressable_shards[0].data[0]
+        return np.asarray(shard)
+    finally:
+        _stall_done("exchange_rows")
+
+
+def _stall_submit(name: str) -> None:
+    si = topology.raw_state().stall_inspector
+    if si is not None:
+        si.submit(name)
+
+
+def _stall_done(name: str) -> None:
+    si = topology.raw_state().stall_inspector
+    if si is not None:
+        si.done(name)
 
 
 def _timeline_span(name: str, activity: str) -> None:

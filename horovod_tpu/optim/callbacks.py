@@ -1,0 +1,173 @@
+"""Training-loop callbacks (Keras-callback parity for JAX/optax loops).
+
+Reference: horovod/_keras/callbacks.py —
+  BroadcastGlobalVariablesCallback (:23), MetricAverageCallback (:62),
+  LearningRateScheduleCallback (:108), LearningRateWarmupCallback (:193) —
+plus the elastic commit callbacks (horovod/_keras/elastic.py).
+
+JAX redesign: no mutable model object to patch, so callbacks are small
+objects a training loop invokes at the standard hook points
+(on_train_begin / on_epoch_end / on_batch_end) and that transform explicit
+state (params pytrees, metric dicts, optax-style scale factors).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.common import types as T
+from horovod_tpu.core.process_sets import ProcessSet
+from horovod_tpu.ops import collectives
+from horovod_tpu.optim.functions import broadcast_parameters
+
+
+class Callback:
+    def on_train_begin(self, state: Dict[str, Any]) -> None: ...
+    def on_epoch_begin(self, epoch: int, state: Dict[str, Any]) -> None: ...
+    def on_batch_end(self, batch: int, state: Dict[str, Any]) -> None: ...
+    def on_epoch_end(self, epoch: int, state: Dict[str, Any]) -> None: ...
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Sync params (and opt state) from root at train start (reference:
+    _keras/callbacks.py:23 — runs the broadcast on the first batch)."""
+
+    def __init__(self, root_rank: int = 0,
+                 process_set: Optional[ProcessSet] = None):
+        self.root_rank = root_rank
+        self.process_set = process_set
+
+    def on_train_begin(self, state: Dict[str, Any]) -> None:
+        for key in ("params", "opt_state"):
+            if state.get(key) is not None:
+                state[key] = broadcast_parameters(
+                    state[key], root_rank=self.root_rank,
+                    process_set=self.process_set)
+
+
+class MetricAverageCallback(Callback):
+    """Average metrics across ranks at epoch end (reference:
+    _keras/callbacks.py:62). Metrics live in state['metrics']: dict of
+    scalars."""
+
+    def __init__(self, process_set: Optional[ProcessSet] = None):
+        self.process_set = process_set
+
+    def on_epoch_end(self, epoch: int, state: Dict[str, Any]) -> None:
+        metrics = state.get("metrics")
+        if not metrics:
+            return
+        keys = sorted(metrics)
+        vec = np.asarray([float(metrics[k]) for k in keys], np.float64)
+        avg = collectives.allreduce(vec, op=T.ReduceOp.AVERAGE,
+                                    process_set=self.process_set)
+        avg = np.asarray(avg)
+        for k, v in zip(keys, avg):
+            metrics[k] = float(v)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by `multiplier(epoch)` within [start_epoch,
+    end_epoch) (reference: _keras/callbacks.py:108). The loop reads
+    state['lr'] each step (e.g. via optax.inject_hyperparams)."""
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True,
+                 momentum_correction: bool = True):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        if not callable(multiplier):
+            self._mult = lambda epoch: multiplier
+        else:
+            self._mult = multiplier
+        self._current_epoch = 0
+
+    def on_epoch_begin(self, epoch: int, state: Dict[str, Any]) -> None:
+        self._current_epoch = epoch
+        if self.staircase:
+            self._apply(epoch, state)
+
+    def on_batch_end(self, batch: int, state: Dict[str, Any]) -> None:
+        if not self.staircase:
+            steps = state.get("steps_per_epoch", 1)
+            self._apply(self._current_epoch + batch / float(steps), state)
+
+    def _apply(self, epoch: float, state: Dict[str, Any]) -> None:
+        if epoch < self.start_epoch:
+            return
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return
+        state["lr"] = self.initial_lr * self._mult(epoch)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from initial_lr to initial_lr*size over
+    `warmup_epochs` (reference: _keras/callbacks.py:193 — implements the
+    'Accurate Large Minibatch SGD' gradual warmup)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: bool = False):
+        from horovod_tpu.core import topology
+        size = topology.size() if topology.is_initialized() else 1
+        self.warmup_epochs = warmup_epochs
+
+        def multiplier(epoch):
+            # epoch/warmup in [0,1] → factor in [1/size, 1] of the scaled LR
+            frac = min(1.0, (epoch + 1) / float(warmup_epochs))
+            return 1.0 / size * (frac * (size - 1) + 1)
+
+        super().__init__(initial_lr=initial_lr * size, multiplier=multiplier,
+                         start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction)
+
+
+class CommitStateCallback(Callback):
+    """Elastic: state.commit() every `batches_per_commit` batches
+    (reference: _keras/elastic.py CommitStateCallback)."""
+
+    def __init__(self, state_obj, batches_per_commit: int = 1):
+        self.state_obj = state_obj
+        self.batches_per_commit = batches_per_commit
+
+    def on_batch_end(self, batch: int, state: Dict[str, Any]) -> None:
+        if (batch + 1) % self.batches_per_commit == 0:
+            self.state_obj.commit()
+
+
+class UpdateBatchStateCallback(Callback):
+    """Elastic: track batch progress in state so rejoining workers resume
+    mid-epoch (reference: _keras/elastic.py UpdateBatchStateCallback)."""
+
+    def __init__(self, state_obj):
+        self.state_obj = state_obj
+
+    def on_batch_end(self, batch: int, state: Dict[str, Any]) -> None:
+        self.state_obj.batch = batch
+
+    def on_epoch_end(self, epoch: int, state: Dict[str, Any]) -> None:
+        self.state_obj.epoch = epoch
+        self.state_obj.batch = 0
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback]):
+        self.callbacks = list(callbacks)
+
+    def __getattr__(self, hook):
+        if not hook.startswith("on_"):
+            raise AttributeError(hook)
+
+        def dispatch(*args, **kwargs):
+            for cb in self.callbacks:
+                getattr(cb, hook)(*args, **kwargs)
+
+        return dispatch

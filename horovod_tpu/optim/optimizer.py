@@ -26,6 +26,7 @@ Two entry points:
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -183,9 +184,23 @@ class DistributedOptimizer:
         L = collectives._local_member_count(self.process_set)
         stacked = [collectives._is_stacked(t, self.process_set, L)
                    for t in tensors]
+        pm = topology.state().parameter_manager
+        # Instrumentation only while actively tuning: once frozen, the
+        # block_until_ready sync would permanently defeat async dispatch.
+        tuning = pm is not None and not pm.frozen
+        t0 = time.perf_counter() if tuning else 0.0
         reduced = collectives.grouped_allreduce(
             tensors, op=rop, prescale_factor=pre, postscale_factor=post,
             process_set=self.process_set)
+        if tuning:
+            jax.block_until_ready(reduced)
+            nbytes = sum(int(np.prod(np.shape(t))) * np.dtype(
+                getattr(t, "dtype", np.float32)).itemsize for t in tensors)
+            pm.record(nbytes, time.perf_counter() - t0)
+            # No cache clear on change: the grouped-allreduce cache key
+            # includes fusion_threshold_bytes, so a new threshold simply
+            # misses and re-traces while other executables stay warm.
+            pm.update()
         # Reduced per-rank rows are identical; collapse stacked inputs to a
         # single copy so updates apply to the (replicated) parameters.
         reduced = [r[0] if s else r for r, s in zip(reduced, stacked)]

@@ -31,7 +31,8 @@ _PH_METADATA = "M"
 class Timeline:
     """Async Chrome-trace writer (reference TimelineWriter, timeline.h:28)."""
 
-    def __init__(self, path: str, mark_cycles: bool = False) -> None:
+    def __init__(self, path: str, mark_cycles: bool = False,
+                 use_native: bool = True) -> None:
         self.path = path
         self.mark_cycles = mark_cycles
         self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
@@ -40,24 +41,43 @@ class Timeline:
         self._t0 = time.monotonic_ns()
         self._lock = threading.Lock()
         self._pending_spans: dict = {}
+        self._native = None
+        self._use_native = use_native
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         with self._lock:
             if self._active:
                 return
+            # Prefer the native writer (C++ writer thread + bounded ring,
+            # horovod_tpu/native/src/timeline.cc — the reference
+            # TimelineWriter counterpart); fall back to the Python thread.
+            if self._use_native and self._native is None:
+                try:
+                    from horovod_tpu import native as native_mod
+                    if native_mod.available():
+                        self._native = native_mod.NativeTimeline(self.path)
+                except Exception:
+                    self._native = None
             self._active = True
-            self._thread = threading.Thread(
-                target=self._writer_loop, name="hvd-timeline", daemon=True)
-            self._thread.start()
-            self._emit({"ph": _PH_METADATA, "pid": 0, "name": "process_name",
-                        "args": {"name": "horovod_tpu"}})
+            if self._native is None:
+                self._thread = threading.Thread(
+                    target=self._writer_loop, name="hvd-timeline",
+                    daemon=True)
+                self._thread.start()
+                self._emit({"ph": _PH_METADATA, "pid": 0,
+                            "name": "process_name",
+                            "args": {"name": "horovod_tpu"}})
 
     def stop(self) -> None:
         with self._lock:
             if not self._active:
                 return
             self._active = False
+            if self._native is not None:
+                self._native.close()
+                self._native = None
+                return
             self._queue.put(None)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
@@ -75,6 +95,13 @@ class Timeline:
             self._queue.put(event)
 
     def record_instant(self, name: str, activity: str) -> None:
+        # Lock around the native handle: a concurrent stop() frees the C++
+        # writer, so check-then-emit must be atomic with close.
+        with self._lock:
+            if self._native is not None:
+                self._native.emit(f"{activity}:{name}", activity, "i",
+                                  int(self._now_us()))
+                return
         self._emit({"ph": _PH_INSTANT, "pid": 0, "tid": 0, "s": "t",
                     "ts": self._now_us(), "name": f"{activity}:{name}"})
 
@@ -86,6 +113,11 @@ class Timeline:
         if t0 is None:
             return
         t1 = self._now_us()
+        with self._lock:
+            if self._native is not None:
+                self._native.emit(f"{activity}:{name}", activity, "X",
+                                  int(t0), dur_us=int(t1 - t0))
+                return
         self._emit({"ph": _PH_COMPLETE, "pid": 0, "tid": 0, "ts": t0,
                     "dur": t1 - t0, "name": activity, "args": {"tensor": name}})
 

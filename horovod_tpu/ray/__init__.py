@@ -1,0 +1,79 @@
+"""Ray orchestration (thin).
+
+Reference: horovod/ray/runner.py RayExecutor (:168) — colocated actor
+placement, Gloo rendezvous driven by a Coordinator actor (:45), and an
+elastic variant (elastic_v2.py). The thin TPU integration maps one Ray
+actor to one worker process; rendezvous is our KV server on the driver.
+
+Import-gated: only needs ray when actually used.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+
+def _require_ray():
+    try:
+        import ray
+        return ray
+    except ImportError as e:
+        raise ImportError("horovod_tpu.ray requires ray (reference extra: "
+                          "horovod[ray])") from e
+
+
+class RayExecutor:
+    """Reference: RayExecutor (ray/runner.py:168) — start() creates the
+    worker actors, run() executes a function on all of them, shutdown()
+    tears down."""
+
+    def __init__(self, num_workers: int,
+                 cpus_per_worker: int = 1,
+                 use_current_placement_group: bool = True,
+                 env_vars: Optional[dict] = None):
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.env_vars = dict(env_vars or {})
+        self._actors: List[Any] = []
+        self._rdv = None
+
+    def start(self) -> None:
+        ray = _require_ray()
+
+        from horovod_tpu.runner.launch import _local_ip
+        from horovod_tpu.runner.rendezvous import RendezvousServer
+
+        self._rdv = RendezvousServer()
+        port = self._rdv.start()
+        addr = _local_ip()
+
+        @ray.remote(num_cpus=self.cpus_per_worker)
+        class Worker:
+            def __init__(self, rank: int, size: int, env: dict):
+                import os
+                os.environ.update(env)
+                os.environ["HOROVOD_RANK"] = str(rank)
+                os.environ["HOROVOD_SIZE"] = str(size)
+                os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = addr
+                os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port)
+
+            def execute(self, fn, *args, **kwargs):
+                return fn(*args, **kwargs)
+
+        self._actors = [Worker.remote(i, self.num_workers, self.env_vars)
+                        for i in range(self.num_workers)]
+
+    def run(self, fn: Callable, args=(), kwargs=None) -> List[Any]:
+        ray = _require_ray()
+        kwargs = kwargs or {}
+        return ray.get([a.execute.remote(fn, *args, **kwargs)
+                        for a in self._actors])
+
+    def shutdown(self) -> None:
+        ray = _require_ray()
+        for a in self._actors:
+            ray.kill(a)
+        self._actors = []
+        if self._rdv is not None:
+            self._rdv.stop()
+            self._rdv = None

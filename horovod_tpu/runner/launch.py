@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import socket
 import sys
 from typing import Dict, List, Optional
@@ -150,15 +151,20 @@ def launch_static(np: int, host_spec: str, command: List[str],
     rdv = RendezvousServer()
     rdv_port = rdv.start()
     ip = coordinator_ip or _local_ip()
-    coord_port = _free_port()
 
     base_env = dict(extra_env)
     base_env.update({
         C.HOROVOD_RENDEZVOUS_ADDR: ip,
         C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
-        "HOROVOD_COORDINATOR_ADDR": f"{ip}:{coord_port}",
         C.HOROVOD_CONTROLLER: "tpu",
     })
+    # Single-host: the launcher can pre-pick the jax.distributed
+    # coordinator port (rank 0 binds it locally). Multi-host: rank 0 picks
+    # a port on ITS host and publishes via the KV store instead
+    # (core/topology.py _maybe_distributed_init) — the launcher cannot
+    # probe a free port on a remote machine.
+    if all(_is_local(s.hostname) for s in slots):
+        base_env["HOROVOD_COORDINATOR_ADDR"] = f"{ip}:{_free_port()}"
 
     workers = []
     try:
@@ -174,7 +180,17 @@ def launch_static(np: int, host_spec: str, command: List[str],
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         print(f"horovodrun-tpu: workers failed: {bad}", file=sys.stderr)
-        return bad[0][1] or 1
+        # Report the ORIGINATING failure, not the -SIGTERM of siblings we
+        # killed in response: prefer positive exit codes, then non-SIGTERM
+        # signal deaths (mapped to 128+signum, the shell convention), then
+        # anything else.
+        real = [c for _, c in bad if c > 0]
+        if real:
+            return real[0]
+        signaled = [c for _, c in bad if c < 0 and c != -signal.SIGTERM]
+        if signaled:
+            return 128 - signaled[0]
+        return 128 + signal.SIGTERM
     return 0
 
 

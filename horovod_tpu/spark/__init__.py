@@ -1,0 +1,74 @@
+"""Spark orchestration (thin).
+
+Reference: horovod/spark/__init__.py + spark/runner.py (448 LoC) —
+`horovod.spark.run(fn, ...)` spawns a Spark job whose tasks each run one
+worker (`_task_fn`, runner.py:49), with the driver doing rendezvous. The
+Estimator stack (spark/common/estimator.py, store.py) is out of scope for
+the thin integration — DataFrame-to-training hand-off on TPU pods goes
+through the standard array path instead of Petastorm.
+
+This module is import-gated: it only needs pyspark when actually used.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark (reference extra: "
+            "horovod[spark])") from e
+
+
+def run(fn: Callable[[], Any], args=(), kwargs=None, num_proc: Optional[int] = None,
+        extra_env: Optional[dict] = None, verbose: int = 1) -> List[Any]:
+    """Run `fn` once per Spark task slot (reference: spark/runner.py:200).
+
+    Each Spark task becomes one framework worker: the driver starts the
+    rendezvous, tasks rendezvous back, run fn, and return per-rank results
+    through Spark's collect.
+    """
+    pyspark = _require_pyspark()
+    import cloudpickle
+
+    from horovod_tpu.runner.launch import _local_ip
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    sc = pyspark.SparkContext._active_spark_context
+    if sc is None:
+        raise RuntimeError("no active SparkContext; create a SparkSession "
+                           "first (reference: spark/runner.py checks the "
+                           "same)")
+    np_ = num_proc or int(sc.defaultParallelism)
+    payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+
+    rdv = RendezvousServer()
+    port = rdv.start()
+    addr = _local_ip()
+    env = dict(extra_env or {})
+
+    def task_fn(index, _it):
+        # Reference: _task_fn (spark/runner.py:49) — set worker identity env
+        # then run the user function.
+        import os as _os
+        import cloudpickle as _cp
+        _os.environ.update(env)
+        _os.environ["HOROVOD_RANK"] = str(index)
+        _os.environ["HOROVOD_SIZE"] = str(np_)
+        _os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = addr
+        _os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port)
+        f, a, kw = _cp.loads(payload)
+        yield (index, f(*a, **kw))
+
+    try:
+        results = (sc.parallelize(range(np_), np_)
+                   .mapPartitionsWithIndex(task_fn).collect())
+    finally:
+        rdv.stop()
+    return [r for _, r in sorted(results)]

@@ -1,0 +1,222 @@
+"""Elastic subsystem tests.
+
+Reference analogs: test/single/test_elastic_driver.py (driver with mocked
+workers + scripted discovery), test_elastic_discovery.py, and the state
+commit/restore semantics exercised by test/parallel elastic torch tests.
+"""
+
+import os
+import stat
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+from horovod_tpu.elastic import (ElasticDriver, FixedHosts, HostDiscoveryScript,
+                                 HostManager, JaxState, ObjectState, run)
+from horovod_tpu.elastic.discovery import _Blacklist
+
+
+# ----------------------------------------------------------------- discovery
+
+def test_discovery_script(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho host1:2\necho host2\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    d = HostDiscoveryScript(str(script), default_slots=4)
+    assert d.find_available_hosts_and_slots() == {"host1": 2, "host2": 4}
+
+
+def test_blacklist_cooldown_backoff(monkeypatch):
+    bl = _Blacklist()
+    t = [0.0]
+    monkeypatch.setattr(time, "monotonic", lambda: t[0])
+    bl.blacklist("h")
+    assert bl.is_blacklisted("h")
+    t[0] += bl.INIT_COOLDOWN + 0.1
+    assert not bl.is_blacklisted("h")
+    bl.blacklist("h")  # second failure: cooldown doubles
+    t[0] += bl.INIT_COOLDOWN + 0.1
+    assert bl.is_blacklisted("h")
+    t[0] += bl.INIT_COOLDOWN + 0.1
+    assert not bl.is_blacklisted("h")
+
+
+def test_host_manager_excludes_blacklisted():
+    hm = HostManager(FixedHosts({"a": 2, "b": 2}))
+    hm.update_available_hosts()
+    assert hm.available_slots() == 4
+    hm.blacklist("b")
+    hm.update_available_hosts()
+    assert [h.hostname for h in hm.current_hosts] == ["a"]
+
+
+# -------------------------------------------------------------------- driver
+
+class MockSpawner:
+    def __init__(self):
+        self.spawned = []   # (slot, round_id)
+        self.stopped = []
+
+    def spawn(self, slot, round_id):
+        handle = object()
+        self.spawned.append((slot, round_id, handle))
+        return handle
+
+    def stop(self, handle):
+        self.stopped.append(handle)
+
+
+def make_driver(hosts, **kw):
+    fixed = FixedHosts(hosts)
+    hm = HostManager(fixed)
+    sp = MockSpawner()
+    d = ElasticDriver(hm, sp.spawn, sp.stop, discovery_interval=0.05, **kw)
+    return d, sp, fixed, hm
+
+
+def test_driver_initial_round_assigns_all_slots():
+    d, sp, fixed, hm = make_driver({"a": 2, "b": 2})
+    d.start()
+    try:
+        slots = d.current_slots()
+        assert [s.rank for s in slots] == [0, 1, 2, 3]
+        assert {s.hostname for s in slots} == {"a", "b"}
+        assert all(s.size == 4 for s in slots)
+    finally:
+        d.stop()
+
+
+def test_driver_scale_up_preserves_existing_hosts_first():
+    d, sp, fixed, hm = make_driver({"a": 2})
+    d.start()
+    try:
+        assert d.world_size == 2
+        fixed.hosts["b"] = 2
+        hm.update_available_hosts()
+        d._host_change.set()
+        assert d.maybe_reset()
+        slots = d.current_slots()
+        assert [s.rank for s in slots] == [0, 1, 2, 3]
+        # Existing host 'a' keeps the leading ranks.
+        assert [s.hostname for s in slots][:2] == ["a", "a"]
+        assert [s.hostname for s in slots][2:] == ["b", "b"]
+    finally:
+        d.stop()
+
+
+def test_driver_worker_failure_blacklists_and_scales_down():
+    d, sp, fixed, hm = make_driver({"a": 2, "b": 2})
+    d.start()
+    try:
+        victim = [s for s in d.current_slots() if s.hostname == "b"][0]
+        d.handle_worker_exit(victim.rank, 1, host_failure=True)
+        hm.update_available_hosts()
+        assert d.maybe_reset()
+        slots = d.current_slots()
+        assert {s.hostname for s in slots} == {"a"}
+        assert all(s.size == 2 for s in slots)
+    finally:
+        d.stop()
+
+
+def test_driver_reset_limit():
+    d, sp, fixed, hm = make_driver({"a": 2}, reset_limit=1)
+    d.start()
+    try:
+        d._host_change.set()
+        d.maybe_reset()
+        d._host_change.set()
+        with pytest.raises(Exception):
+            d.maybe_reset()
+    finally:
+        d.stop()
+
+
+def test_driver_respects_max_num_proc():
+    d, sp, fixed, hm = make_driver({"a": 4}, max_num_proc=2)
+    d.start()
+    try:
+        assert d.world_size == 2
+    finally:
+        d.stop()
+
+
+# --------------------------------------------------------------------- state
+
+def test_object_state_commit_restore(hvd):
+    s = ObjectState(epoch=3, batch=7)
+    s.epoch = 5
+    s.restore()
+    assert s.epoch == 3 and s.batch == 7
+    s.epoch = 5
+    s.commit()
+    s.epoch = 9
+    s.restore()
+    assert s.epoch == 5
+
+
+def test_jax_state_save_restore_sync(hvd):
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    s = JaxState(params=params, opt_state={"m": jnp.zeros((4, 4))}, epoch=0)
+    s.params["w"] = s.params["w"] * 3
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.params["w"]), 1.0)
+    s.epoch = 2
+    s.commit()
+    s.sync()  # single-controller: broadcast over the local mesh
+    assert s.epoch == 2
+    np.testing.assert_allclose(np.asarray(s.params["w"]), 1.0)
+
+
+def test_elastic_run_retries_on_internal_error(hvd):
+    calls = {"n": 0, "restores": 0, "syncs": 0}
+
+    class S(ObjectState):
+        def restore(self):
+            calls["restores"] += 1
+            super().restore()
+
+        def sync(self):
+            calls["syncs"] += 1
+            super().sync()
+
+    state = S(step=0)
+
+    @run
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise HorovodInternalError("simulated collective failure")
+        return "done"
+
+    assert train(state) == "done"
+    assert calls["restores"] == 1
+    assert calls["n"] == 2
+    assert calls["syncs"] == 2  # initial + post-reset
+
+
+def test_elastic_run_hosts_updated_skips_restore(hvd):
+    calls = {"n": 0, "restores": 0}
+
+    class S(ObjectState):
+        def restore(self):
+            calls["restores"] += 1
+            super().restore()
+
+    state = S(step=0)
+
+    @run
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise HostsUpdatedInterrupt(False)
+        return 42
+
+    assert train(state) == 42
+    assert calls["restores"] == 0

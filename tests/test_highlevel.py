@@ -1,0 +1,169 @@
+"""High-level API tests: sync batch norm, callbacks, autotuner.
+
+Reference analogs: sync BN numeric tests in test/parallel/test_tensorflow.py
+/ torch sync_batch_norm tests; callback behavior from _keras/callbacks.py;
+autotune parameter convergence (the reference has no unit test for the GP —
+we add one)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.core.autotune import (BayesianOptimization, GaussianProcess,
+                                       ParameterManager)
+from horovod_tpu.ops.sync_batch_norm import SyncBatchNorm, sync_batch_norm
+from horovod_tpu.optim import callbacks as cb
+
+
+# ------------------------------------------------------------------ sync BN
+
+def test_sync_batch_norm_in_shard_map_matches_global(hvd):
+    """Moments over the full (sharded) batch must equal unsharded BN."""
+    from horovod_tpu.core import topology
+    mesh = topology.mesh()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 6), jnp.float32)
+    scale = jnp.ones((6,)) * 2.0
+    bias = jnp.ones((6,)) * 0.5
+
+    def local(xs):
+        out, mean, var = sync_batch_norm(xs, scale, bias, axis_name="hvd")
+        return out, mean, var
+
+    out, mean, var = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=P("hvd"),
+        out_specs=(P("hvd"), P(), P()), check_vma=False))(x)
+
+    gm = x.astype(jnp.float32).mean(0)
+    gv = x.astype(jnp.float32).var(0)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(gm), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(gv), atol=1e-5)
+    expect = (x - gm) / np.sqrt(gv + 1e-5) * 2.0 + 0.5
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-4)
+
+
+def test_sync_batch_norm_eager_wrapper(hvd):
+    bn = SyncBatchNorm(4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 4), jnp.float32)
+    y = bn(x, train=True)
+    assert y.shape == x.shape
+    # Per-channel output stats ~ (0, 1) after normalization.
+    yf = np.asarray(y, np.float64)
+    np.testing.assert_allclose(yf.mean((0, 1)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(yf.std((0, 1)), 1.0, atol=1e-2)
+    # Running stats moved from init.
+    assert float(jnp.abs(bn.running_mean).sum()) > 0
+    y_eval = bn(x, train=False)
+    assert y_eval.shape == x.shape
+
+
+# ---------------------------------------------------------------- callbacks
+
+def test_metric_average_callback(hvd):
+    state = {"metrics": {"loss": 2.0, "acc": 0.5}}
+    cb.MetricAverageCallback().on_epoch_end(0, state)
+    # Single controller: average of identical values is identity.
+    assert state["metrics"]["loss"] == pytest.approx(2.0)
+
+
+def test_broadcast_callback_syncs_params(hvd):
+    params = {"w": jnp.arange(4.0)}
+    state = {"params": params, "opt_state": None}
+    cb.BroadcastGlobalVariablesCallback(0).on_train_begin(state)
+    np.testing.assert_allclose(np.asarray(state["params"]["w"]),
+                               np.arange(4.0))
+
+
+def test_lr_schedule_callback():
+    c = cb.LearningRateScheduleCallback(
+        initial_lr=0.1, multiplier=lambda e: 0.1 ** (e // 2), staircase=True)
+    state = {}
+    c.on_epoch_begin(0, state)
+    assert state["lr"] == pytest.approx(0.1)
+    c.on_epoch_begin(3, state)
+    assert state["lr"] == pytest.approx(0.01)
+
+
+def test_lr_warmup_callback(hvd):
+    c = cb.LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=4)
+    state = {"steps_per_epoch": 10}
+    c.on_epoch_begin(0, state)
+    c.on_batch_end(0, state)
+    lr_start = state["lr"]
+    c.on_epoch_begin(3, state)
+    c.on_batch_end(9, state)
+    lr_end = state["lr"]
+    assert lr_end > lr_start  # ramping up
+    size = 8  # conftest mesh
+    assert lr_end <= 0.1 * size + 1e-9
+
+
+def test_commit_state_callback():
+    commits = []
+
+    class FakeState:
+        def commit(self):
+            commits.append(1)
+
+    c = cb.CommitStateCallback(FakeState(), batches_per_commit=3)
+    for b in range(9):
+        c.on_batch_end(b, {})
+    assert len(commits) == 3
+
+
+# ----------------------------------------------------------------- autotune
+
+def test_gaussian_process_fits_and_predicts():
+    gp = GaussianProcess(length_scale=0.3, noise=0.05)
+    x = np.linspace(0, 1, 8)[:, None]
+    y = np.sin(3 * x[:, 0])
+    gp.fit(x, y)
+    mu, sd = gp.predict(x)
+    np.testing.assert_allclose(mu, y, atol=0.15)
+    mu_mid, sd_mid = gp.predict(np.asarray([[0.5]]))
+    assert sd_mid[0] < 0.5
+
+
+def test_bayes_opt_finds_peak():
+    rng = np.random.default_rng(0)
+    bo = BayesianOptimization(dims=1, noise=0.05, seed=1)
+
+    def f(x):
+        return float(-(x - 0.7) ** 2)
+
+    x = np.asarray([0.1])
+    for _ in range(20):
+        bo.register(x, f(x[0]))
+        x = bo.next_sample()
+    best = bo.xs[int(np.argmax(bo.ys))]
+    assert abs(best[0] - 0.7) < 0.15
+
+
+def test_parameter_manager_tunes_and_freezes():
+    cfg = Config(autotune=True, autotune_warmup_samples=1,
+                 autotune_steps_per_sample=2,
+                 autotune_bayes_opt_max_samples=5)
+    pm = ParameterManager(cfg)
+    # Synthetic world: throughput peaks at 32MB threshold.
+    peak = 32 * 1024 * 1024
+
+    def throughput():
+        t = cfg.fusion_threshold_bytes
+        return 1e9 * np.exp(-((np.log2(t) - np.log2(peak)) ** 2) / 8)
+
+    for _ in range(40):
+        rate = throughput()
+        pm.record(rate * 0.01, 0.01)  # 10ms windows at `rate` bytes/sec
+        pm.update()
+        if pm.frozen:
+            break
+    assert pm.frozen
+    # Converged threshold within a factor of ~8 of the peak (5 samples of a
+    # noisy GP — just assert it moved into a sane range).
+    assert 1 * 1024 * 1024 <= cfg.fusion_threshold_bytes <= 256 * 1024 * 1024

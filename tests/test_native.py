@@ -1,0 +1,130 @@
+"""Native control-plane tests (KV/coordination server, timeline writer,
+stall inspector).
+
+Reference analogs: the Gloo rendezvous/http_store path (exercised in the
+reference via gloo_run + C++ http_store.cc), controller bitvector
+coordination (controller.cc:159-190), test_timeline.py (validates the
+Chrome-trace JSON), test_stall.py.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain)")
+
+
+@pytest.fixture()
+def kv():
+    srv = native.NativeKVServer()
+    yield srv
+    srv.stop()
+
+
+def test_kv_put_get_roundtrip(kv):
+    c = native.NativeKVClient("127.0.0.1", kv.port)
+    assert c.ping()
+    c.put("a/b", b"hello world")
+    assert c.get("a/b") == b"hello world"
+    assert c.get("missing") is None
+    c.close()
+
+
+def test_kv_add_atomic_across_clients(kv):
+    def worker(n):
+        c = native.NativeKVClient("127.0.0.1", kv.port)
+        for _ in range(n):
+            c.add("ctr", 1)
+        c.close()
+
+    threads = [threading.Thread(target=worker, args=(100,)) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    c = native.NativeKVClient("127.0.0.1", kv.port)
+    assert c.add("ctr", 0) == 800
+    c.close()
+
+
+def test_kv_barrier(kv):
+    results = []
+
+    def worker(i):
+        c = native.NativeKVClient("127.0.0.1", kv.port)
+        ok = c.barrier("round1", size=4, timeout=10.0)
+        results.append((i, ok))
+        c.close()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(ok for _, ok in results) and len(results) == 4
+
+
+def test_kv_bitvector_and_or(kv):
+    """The cache-coordination pattern: every rank contributes its bitvector,
+    then reads the combined result once all ranks checked in (reference:
+    CoordinateCacheAndState, controller.cc:159)."""
+    vecs = [bytes([0b1110]), bytes([0b0111]), bytes([0b1101])]
+
+    def worker(i):
+        c = native.NativeKVClient("127.0.0.1", kv.port)
+        c.bitwise("cache_and", vecs[i], op="and")
+        got = c.get_when("cache_and", expected=3, timeout=10.0)
+        results[i] = got
+        c.close()
+
+    results = [None] * 3
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r == bytes([0b1110 & 0b0111 & 0b1101]) for r in results)
+
+
+def test_timeline_writes_valid_chrome_trace(tmp_path):
+    path = str(tmp_path / "tl.json")
+    tl = native.NativeTimeline(path)
+    t0 = int(time.time() * 1e6)
+    tl.emit("allreduce.grad0", "NEGOTIATE_ALLREDUCE", "B", t0)
+    tl.emit("allreduce.grad0", "NEGOTIATE_ALLREDUCE", "E", t0 + 50)
+    tl.emit("allreduce.grad0", "ALLREDUCE", "X", t0 + 60, dur_us=400)
+    tl.emit('weird"name\\x', "CAT", "i", t0 + 500)
+    tl.close()
+    events = json.load(open(path))
+    assert len(events) == 4
+    assert events[2]["ph"] == "X" and events[2]["dur"] == 400
+    assert events[0]["name"] == "allreduce.grad0"
+
+
+def test_stall_inspector_flags_old_submissions():
+    si = native.NativeStallInspector(warn_sec=0.05, shutdown_sec=0.0)
+    si.submit("tensor_a")
+    si.submit("tensor_b")
+    si.done("tensor_b")
+    time.sleep(0.1)
+    stalled, shutdown = si.check()
+    assert stalled == ["tensor_a"]
+    assert not shutdown
+    si.done("tensor_a")
+    stalled, _ = si.check()
+    assert stalled == []
+    si.free()
+
+
+def test_stall_inspector_shutdown_window():
+    si = native.NativeStallInspector(warn_sec=0.01, shutdown_sec=0.05)
+    si.submit("t")
+    time.sleep(0.1)
+    stalled, shutdown = si.check()
+    assert stalled == ["t"] and shutdown
+    si.free()

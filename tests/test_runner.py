@@ -1,0 +1,142 @@
+"""Launcher tests (reference analog: test/single/test_run.py — launcher
+logic with no cluster, plus integration-style local subprocess launches as
+in test/integration/test_static_run.py)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner.launch import (args_to_env, build_parser,
+                                       launch_static)
+from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+
+
+# ---------------------------------------------------------------------- hosts
+
+def test_parse_hosts():
+    hs = hosts_mod.parse_hosts("a:4, b:2,c")
+    assert [(h.hostname, h.slots) for h in hs] == [("a", 4), ("b", 2),
+                                                   ("c", 1)]
+
+
+def test_parse_hosts_rejects_bad_spec():
+    with pytest.raises(HorovodTpuError):
+        hosts_mod.parse_hosts("a:zero")
+    with pytest.raises(HorovodTpuError):
+        hosts_mod.parse_hosts("a:0")
+    with pytest.raises(HorovodTpuError):
+        hosts_mod.parse_hosts("")
+
+
+def test_host_assignments_even():
+    hs = hosts_mod.parse_hosts("a:2,b:2")
+    slots = hosts_mod.get_host_assignments(hs, 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [s.hostname for s in slots] == ["a", "a", "b", "b"]
+    assert [s.local_rank for s in slots] == [0, 1, 0, 1]
+    assert all(s.size == 4 for s in slots)
+    assert [s.cross_rank for s in slots] == [0, 0, 1, 1]
+    assert all(s.cross_size == 2 for s in slots)
+
+
+def test_host_assignments_uneven_cross_groups():
+    # Host b has no local_rank 1, so the cross group for local_rank 1 only
+    # contains host a (reference: cross communicator semantics).
+    hs = hosts_mod.parse_hosts("a:2,b:1")
+    slots = hosts_mod.get_host_assignments(hs, 3)
+    lr1 = [s for s in slots if s.local_rank == 1]
+    assert len(lr1) == 1 and lr1[0].cross_size == 1
+    lr0 = [s for s in slots if s.local_rank == 0]
+    assert all(s.cross_size == 2 for s in lr0)
+
+
+def test_host_assignments_overflow():
+    hs = hosts_mod.parse_hosts("a:2")
+    with pytest.raises(HorovodTpuError):
+        hosts_mod.get_host_assignments(hs, 3)
+
+
+# ----------------------------------------------------------------- arg → env
+
+def test_args_to_env_mapping():
+    args = build_parser().parse_args(
+        ["-np", "2", "--fusion-threshold-mb", "32", "--cache-capacity",
+         "512", "--timeline-filename", "/tmp/tl.json", "--autotune",
+         "--", "python", "x.py"])
+    env = args_to_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CACHE_CAPACITY"] == "512"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+
+
+def test_disable_cache_flag():
+    args = build_parser().parse_args(["-np", "1", "--disable-cache", "x"])
+    assert args_to_env(args)["HOROVOD_CACHE_CAPACITY"] == "0"
+
+
+# ---------------------------------------------------------------- rendezvous
+
+def test_rendezvous_put_get_roundtrip():
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        client = KVClient("127.0.0.1", port)
+        client.put("scope", "key", b"hello")
+        assert client.get("scope", "key") == b"hello"
+        assert srv.get("scope", "key") == b"hello"
+        srv.put("s2", "k2", b"x")
+        assert client.get("s2", "k2") == b"x"
+        assert client.get("nope", "nothing", timeout=0.2) is None
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------------- static launch e2e
+
+def test_launch_static_injects_env(tmp_path):
+    out = tmp_path / "env_out"
+    script = (
+        "import os,sys,pathlib;"
+        "d=pathlib.Path(os.environ['OUT_DIR']);"
+        "r=os.environ['HOROVOD_RANK'];"
+        "(d/('r'+r)).write_text(','.join("
+        "os.environ[k] for k in ['HOROVOD_RANK','HOROVOD_SIZE',"
+        "'HOROVOD_LOCAL_RANK','HOROVOD_GLOO_RENDEZVOUS_ADDR']))"
+    )
+    out.mkdir()
+    rc = launch_static(
+        2, "localhost:2", [sys.executable, "-c", script],
+        {"OUT_DIR": str(out)})
+    assert rc == 0
+    r0 = (out / "r0").read_text().split(",")
+    r1 = (out / "r1").read_text().split(",")
+    assert r0[0] == "0" and r1[0] == "1"
+    assert r0[1] == r1[1] == "2"
+    assert r0[3]  # rendezvous addr injected
+
+
+def test_launch_static_propagates_failure():
+    rc = launch_static(
+        2, "localhost:2",
+        [sys.executable, "-c",
+         "import os,sys,time;"
+         "sys.exit(7) if os.environ['HOROVOD_RANK']=='1' else time.sleep(60)"],
+        {})
+    assert rc == 7
+
+
+def test_interactive_run_returns_per_rank_results():
+    from horovod_tpu.runner import run
+
+    def fn():
+        import os
+        return int(os.environ["HOROVOD_RANK"]) * 10
+
+    results = run(fn, np=2)
+    assert results == [0, 10]
