@@ -136,14 +136,36 @@ def _apply_cpu_emulation(n: int) -> None:
     Must run before the first JAX backend touch; env vars alone are not
     enough when a site plugin pins the platform, so jax.config is set too.
     """
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+    import re
+
+    try:
+        if jax.devices()[0].platform == "cpu" and len(jax.devices()) >= n:
+            return
+    except Exception:
+        pass
+    try:  # discard any live backend (e.g. a 1-chip TPU client) first —
+        # XLA_FLAGS/jax_num_cpu_devices are consumed at client creation.
+        import jax.extend.backend as _jeb
+        _jeb.clear_backends()
+    except Exception:
+        pass
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n}").strip()
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        pass  # older jax: the XLA_FLAGS path above handles it
+    if len(jax.devices()) < n:
+        raise HorovodTpuError(
+            f"CPU emulation failed: need {n} devices, have "
+            f"{len(jax.devices())} (a JAX backend may already be "
+            "initialized in a way that cannot be reset)")
 
 
 def init(process_sets: Optional[Sequence] = None,
