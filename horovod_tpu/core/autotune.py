@@ -236,14 +236,17 @@ class ParameterManager:
         return changed
 
     def _maybe_log(self) -> None:
-        if self.cfg.autotune_log:
-            try:
-                with open(self.cfg.autotune_log, "a") as f:
-                    th, score = self._log_rows[-1]
-                    f.write(f"{th}\t{score:.3e}\t"
-                            f"{'frozen' if self._frozen else 'tuning'}\n")
-            except OSError:
-                pass
+        # In multi-process mode only rank 0 appends to _log_rows
+        # (_coordinate_multiprocess) — other ranks have nothing to log.
+        if not self.cfg.autotune_log or not self._log_rows:
+            return
+        try:
+            with open(self.cfg.autotune_log, "a") as f:
+                th, score = self._log_rows[-1]
+                f.write(f"{th}\t{score:.3e}\t"
+                        f"{'frozen' if self._frozen else 'tuning'}\n")
+        except OSError:
+            pass
 
     @property
     def frozen(self) -> bool:

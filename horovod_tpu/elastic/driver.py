@@ -61,6 +61,15 @@ class ElasticDriver:
         self._workers: Dict[int, _Worker] = {}   # rank -> worker
         self._round = 0
         self._resets = 0
+        # Per-round outcome tracking (reference: WorkerStateRegistry ends
+        # the job when the last worker exits and none succeeded,
+        # runner/elastic/registration.py:150-165). Without this, a
+        # deterministic user-code failure loops forever: blacklist cooldown
+        # (≤300s) re-admits the host before elastic_timeout can fire.
+        self._round_spawned = 0
+        self._round_failed = 0
+        self._round_succeeded = 0
+        self.consecutive_failed_rounds = 0
         self._shutdown = threading.Event()
         self._host_change = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -132,6 +141,9 @@ class ElasticDriver:
             for w in list(self._workers.values()):
                 self.stop_fn(w.handle)
             self._workers = {}
+            self._round_spawned = len(slots)
+            self._round_failed = 0
+            self._round_succeeded = 0
             for slot in slots:
                 handle = self.spawn_fn(slot, round_id)
                 self._workers[slot.rank] = _Worker(slot, handle, round_id)
@@ -146,8 +158,16 @@ class ElasticDriver:
             return
         if exit_code == 0:
             self.registry.record_success(rank)
+            with self._lock:
+                self._round_succeeded += 1
+                self.consecutive_failed_rounds = 0
             return
         self.registry.record_failure(rank)
+        with self._lock:
+            self._round_failed += 1
+            if (self._round_succeeded == 0
+                    and self._round_failed >= self._round_spawned > 0):
+                self.consecutive_failed_rounds += 1
         if host_failure:
             self.hosts.blacklist(w.slot.hostname)
         self._host_change.set()
@@ -246,6 +266,13 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
         reset_limit=args.reset_limit)
     driver.start()
     idle_since = None
+    # Stop once this many consecutive rounds ended with every worker
+    # failing — a deterministic user-code failure, not a host event
+    # (reference analog: registration.py:150-165 fails the job when the
+    # last worker exits and none succeeded; we allow a couple of retries
+    # to survive whole-pod preemptions).
+    failed_round_limit = int(
+        os.environ.get("HOROVOD_ELASTIC_FAILED_ROUND_LIMIT", "3"))
     try:
         while True:
             driver.maybe_reset()
@@ -255,6 +282,11 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
             exited = {r: c for r, c in done.items() if c is not None}
             for r, c in exited.items():
                 driver.handle_worker_exit(r, c, host_failure=(c != 0))
+            if driver.consecutive_failed_rounds >= failed_round_limit:
+                print(f"elastic: {driver.consecutive_failed_rounds} "
+                      "consecutive rounds failed on every worker; giving up",
+                      file=sys.stderr)
+                return 1
             if workers and all(c == 0 for c in done.values()
                                if c is not None) \
                     and all(c is not None for c in done.values()):

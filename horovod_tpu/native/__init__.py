@@ -140,6 +140,8 @@ class NativeKVClient:
 
     def get(self, key: str, maxlen: int = 1 << 20) -> Optional[bytes]:
         st, data = self._req(OP_GET, key, b"", maxlen)
+        if st > maxlen:  # value larger than our buffer: re-fetch full size
+            st, data = self._req(OP_GET, key, b"", int(st))
         return data if st >= 0 else None
 
     def add(self, key: str, delta: int) -> int:
@@ -163,6 +165,10 @@ class NativeKVClient:
             out = ctypes.create_string_buffer(maxlen)
             st = self._lib.hvdn_kv_request(
                 self._h, OP_GETC, key.encode(), payload, 8, out, maxlen)
+            if st > maxlen:  # buffer too small: re-fetch at full size
+                out = ctypes.create_string_buffer(int(st))
+                st = self._lib.hvdn_kv_request(
+                    self._h, OP_GETC, key.encode(), payload, 8, out, int(st))
             if st >= 0:
                 return out.raw[:st]
             time.sleep(0.005)

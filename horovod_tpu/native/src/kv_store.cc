@@ -77,6 +77,10 @@ class KVServer {
     }
     if (accept_thread_.joinable()) accept_thread_.join();
     std::lock_guard<std::mutex> g(conn_mu_);
+    // Serve threads may be blocked in recv() on idle client connections;
+    // shutdown their fds so the joins below cannot hang (Serve still owns
+    // the close()).
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
     for (auto& t : conn_threads_)
       if (t.joinable()) t.join();
   }
@@ -114,6 +118,7 @@ class KVServer {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> g(conn_mu_);
+      conn_fds_.push_back(fd);
       conn_threads_.emplace_back([this, fd] { Serve(fd); });
     }
   }
@@ -219,6 +224,7 @@ class KVServer {
   std::atomic<bool> stopping_{false};
   std::thread accept_thread_;
   std::mutex conn_mu_;
+  std::vector<int> conn_fds_;
   std::vector<std::thread> conn_threads_;
   std::mutex mu_;
   std::map<std::string, Entry> store_;

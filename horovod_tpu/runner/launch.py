@@ -131,10 +131,14 @@ def make_worker_cmd(slot: hosts_mod.SlotInfo, command: List[str],
     env.update(slot.to_env())
     if _is_local(slot.hostname):
         return list(command), env
-    # Remote: ssh with env inlined (reference: gloo_run.py get_remote_command).
-    env_str = " ".join(f"{k}={v}" for k, v in {**base_env,
-                                               **slot.to_env()}.items())
-    remote = f"cd {os.getcwd()} && env {env_str} " + " ".join(command)
+    # Remote: ssh with env inlined (reference: gloo_run.py
+    # get_remote_command). Everything user-controlled is shell-quoted —
+    # cwd, env values (e.g. XLA_FLAGS with spaces), and command args.
+    import shlex
+    env_str = " ".join(f"{k}={shlex.quote(str(v))}"
+                       for k, v in {**base_env, **slot.to_env()}.items())
+    remote = (f"cd {shlex.quote(os.getcwd())} && env {env_str} "
+              + " ".join(shlex.quote(c) for c in command))
     return ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote], \
         dict(os.environ)
 

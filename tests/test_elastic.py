@@ -220,3 +220,39 @@ def test_elastic_run_hosts_updated_skips_restore(hvd):
 
     assert train(state) == 42
     assert calls["restores"] == 0
+
+
+def test_driver_counts_consecutive_all_failed_rounds():
+    """A round where every worker fails must be observable so the launcher
+    can stop instead of blacklisting/cooldown-respawning forever (advisor
+    finding; reference: registration.py fails the job when the last worker
+    exits and none succeeded)."""
+    d, sp, fixed, hm = make_driver({"a": 2})
+    d.start()
+    try:
+        assert d.consecutive_failed_rounds == 0
+        for s in d.current_slots():
+            d.handle_worker_exit(s.rank, 1, host_failure=True)
+        assert d.consecutive_failed_rounds == 1
+        # Host reappears after cooldown; the next all-failed round bumps it.
+        hm._blacklist._entries.clear()
+        hm.update_available_hosts()
+        d._host_change.set()
+        assert d.maybe_reset()
+        for s in d.current_slots():
+            d.handle_worker_exit(s.rank, 1, host_failure=True)
+        assert d.consecutive_failed_rounds == 2
+    finally:
+        d.stop()
+
+
+def test_driver_success_resets_failed_round_counter():
+    d, sp, fixed, hm = make_driver({"a": 2})
+    d.start()
+    try:
+        slots = d.current_slots()
+        d.handle_worker_exit(slots[0].rank, 1)
+        d.handle_worker_exit(slots[1].rank, 0)
+        assert d.consecutive_failed_rounds == 0
+    finally:
+        d.stop()

@@ -128,3 +128,15 @@ def test_stall_inspector_shutdown_window():
     stalled, shutdown = si.check()
     assert stalled == ["t"] and shutdown
     si.free()
+
+
+def test_kv_get_larger_than_buffer_refetches(kv):
+    """Values larger than the client's buffer must come back whole, not
+    silently truncated (advisor finding: native/__init__.py get/get_when)."""
+    c = native.NativeKVClient("127.0.0.1", kv.port)
+    big = bytes(range(256)) * 1024  # 256 KiB
+    c.put("big", big)
+    assert c.get("big", maxlen=1024) == big
+    c.bitwise("bigc", big, op="or")
+    assert c.get_when("bigc", expected=1, timeout=5.0, maxlen=1024) == big
+    c.close()
