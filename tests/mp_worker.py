@@ -1,0 +1,190 @@
+"""Multi-process integration worker.
+
+Launched by tests/test_multiprocess.py through the real launcher
+(`horovod_tpu.runner.launch.launch_static`) with 2 or 4 processes over
+loopback — the repo's analog of the reference running test/parallel suites
+under `mpirun -np 2` (reference: .buildkite/gen-pipeline.sh:139,
+Dockerfile.test.cpu:122). Each process owns ONE CPU device and is one rank;
+collectives go through jax.distributed + the gloo CPU collectives
+implementation, exercising the true multi-process branches:
+topology._maybe_distributed_init, collectives._to_global's
+make_array_from_single_device_arrays path, _exchange_rows, and
+broadcast_object's root logic.
+
+Usage: python mp_worker.py <scenario>
+Prints "MP_WORKER_OK <scenario> rank=<r>" on success; any assert kills the
+job with a non-zero exit the launcher propagates.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # before any backend touch
+
+import numpy as np  # noqa: E402
+
+
+def check(cond, msg=""):
+    assert cond, msg
+
+
+def scenario_allreduce(hvd, rank, size):
+    import jax.numpy as jnp
+
+    from horovod_tpu.common.types import ReduceOp
+
+    x = jnp.asarray([float(rank + 1), 2.0 * (rank + 1)])
+    avg = np.asarray(hvd.allreduce(x))  # default AVERAGE
+    expect = np.mean([[r + 1, 2.0 * (r + 1)] for r in range(size)], axis=0)
+    np.testing.assert_allclose(avg, expect, rtol=1e-6)
+
+    s = np.asarray(hvd.allreduce(x, op=ReduceOp.SUM))
+    np.testing.assert_allclose(
+        s, np.sum([[r + 1, 2.0 * (r + 1)] for r in range(size)], axis=0),
+        rtol=1e-6)
+
+    mx = np.asarray(hvd.allreduce(x, op=ReduceOp.MAX))
+    np.testing.assert_allclose(mx, [size, 2.0 * size], rtol=1e-6)
+
+
+def scenario_grouped(hvd, rank, size):
+    import jax.numpy as jnp
+
+    from horovod_tpu.common.types import ReduceOp
+
+    tensors = [jnp.full((3,), float(rank)), jnp.full((2, 2), float(rank * 10))]
+    outs = hvd.grouped_allreduce(tensors, op=ReduceOp.SUM)
+    tot = sum(range(size))
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((3,), float(tot)))
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.full((2, 2), float(tot * 10)))
+
+
+def scenario_broadcast(hvd, rank, size):
+    import jax.numpy as jnp
+
+    x = jnp.asarray([[float(rank)] * 4])
+    out = np.asarray(hvd.broadcast(x, root_rank=1))
+    np.testing.assert_allclose(out, [[1.0] * 4])
+
+
+def scenario_allgather_uneven(hvd, rank, size):
+    import jax.numpy as jnp
+
+    # Rank r contributes r+1 rows => output rows 0..0,1,1,... in rank order.
+    x = jnp.full((rank + 1, 2), float(rank))
+    out = np.asarray(hvd.allgather(x))
+    expect = np.concatenate(
+        [np.full((r + 1, 2), float(r)) for r in range(size)], axis=0)
+    np.testing.assert_allclose(out, expect)
+
+
+def scenario_alltoall(hvd, rank, size):
+    import jax.numpy as jnp
+
+    # Rank r sends (dst+1) rows tagged r*100+dst to each dst.
+    splits = [d + 1 for d in range(size)]
+    rows = []
+    for d in range(size):
+        rows += [[float(rank * 100 + d)]] * (d + 1)
+    x = jnp.asarray(rows)
+    out, rsplits = hvd.alltoall(x, splits=jnp.asarray(splits))
+    expect = np.concatenate(
+        [np.full((rank + 1, 1), float(src * 100 + rank))
+         for src in range(size)], axis=0)
+    np.testing.assert_allclose(np.asarray(out), expect)
+    np.testing.assert_array_equal(np.asarray(rsplits),
+                                  np.full((size,), rank + 1))
+
+
+def scenario_reducescatter(hvd, rank, size):
+    import jax.numpy as jnp
+
+    from horovod_tpu.common.types import ReduceOp
+
+    d0 = 2 * size + 1  # uneven split
+    x = jnp.arange(d0 * 3, dtype=jnp.float32).reshape(d0, 3) + rank
+    out = np.asarray(hvd.reducescatter(x, op=ReduceOp.SUM))
+    full = np.sum([np.arange(d0 * 3, dtype=np.float32).reshape(d0, 3) + r
+                   for r in range(size)], axis=0)
+    big = d0 // size + 1
+    rem = d0 % size
+    start = min(rank, rem) * big + max(rank - rem, 0) * (big - 1)
+    mine = big if rank < rem else big - 1
+    np.testing.assert_allclose(out, full[start:start + mine], rtol=1e-6)
+
+
+def scenario_broadcast_object(hvd, rank, size):
+    from horovod_tpu.optim.functions import broadcast_object
+
+    obj = {"round": 7, "who": rank} if rank == 0 else None
+    got = broadcast_object(obj, root_rank=0)
+    check(got == {"round": 7, "who": 0}, f"rank {rank} got {got}")
+
+
+def scenario_barrier(hvd, rank, size):
+    import time
+
+    t0 = time.monotonic()
+    if rank == 0:
+        time.sleep(1.0)
+    hvd.barrier()
+    dt = time.monotonic() - t0
+    if rank != 0:
+        check(dt > 0.5, f"barrier returned too early on rank {rank}: {dt}")
+
+
+def scenario_autotune_sync(hvd, rank, size):
+    """Multi-process autotune broadcast path (autotune.py:212-230)."""
+    from horovod_tpu.core.autotune import ParameterManager
+    from horovod_tpu.core.topology import raw_state
+
+    cfg = raw_state().config
+    cfg.autotune = True
+    pm = ParameterManager(cfg)
+    for _ in range(pm.steps_per_sample *
+                   (cfg.autotune_warmup_samples + cfg.autotune_bayes_opt_max_samples + 2)):
+        pm.record(1 << 20, 0.01)
+        pm.update()
+        if pm.frozen:
+            break
+    check(pm.frozen, "autotuner never froze")
+    # Every rank must converge to the same threshold (rank 0 decides).
+    got = hvd.allgather(np.asarray([[float(cfg.fusion_threshold_bytes)]]))
+    vals = set(float(v) for v in np.asarray(got).ravel())
+    check(len(vals) == 1, f"ranks disagree on tuned threshold: {vals}")
+
+
+SCENARIOS = {
+    "allreduce": scenario_allreduce,
+    "grouped": scenario_grouped,
+    "broadcast": scenario_broadcast,
+    "allgather_uneven": scenario_allgather_uneven,
+    "alltoall": scenario_alltoall,
+    "reducescatter": scenario_reducescatter,
+    "broadcast_object": scenario_broadcast_object,
+    "barrier": scenario_barrier,
+    "autotune_sync": scenario_autotune_sync,
+}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "allreduce"
+    names = list(SCENARIOS) if which == "all" else which.split(",")
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    check(size > 1, f"expected multi-process world, got size={size}")
+    check(jax.process_count() == size,
+          f"process_count {jax.process_count()} != size {size}")
+    for name in names:
+        SCENARIOS[name](hvd, rank, size)
+        print(f"MP_WORKER_OK {name} rank={rank}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Multi-process collective correctness over loopback.
+
+The repo's analog of the reference running its test/parallel suites under a
+real 2-process launcher (`mpirun -np 2 ...`, reference:
+.buildkite/gen-pipeline.sh:139, Dockerfile.test.cpu:122, SURVEY.md §4 tier
+2). Each test spawns REAL worker processes through launch_static; workers
+bootstrap jax.distributed over the launcher's rendezvous and run eager
+collectives through the gloo CPU collectives implementation, asserting
+numeric results per rank (see mp_worker.py for the scenarios).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner.launch import launch_static
+
+WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+
+# The pytest session pins an 8-device virtual CPU platform (conftest.py);
+# workers must instead own ONE cpu device each so process == rank.
+WORKER_ENV = {"XLA_FLAGS": "", "HOROVOD_TPU_EMULATE_RANKS": ""}
+
+
+def run_scenarios(np_procs: int, scenarios: str, tmp_path) -> str:
+    out_path = tmp_path / "out.txt"
+    with open(out_path, "w") as f:
+        rc = launch_static(
+            np_procs, f"localhost:{np_procs}",
+            [sys.executable, WORKER, scenarios], dict(WORKER_ENV), stdout=f)
+    text = out_path.read_text()
+    assert rc == 0, f"launch failed rc={rc}\n{text}"
+    return text
+
+
+@pytest.mark.parametrize("np_procs", [2, 4])
+def test_collectives_multiprocess(np_procs, tmp_path):
+    scenarios = ("allreduce,grouped,broadcast,allgather_uneven,alltoall,"
+                 "reducescatter,broadcast_object,barrier")
+    text = run_scenarios(np_procs, scenarios, tmp_path)
+    for name in scenarios.split(","):
+        for rank in range(np_procs):
+            assert f"MP_WORKER_OK {name} rank={rank}" in text, \
+                f"missing {name} on rank {rank}:\n{text}"
+
+
+def test_autotune_broadcast_multiprocess(tmp_path):
+    text = run_scenarios(2, "autotune_sync", tmp_path)
+    for rank in range(2):
+        assert f"MP_WORKER_OK autotune_sync rank={rank}" in text
+
+
+def test_worker_failure_propagates(tmp_path):
+    """A worker that dies must fail the whole launch with its exit code
+    (reference: gloo_run terminates all workers when one fails)."""
+    out_path = tmp_path / "out.txt"
+    with open(out_path, "w") as f:
+        rc = launch_static(
+            2, "localhost:2",
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            dict(WORKER_ENV), stdout=f)
+    assert rc == 3
