@@ -43,6 +43,7 @@ from horovod_tpu.optim.functions import (  # noqa: F401
 )
 from horovod_tpu.core import join as _join_mod  # noqa: F401
 from horovod_tpu.core.join import join  # noqa: F401
+from horovod_tpu import elastic  # noqa: F401  (hvd.elastic.run / State)
 
 __version__ = "0.1.0"
 

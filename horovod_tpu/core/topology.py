@@ -122,11 +122,83 @@ def _maybe_distributed_init(cfg: Config) -> None:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
-    jax.distributed.initialize(
-        coordinator_address=coord,
-        num_processes=cfg.size,
-        process_id=cfg.rank or 0,
-    )
+    if cfg.elastic:
+        _elastic_distributed_init(coord, cfg)
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=cfg.size,
+            process_id=cfg.rank or 0,
+        )
+
+
+def _elastic_distributed_init(coord: str, cfg: Config) -> None:
+    """jax.distributed bootstrap for ELASTIC workers.
+
+    Reference: in elastic mode the reference aborts NCCL communicators on
+    peer failure instead of dying (nccl_operations.cc elastic handling) so
+    HorovodInternalError can drive recovery. Two departures from the stock
+    jax.distributed.initialize path make that possible here:
+
+    1. The coordination SERVICE lives in the LAUNCHER, not in rank 0
+       (elastic/driver.py run_elastic starts one per round): a worker crash
+       can then never take the coordinator down, which is what turns peer
+       failure into process-fatal error polling on the survivors.
+    2. The client is built `recoverable` (no all-task shutdown barrier —
+       workers leave the ring independently during a resize) and without a
+       destructor-time RPC. With a live service and recoverable clients, a
+       dead peer propagates NO fatal error to survivors (verified
+       empirically); failures surface through the data-plane collectives
+       as catchable errors instead.
+    """
+    from jax._src import distributed as _dist
+    from jax._src.lib import _jax as _jaxlib
+
+    hb = int(os.environ.get("HOROVOD_ELASTIC_HEARTBEAT_SECONDS", "10"))
+    sd = int(os.environ.get("HOROVOD_ELASTIC_SHUTDOWN_SECONDS", "10"))
+    st = _dist.global_state
+    rank = cfg.rank or 0
+    st.num_processes = cfg.size
+    st.process_id = rank
+    st.coordinator_address = coord
+    client = _jaxlib.get_distributed_runtime_client(
+        coord, rank, init_timeout=300, heartbeat_timeout=hb,
+        shutdown_timeout=sd, use_compression=True, recoverable=True,
+        shutdown_on_destruction=False)
+    client.connect()
+    st.client = client
+
+
+def distributed_teardown() -> None:
+    """Tear down the jax.distributed client/service, tolerating dead peers
+    (used by the elastic reset; every step is best-effort because the ring
+    may already be half-gone)."""
+    from jax._src import distributed as _dist
+
+    st = _dist.global_state
+    if st.client is None and st.service is None:
+        return
+    try:
+        if st.preemption_sync_manager is not None:
+            st.preemption_sync_manager.shutdown()
+    except Exception:
+        pass
+    st.preemption_sync_manager = None
+    try:
+        if st.client is not None:
+            st.client.shutdown()
+    except Exception:
+        pass
+    st.client = None
+    try:
+        if st.service is not None:
+            st.service.shutdown()
+    except Exception:
+        pass
+    st.service = None
+    st.coordinator_address = None
+    st.process_id = 0
+    st.num_processes = 1
 
 
 def _apply_cpu_emulation(n: int) -> None:

@@ -17,6 +17,8 @@ Worker-side usage (mirrors hvd.elastic.run):
 from __future__ import annotations
 
 import functools
+import os
+import sys
 from typing import Callable
 
 from horovod_tpu.common.exceptions import (HorovodInternalError,
@@ -27,14 +29,56 @@ from horovod_tpu.elastic.discovery import (  # noqa: F401
 )
 from horovod_tpu.elastic.driver import ElasticDriver  # noqa: F401
 from horovod_tpu.elastic.registration import WorkerStateRegistry  # noqa: F401
+from horovod_tpu.elastic import worker as worker_mod
 
 
-def _reset() -> None:
-    """Re-initialize topology after a host change (reference: the Gloo ring
-    rebuild in common/elastic.py reset(); here: mesh rebuild — a full
-    jax.distributed re-init happens via process restart by the driver)."""
+def _reset(state: State) -> None:
+    """Re-join the job after a failure or host change WITHOUT restarting the
+    process, so in-memory state survives (reference:
+    common/elastic.py reset() rebuilds the Gloo ring in-process;
+    runner/elastic/driver.py:240 preserves running workers' slots).
+
+    TPU mechanics: detach state to host, tear down topology and the
+    jax.distributed client, wait at the rendezvous for the driver's next
+    round, adopt the new (rank, size) assignment, and re-initialize over the
+    new ring. A worker whose slot was removed exits cleanly (the reference
+    driver kills removed workers; we let them leave on their own).
+    """
     from horovod_tpu.core import topology
+
+    state.to_host()
+    notifier = worker_mod.get_notifier()
     topology.shutdown()
+
+    import jax
+    if jax._src.distributed.global_state.client is not None:
+        topology.distributed_teardown()
+        import jax.extend.backend as jeb
+        jeb.clear_backends()
+
+    if notifier is not None:
+        timeout = float(os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
+        new_round = notifier.wait_for_new_round(timeout)
+        assignment = notifier.fetch_assignment(new_round)
+        if assignment is None:
+            from horovod_tpu.common.hvd_logging import get_logger
+            get_logger().info(
+                "elastic: this slot is not part of round %d; exiting",
+                new_round)
+            worker_mod.stop_notifier()
+            sys.exit(0)
+        for env_key, asg_key in [
+                ("HOROVOD_RANK", "rank"), ("HOROVOD_SIZE", "size"),
+                ("HOROVOD_LOCAL_RANK", "local_rank"),
+                ("HOROVOD_LOCAL_SIZE", "local_size"),
+                ("HOROVOD_CROSS_RANK", "cross_rank"),
+                ("HOROVOD_CROSS_SIZE", "cross_size")]:
+            os.environ[env_key] = str(assignment[asg_key])
+        if assignment.get("coord"):
+            os.environ["HOROVOD_COORDINATOR_ADDR"] = assignment["coord"]
+        os.environ["HOROVOD_ELASTIC_ROUND"] = str(new_round)
+        notifier.advance(new_round)
+
     topology.init()
 
 
@@ -47,18 +91,21 @@ def run(func: Callable) -> Callable:
 
     @functools.wraps(func)
     def wrapper(state: State, *args, **kwargs):
+        worker_mod.maybe_init_notifier()
         skip_sync = False
         while True:
             if not skip_sync:
                 state.sync()
             try:
-                return func(state, *args, **kwargs)
+                result = func(state, *args, **kwargs)
+                worker_mod.stop_notifier()
+                return result
             except HorovodInternalError:
                 state.restore()
                 skip_sync = False
             except HostsUpdatedInterrupt as e:
                 skip_sync = bool(getattr(e, "skip_sync", False))
-            _reset()
+            _reset(state)
             state.on_reset()
 
     return wrapper

@@ -48,10 +48,17 @@ class ElasticDriver:
                  min_num_proc: int = 1,
                  max_num_proc: Optional[int] = None,
                  discovery_interval: float = 1.0,
-                 reset_limit: Optional[int] = None):
+                 reset_limit: Optional[int] = None,
+                 publish_fn: Optional[Callable[[List[SlotInfo], int],
+                                               None]] = None):
         self.hosts = host_manager
         self.spawn_fn = spawn_fn
         self.stop_fn = stop_fn
+        # Publishes (slots, round_id) to the rendezvous KV BEFORE workers
+        # are notified of the round bump, so survivors can read their new
+        # assignment (reference: the rendezvous handler's rank_and_size
+        # scope, runner/elastic/rendezvous.py:22-45).
+        self.publish_fn = publish_fn
         self.min_num_proc = min_num_proc
         self.max_num_proc = max_num_proc
         self.discovery_interval = discovery_interval
@@ -59,6 +66,14 @@ class ElasticDriver:
         self.registry = WorkerStateRegistry()
 
         self._workers: Dict[int, _Worker] = {}   # rank -> worker
+        # Workers removed by a resize leave COOPERATIVELY: they observe the
+        # round bump, join the distributed-shutdown barrier with the
+        # survivors, see no assignment, and exit 0. SIGTERMing them instead
+        # would strand the survivors' shutdown barrier on a dead task
+        # (jax coordination service), so they are only force-stopped after
+        # a grace period. (leaving_deadline, worker) pairs.
+        self._leaving: List[tuple] = []
+        self.leave_grace_seconds = 60.0
         self._round = 0
         self._resets = 0
         # Per-round outcome tracking (reference: WorkerStateRegistry ends
@@ -123,30 +138,74 @@ class ElasticDriver:
         return get_host_assignments(ordered, np)
 
     # -------------------------------------------------------------- workers
+    @staticmethod
+    def _alive(w: _Worker) -> bool:
+        poll = getattr(w.handle, "poll", None)
+        return poll is None or poll() is None
+
     def _start_round(self) -> None:
+        """Start a new rendezvous round, PRESERVING surviving workers.
+
+        Reference: _update_host_assignments (runner/elastic/driver.py:240)
+        keeps running workers on their (host, slot) so rank 0's in-memory
+        state survives a resize; only removed/dead slots are stopped and
+        only new slots are spawned. Survivors learn their new rank/size by
+        reading the published assignment after observing the round bump
+        (elastic/worker.py), then re-init jax.distributed in-process.
+        """
         slots = self.compute_assignments()
         with self._lock:
             self._round += 1
             round_id = self._round
             self.registry.reset(len(slots))
-            # Stop workers whose (host, local_rank) no longer exists.
             keep = {(s.hostname, s.local_rank): s for s in slots}
+            survivors: Dict[tuple, _Worker] = {}
             for rank, w in list(self._workers.items()):
                 key = (w.slot.hostname, w.slot.local_rank)
-                if key not in keep:
+                if key in keep and self._alive(w):
+                    survivors[key] = w
+                elif self._alive(w):
+                    # Removed by the resize: let it exit on its own (see
+                    # _leaving above); force-stop only after the grace.
+                    self._leaving.append(
+                        (time.monotonic() + self.leave_grace_seconds, w))
+                else:
                     self.stop_fn(w.handle)
-                    del self._workers[rank]
-            # (Re)spawn everything for the new ring: rank/size changed for
-            # everyone, so every worker restarts into the new rendezvous.
-            for w in list(self._workers.values()):
-                self.stop_fn(w.handle)
+            # Assignments must be readable before any worker can observe
+            # the round bump — publish_fn writes them then bumps "round".
+            print(f"elastic: round {round_id}: slots="
+                  f"{[(s.hostname, s.local_rank, s.rank) for s in slots]} "
+                  f"survivors={len(survivors)}", file=sys.stderr)
+            if self.publish_fn is not None:
+                self.publish_fn(slots, round_id)
             self._workers = {}
             self._round_spawned = len(slots)
             self._round_failed = 0
             self._round_succeeded = 0
             for slot in slots:
-                handle = self.spawn_fn(slot, round_id)
-                self._workers[slot.rank] = _Worker(slot, handle, round_id)
+                key = (slot.hostname, slot.local_rank)
+                if key in survivors:
+                    w = survivors[key]
+                    w.slot = slot
+                    w.round_id = round_id
+                    self._workers[slot.rank] = w
+                else:
+                    handle = self.spawn_fn(slot, round_id)
+                    self._workers[slot.rank] = _Worker(slot, handle, round_id)
+
+    def reap_leaving(self) -> None:
+        """Drop leaving workers that exited; force-stop stragglers past the
+        grace deadline."""
+        with self._lock:
+            still = []
+            for deadline, w in self._leaving:
+                if not self._alive(w):
+                    continue
+                if time.monotonic() > deadline:
+                    self.stop_fn(w.handle)
+                else:
+                    still.append((deadline, w))
+            self._leaving = still
 
     def handle_worker_exit(self, rank: int, exit_code: int,
                            host_failure: bool = False) -> None:
@@ -210,7 +269,10 @@ class ElasticDriver:
         with self._lock:
             for w in self._workers.values():
                 self.stop_fn(w.handle)
+            for _, w in self._leaving:
+                self.stop_fn(w.handle)
             self._workers = {}
+            self._leaving = []
         if self._thread:
             self._thread.join(timeout=5)
 
@@ -244,26 +306,67 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
     rdv_port = rdv.start()
     ip = _local_ip()
 
+    # The jax coordination service runs HERE in the launcher, one per
+    # round — never inside rank 0. A worker crash therefore cannot kill
+    # the coordinator, which is what makes peer failure survivable for the
+    # remaining workers (see topology._elastic_distributed_init). Old
+    # services are retired two rounds later, after their clients are gone.
+    services: Dict[int, object] = {}
+    round_coords: Dict[int, str] = {}
+
+    def make_service(round_id: int, n: int) -> str:
+        from jax._src.lib import _jax as _jaxlib
+        port = _free_port()
+        services[round_id] = _jaxlib.get_distributed_runtime_service(
+            f"[::]:{port}", n,
+            heartbeat_timeout=int(os.environ.get(
+                "HOROVOD_ELASTIC_HEARTBEAT_SECONDS", "10")),
+            shutdown_timeout=int(os.environ.get(
+                "HOROVOD_ELASTIC_SHUTDOWN_SECONDS", "10")))
+        round_coords[round_id] = f"{ip}:{port}"
+        for rid in [r for r in services if r <= round_id - 2]:
+            try:
+                services.pop(rid).shutdown()
+            except Exception:
+                pass
+            round_coords.pop(rid, None)
+        return round_coords[round_id]
+
     def spawn(slot: SlotInfo, round_id: int):
-        # No pre-picked jax.distributed coordinator: rank 0 of each round
-        # publishes its own address through the KV store, keyed by
-        # HOROVOD_ELASTIC_ROUND (core/topology.py _maybe_distributed_init)
-        # — correct even when rank 0 lands on a remote host after a reset.
         env = dict(extra_env)
         env.update({
             C.HOROVOD_RENDEZVOUS_ADDR: ip,
             C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
             C.HOROVOD_ELASTIC: "1",
             "HOROVOD_ELASTIC_ROUND": str(round_id),
+            "HOROVOD_ELASTIC_TIMEOUT": str(args.elastic_timeout),
+            "HOROVOD_COORDINATOR_ADDR": round_coords[round_id],
         })
         cmd, full_env = make_worker_cmd(slot, command, env)
         return safe_exec.WorkerProcess(slot.rank, cmd, full_env)
+
+    def publish(slots: List[SlotInfo], round_id: int) -> None:
+        # Service first (workers connect to it), then assignments, round
+        # bump LAST: a worker that observes the bump must already be able
+        # to read its assignment — with the round's coordinator address —
+        # or conclude it was removed. See elastic/worker.py.
+        import dataclasses as _dc
+        import json as _json
+        coord = make_service(round_id, len(slots))
+        for s in slots:
+            record = _dc.asdict(s)
+            record["coord"] = coord
+            rdv.put("elastic",
+                    f"assign/{round_id}/{s.hostname}/{s.local_rank}",
+                    _json.dumps(record).encode())
+        rdv.put("elastic", "round", str(round_id).encode())
 
     driver = ElasticDriver(
         hm, spawn, lambda h: h.terminate(),
         min_num_proc=args.min_num_proc or 1,
         max_num_proc=args.max_num_proc,
-        reset_limit=args.reset_limit)
+        reset_limit=args.reset_limit,
+        publish_fn=publish)
     driver.start()
     idle_since = None
     # Stop once this many consecutive rounds ended with every worker
@@ -276,11 +379,15 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
     try:
         while True:
             driver.maybe_reset()
+            driver.reap_leaving()
             with driver._lock:
                 workers = dict(driver._workers)
             done = {r: w.handle.poll() for r, w in workers.items()}
             exited = {r: c for r, c in done.items() if c is not None}
             for r, c in exited.items():
+                print(f"elastic: worker rank={r} "
+                      f"({workers[r].slot.hostname}) exited code={c}",
+                      file=sys.stderr)
                 driver.handle_worker_exit(r, c, host_failure=(c != 0))
             if driver.consecutive_failed_rounds >= failed_round_limit:
                 print(f"elastic: {driver.consecutive_failed_rounds} "

@@ -53,10 +53,26 @@ class State:
     def sync(self) -> None:
         raise NotImplementedError
 
+    def to_host(self) -> None:
+        """Detach live values to host memory. Called by the elastic reset
+        before the JAX backend is torn down, so uncommitted state survives a
+        HostsUpdatedInterrupt (device arrays die with the backend)."""
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt if the driver announced a new round
+        (reference: State._handle_host_updates via the worker notification
+        service)."""
+        from horovod_tpu.elastic import worker as worker_mod
+        n = worker_mod.get_notifier()
+        if n is not None:
+            n.check()
+
     def commit(self) -> None:
-        """Snapshot current values (reference: State.commit =
-        save + check_host_updates)."""
+        """Snapshot current values, then surface any pending host updates
+        (reference: State.commit = save + check_host_updates,
+        common/elastic.py:117-125)."""
         self.save()
+        self.check_host_updates()
 
 
 class ObjectState(State):
@@ -86,6 +102,12 @@ class ObjectState(State):
             setattr(self, k, v)
             self._known_attrs.add(k)
         self.save()
+
+    def to_host(self) -> None:
+        for k in self._known_attrs:
+            v = getattr(self, k)
+            if _is_pytree_of_arrays(v):
+                setattr(self, k, jax.device_get(v))
 
 
 class JaxState(ObjectState):
@@ -122,6 +144,11 @@ class JaxState(ObjectState):
         if self.opt_state is not None:
             self.opt_state = broadcast_parameters(self.opt_state, root_rank=0)
         super().sync()
+
+    def to_host(self) -> None:
+        self.params = jax.device_get(self.params)
+        self.opt_state = jax.device_get(self.opt_state)
+        super().to_host()
 
 
 def _is_pytree_of_arrays(v: Any) -> bool:

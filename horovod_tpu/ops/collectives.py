@@ -46,11 +46,44 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from horovod_tpu.common import types as T
-from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HorovodTpuError)
 from horovod_tpu.core import topology
 from horovod_tpu.core.process_sets import ProcessSet, global_process_set
 
 _AXIS = "hvd"
+
+# Runtime (not trace-time) failure types: a dead peer / aborted transport
+# surfaces as one of these from XLA or the distributed client.
+try:
+    _COMM_ERRORS: tuple = (jax.errors.JaxRuntimeError,)
+except AttributeError:  # older jax spelling
+    from jax._src.lib import xla_client as _xc
+    _COMM_ERRORS = (_xc.XlaRuntimeError,)
+
+
+def _execute(fn: Callable, *args):
+    """Run a compiled collective with failure propagation.
+
+    Reference: op failures flow error Status → entry callbacks → frontends
+    raise HorovodInternalError (SURVEY §5; common/operations.cc callbacks,
+    elastic NCCL abort in nccl_operations.cc). Here: in elastic mode we
+    force completion so a peer death surfaces HERE — inside the elastic
+    retry scope — as HorovodInternalError, instead of as a raw
+    XlaRuntimeError at some later readback the retry loop can't catch.
+    Non-elastic runs keep fully async dispatch and raw errors.
+    """
+    elastic = topology.raw_state().config.elastic
+    try:
+        out = fn(*args)
+        if elastic:
+            jax.block_until_ready(out)
+        return out
+    except _COMM_ERRORS as e:
+        if elastic:
+            raise HorovodInternalError(
+                f"collective execution failed: {e}") from e
+        raise
 
 
 # --------------------------------------------------------------------------
@@ -244,7 +277,7 @@ def allreduce(tensor: Any,
     fn = _cache.get_or_build(key, lambda: _builder_allreduce(
         ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
     _timeline_span(name or "allreduce", "ALLREDUCE")
-    return _from_global(fn(g), stacked)
+    return _from_global(_execute(fn, g), stacked)
 
 
 def grouped_allreduce(tensors: Sequence[Any],
@@ -293,7 +326,7 @@ def grouped_allreduce(tensors: Sequence[Any],
 
     fn = _cache.get_or_build(key, build)
     _timeline_span(name or "grouped_allreduce", "ALLREDUCE")
-    outs = fn(*gs)
+    outs = _execute(fn, *gs)
     return [_from_global(o, s) for o, s in zip(outs, stackeds)]
 
 
@@ -321,7 +354,7 @@ def broadcast(tensor: Any, root_rank: int,
 
     fn = _cache.get_or_build(key, build)
     _timeline_span(name or "broadcast", "BROADCAST")
-    return _from_global(fn(g), stacked)
+    return _from_global(_execute(fn, g), stacked)
 
 
 def allgather(tensor: Any, name: Optional[str] = None,
@@ -379,7 +412,7 @@ def allgather(tensor: Any, name: Optional[str] = None,
         key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.cache_token)
     fn = _cache.get_or_build(key, build)
     _timeline_span(name or "allgather", "ALLGATHER")
-    return _from_global(fn(g), stacked)
+    return _from_global(_execute(fn, g), stacked)
 
 
 def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
@@ -443,7 +476,7 @@ def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
 
     fn = _cache.get_or_build(key, build)
     _timeline_span(name or "reducescatter", "REDUCESCATTER")
-    out = fn(g)
+    out = _execute(fn, g)
     if even:
         return _from_global(out, stacked)
     # Trim each rank's padded slice to its true size.
@@ -538,7 +571,7 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
 
     fn = _cache.get_or_build(key, build)
     _timeline_span(name or "alltoall", "ALLTOALL")
-    out = fn(g)  # (k_local_rows, k, max_chunk, *rest)
+    out = _execute(fn, g)  # (k_local_rows, k, max_chunk, *rest)
 
     def trim(rank_in_set: int, rowdata):
         pieces = [rowdata[i, : int(splits_matrix[i, rank_in_set])]
@@ -580,7 +613,7 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     # what the stall inspector watches (reference: stall_inspector.cc).
     _stall_submit("barrier")
     try:
-        jax.block_until_ready(fn(g))
+        jax.block_until_ready(_execute(fn, g))
     finally:
         _stall_done("barrier")
 
@@ -593,6 +626,10 @@ def synchronize(handle: Any) -> Any:
     _stall_submit("synchronize")
     try:
         return jax.block_until_ready(handle)
+    except _COMM_ERRORS as e:
+        if topology.raw_state().config.elastic:
+            raise HorovodInternalError(f"synchronize failed: {e}") from e
+        raise
     finally:
         _stall_done("synchronize")
 
@@ -661,9 +698,14 @@ def _exchange_rows(my_row: np.ndarray, ps: ProcessSet) -> np.ndarray:
     # Host readback blocks until every rank contributed — stall watchpoint.
     _stall_submit("exchange_rows")
     try:
-        out = fn(g)
+        out = _execute(fn, g)
         shard = out.addressable_shards[0].data[0]
         return np.asarray(shard)
+    except _COMM_ERRORS as e:
+        if topology.raw_state().config.elastic:
+            raise HorovodInternalError(
+                f"size exchange failed: {e}") from e
+        raise
     finally:
         _stall_done("exchange_rows")
 

@@ -1,0 +1,110 @@
+"""Elastic end-to-end worker (driven by tests/test_elastic_e2e.py).
+
+The repo's analog of the reference's test/integration/elastic_common.py
+training scripts: a real elastic job on localhost whose host set changes
+mid-run. Asserts the defining property of Horovod elastic — in-memory state
+survives a resize because surviving workers are NOT restarted (reference:
+runner/elastic/driver.py:240 preserves running workers;
+common/elastic.py:151 retry loop).
+
+Protocol with the test:
+- WORKER_BOOT is printed exactly once per process start, so the test can
+  prove survivors were not respawned.
+- rank 0 appends one line per committed step to ELASTIC_PROGRESS_FILE so
+  the test knows when to rewrite the discovery file.
+- Each worker prints RESIZED old=<n> new=<n> step=<s> after re-joining a
+  round, and ELASTIC_DONE rank=<r> size=<n> step=<s> w=<val> on success.
+
+Modes (argv[1]):
+  resize  — run until TOTAL_STEPS; the test shrinks/grows the host set
+            mid-run.
+  crash   — the worker on CRASH_HOSTNAME exits(7) at step CRASH_STEP in
+            round 1; survivors must recover from the last commit via
+            HorovodInternalError -> restore -> re-rendezvous.
+"""
+
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "12"))
+STEP_SLEEP = float(os.environ.get("ELASTIC_STEP_SLEEP", "0.3"))
+# In resize mode, steps pause here until the host change arrives, so the
+# job cannot finish before the test's mid-run rewrite takes effect.
+WAIT_STEP = int(os.environ.get("ELASTIC_WAIT_STEP", "8"))
+PROGRESS_FILE = os.environ.get("ELASTIC_PROGRESS_FILE", "")
+CRASH_HOSTNAME = os.environ.get("ELASTIC_CRASH_HOSTNAME", "")
+CRASH_STEP = int(os.environ.get("ELASTIC_CRASH_STEP", "5"))
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "resize"
+    my_host = os.environ.get("HOROVOD_HOSTNAME", "?")
+    boot_round = os.environ.get("HOROVOD_ELASTIC_ROUND", "0")
+    print(f"WORKER_BOOT host={my_host} local_rank="
+          f"{os.environ.get('HOROVOD_LOCAL_RANK')} round={boot_round}",
+          flush=True)
+
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    state = hvd.elastic.JaxState(
+        params={"w": jnp.zeros((4,), jnp.float32)}, step=0)
+    # A worker that joins after round 1 was born resized — it must not
+    # wait at WAIT_STEP or it would stall the survivors' collectives.
+    sizes_seen = {"last": hvd.size(), "resized": boot_round != "1"}
+
+    @hvd.elastic.run
+    def train(st):
+        while st.step < TOTAL_STEPS:
+            now = hvd.size()
+            if now != sizes_seen["last"]:
+                print(f"RESIZED old={sizes_seen['last']} new={now} "
+                      f"step={st.step}", flush=True)
+                sizes_seen["last"] = now
+                sizes_seen["resized"] = True
+            if (mode == "resize" and st.step >= WAIT_STEP
+                    and not sizes_seen["resized"]):
+                # Hold at a committed point until the driver's next round
+                # (raised as HostsUpdatedInterrupt from check_host_updates).
+                st.check_host_updates()
+                time.sleep(0.1)
+                continue
+            # One "training step": allreduce a per-rank gradient; every
+            # rank adds exactly 1.0 to w per step regardless of world size,
+            # so w == step at all times if and only if state survived.
+            g = hvd.allreduce(np.ones((4,), np.float32), op="sum")
+            st.params = {"w": st.params["w"] + np.asarray(g) / now}
+            st.step += 1
+            if (mode == "crash" and my_host == CRASH_HOSTNAME
+                    and st.step == CRASH_STEP
+                    and os.environ.get("HOROVOD_ELASTIC_ROUND") == "1"):
+                print(f"CRASHING host={my_host} step={st.step}", flush=True)
+                sys.stdout.flush()
+                os._exit(7)
+            st.commit()
+            if hvd.rank() == 0 and PROGRESS_FILE:
+                with open(PROGRESS_FILE, "a") as f:
+                    f.write(f"{st.step}\n")
+            time.sleep(0.15)
+        return st.step
+
+    final = train(state)
+    w = float(np.asarray(state.params["w"])[0])
+    print(f"ELASTIC_DONE rank={hvd.rank()} size={hvd.size()} "
+          f"step={final} w={w:.3f}", flush=True)
+    assert final == TOTAL_STEPS
+    assert abs(w - TOTAL_STEPS) < 1e-3, f"state lost: w={w} != {TOTAL_STEPS}"
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
