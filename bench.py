@@ -1,20 +1,20 @@
-"""Headline benchmark: ResNet-50 synthetic training throughput (images/sec).
+"""Headline benchmarks: ResNet-50 + transformer-LM synthetic training.
 
-Mirrors the reference's synthetic benchmark
-(examples/pytorch/pytorch_synthetic_benchmark.py — ResNet-50, random data,
-images/sec; docs/benchmarks.rst reproduction recipe). Runs on whatever
-devices are visible (the driver provides one real TPU chip) through the
-framework's own data-parallel train-step path: gradients bucketed and
-psum'd inside one compiled XLA program (optim/optimizer.py).
+Mirrors the reference's synthetic benchmark recipe
+(examples/pytorch/pytorch_synthetic_benchmark.py — random data, images/sec;
+docs/benchmarks.rst:15-42) and extends it with the proof the reference never
+gives: **MFU** (model FLOPs ÷ chip peak), a per-chip batch sweep, and a
+fusion-threshold sweep on the eager grouped-allreduce path.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline compares images/sec/chip against the reference's published
-per-GPU throughput, 1656.8/16 ≈ 103.55 images/sec (ResNet-101,
-tf_cnn_benchmarks, 4×4 Pascal P100 — docs/benchmarks.rst:40-42; the closest
-published absolute number in the reference tree, see BASELINE.md).
+Both models run through the framework's own data-parallel train-step path
+(gradients psum'd inside one compiled XLA program). Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", "extra": {...}} — the headline
+stays the ResNet-50 images/sec/chip for round-over-round comparability;
+everything else rides in "extra".
 """
 
 import json
+import os
 import time
 
 import jax
@@ -27,23 +27,52 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import horovod_tpu as hvd
 from horovod_tpu.core import topology
 from horovod_tpu.models import resnet
+from horovod_tpu.models import transformer as tfm
 from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
+from horovod_tpu.parallel.mesh import MeshSpec, build_mesh
 
 BASELINE_PER_CHIP = 1656.8 / 16  # images/sec/GPU, reference docs/benchmarks.rst:40-42
 
+# Peak dense bf16 TFLOP/s per chip by device kind (public specs). The
+# tunnel to this image's chip measures ~157 TFLOP/s on an 8k matmul, so
+# MFU against the spec peak is conservative.
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5litepod": 197.0,
+    "TPU v5": 459.0, "TPU v5p": 459.0, "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
 
-def main():
-    hvd.init()
-    mesh = topology.mesh()
-    k = hvd.size()
-    on_cpu = jax.devices()[0].platform == "cpu"
 
-    # Per-chip batch 128 bf16 on TPU; tiny smoke config on CPU.
-    per_chip = 8 if on_cpu else 128
+def peak_flops_per_chip():
+    env = os.environ.get("HOROVOD_BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = jax.devices()[0].device_kind
+    for name, tf in _PEAK_TFLOPS.items():
+        if kind.startswith(name):
+            return tf * 1e12
+    return None  # unknown chip / CPU: omit MFU
+
+
+def _timed_steps(step_fn, state, steps):
+    """Run `steps` iterations; completion forced by a host readback of the
+    final loss (through the remote-device tunnel, block_until_ready can
+    return before compute finishes, but a D2H transfer cannot)."""
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step_fn(state)
+    float(np.asarray(state[-1]).ravel()[0])
+    return time.perf_counter() - t0, state
+
+
+# --------------------------------------------------------------------------
+# ResNet-50 (the reference's own headline model)
+# --------------------------------------------------------------------------
+
+def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup):
     img = 32 if on_cpu else 224
-    steps, warmup = (3, 1) if on_cpu else (30, 5)
-    batch = per_chip * k
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
+    batch = per_chip_batch * k
 
     params, stats = resnet.init(jax.random.PRNGKey(0), depth=50,
                                 num_classes=1000, dtype=dtype)
@@ -73,28 +102,165 @@ def main():
         NamedSharding(mesh, P("hvd")))
     labels = jax.device_put(rng.integers(0, 1000, (batch,)),
                             NamedSharding(mesh, P("hvd")))
-    data = (images, labels)
 
-    # NOTE: completion is forced by a host readback of the final loss —
-    # through the remote-device tunnel, block_until_ready can return before
-    # compute finishes, but a D2H transfer cannot.
-    for _ in range(warmup):
-        params, stats, opt_state, l = step(params, stats, opt_state, data)
-    float(l)
+    def run(state):
+        p, s, o, _l = state[0], state[1], state[2], None
+        p, s, o, l = step(p, s, o, (images, labels))
+        return (p, s, o, l)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, stats, opt_state, l = step(params, stats, opt_state, data)
-    float(l)
-    dt = time.perf_counter() - t0
+    state = (params, stats, opt_state, jnp.zeros(()))
+    _, state = _timed_steps(run, state, warmup)
+    dt, state = _timed_steps(run, state, steps)
 
     ips = batch * steps / dt
-    per_chip_ips = ips / k
+    # Training FLOPs ≈ 3× forward (fwd + 2×bwd); ResNet-50 fwd @224 ≈
+    # 4.1 GFLOP/image (torchvision profile) → 12.3 GFLOP/image-step.
+    flops_per_img = 12.3e9 if not on_cpu else None
+    return {
+        "images_per_sec_per_chip": round(ips / k, 2),
+        "per_chip_batch": per_chip_batch,
+        "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        "step_ms": round(dt / steps * 1e3, 2),
+        "model_flops_per_image": flops_per_img,
+    }
+
+
+# --------------------------------------------------------------------------
+# Transformer LM (the framework flagship; MXU-bound)
+# --------------------------------------------------------------------------
+
+def bench_transformer(on_cpu, steps, warmup):
+    if on_cpu:
+        cfg = tfm.TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                    d_ff=256, n_layers=2, max_seq=128,
+                                    attn="local")
+        batch, seq = 2, 64
+    else:
+        cfg = tfm.TransformerConfig(vocab=32768, d_model=2048, n_heads=16,
+                                    d_ff=8192, n_layers=12, max_seq=1024,
+                                    attn="local", dtype=jnp.bfloat16,
+                                    remat=True)
+        batch, seq = 8, 1024
+    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    params = tfm.shard_params(tfm.init(jax.random.PRNGKey(0), cfg), cfg, mesh)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    step = tfm.build_train_step(cfg, mesh, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
+                                0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def run(state):
+        p, o, _ = state
+        p, o, l = step(p, o, tokens, targets)
+        return (p, o, l)
+
+    state = (params, opt_state, jnp.zeros(()))
+    _, state = _timed_steps(run, state, warmup)
+    dt, state = _timed_steps(run, state, steps)
+
+    # Analytical model FLOPs (the standard 6N + attention accounting):
+    # matmul params (non-embedding) N ≈ layers·(4·D² attn + 2·D·F ffn),
+    # fwd+bwd ≈ 6·N per token; attention scores+values fwd+bwd ≈
+    # 12·L·S·D per token (causal halves it → 6·L·S·D).
+    D, F, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    n_matmul = L * (4 * D * D + 2 * D * F)
+    flops_tok = 6 * n_matmul + 6 * L * seq * D + 6 * D * V  # + unembed
+    toks = batch * seq
+    tps = toks * steps / dt
+    return {
+        "tokens_per_sec_per_chip": round(tps, 1),
+        "config": f"L{L} D{D} F{F} H{cfg.n_heads} S{seq} B{batch} "
+                  f"V{V} bf16",
+        "step_ms": round(dt / steps * 1e3, 2),
+        "model_flops_per_token": flops_tok,
+        "params_m": round((n_matmul + 2 * D * V) / 1e6, 1),
+    }
+
+
+# --------------------------------------------------------------------------
+# Fusion-threshold sweep on the eager grouped-allreduce path
+# --------------------------------------------------------------------------
+
+def bench_fusion_sweep(on_cpu):
+    """Grouped allreduce of a ResNet-50-like gradient set at several fusion
+    thresholds (reference knob: HOROVOD_FUSION_THRESHOLD, tensor-fusion.rst).
+    On one chip this measures the fusion machinery's dispatch/concat cost;
+    multi-chip runs ride the same code path."""
+    sizes = [(1000, 2048), (2048,)] + [(512, 512, 3, 3)] * 8 + \
+        [(256, 256, 3, 3)] * 8 + [(512,)] * 30 + [(256,)] * 30
+    if on_cpu:
+        sizes = sizes[:6]
+    tensors = [jnp.ones(s, jnp.float32) for s in sizes]
+    out = {}
+    cfg = topology.raw_state().config
+    orig = cfg.fusion_threshold_bytes
+    try:
+        for mb in (1, 16, 64):
+            cfg.fusion_threshold_bytes = mb * 1024 * 1024
+            from horovod_tpu.ops.collectives import clear_compiled_cache
+            clear_compiled_cache()
+            outs = hvd.grouped_allreduce(tensors, op="sum")  # compile
+            jax.block_until_ready(outs)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                outs = hvd.grouped_allreduce(tensors, op="sum")
+            jax.block_until_ready(outs)
+            float(np.asarray(outs[0]).ravel()[0])
+            out[f"{mb}MB_ms"] = round((time.perf_counter() - t0) / 5 * 1e3, 2)
+    finally:
+        cfg.fusion_threshold_bytes = orig
+    return out
+
+
+def main():
+    hvd.init()
+    mesh = topology.mesh()
+    k = hvd.size()
+    on_cpu = jax.devices()[0].platform == "cpu"
+    peak = peak_flops_per_chip()
+
+    # --- ResNet-50: per-chip batch sweep, report the best ---
+    batches = (8,) if on_cpu else (128, 256)
+    steps, warmup = (3, 1) if on_cpu else (30, 5)
+    sweep = {}
+    best = None
+    for b in batches:
+        r = bench_resnet(mesh, k, on_cpu, b, steps, warmup)
+        sweep[f"batch_{b}"] = r["images_per_sec_per_chip"]
+        if best is None or r["images_per_sec_per_chip"] > \
+                best["images_per_sec_per_chip"]:
+            best = r
+    if peak and best["model_flops_per_image"]:
+        best["mfu"] = round(
+            best["images_per_sec_per_chip"] * best["model_flops_per_image"]
+            / peak, 4)
+    best["batch_sweep"] = sweep
+
+    # --- Transformer LM ---
+    t_steps, t_warmup = (2, 1) if on_cpu else (20, 3)
+    tr = bench_transformer(on_cpu, t_steps, t_warmup)
+    if peak:
+        tr["mfu"] = round(
+            tr["tokens_per_sec_per_chip"] * tr["model_flops_per_token"]
+            / peak, 4)
+
+    fusion = bench_fusion_sweep(on_cpu)
+
+    per_chip_ips = best["images_per_sec_per_chip"]
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
-        "value": round(per_chip_ips, 2),
+        "value": per_chip_ips,
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip_ips / BASELINE_PER_CHIP, 3),
+        "extra": {
+            "peak_tflops_per_chip": peak / 1e12 if peak else None,
+            "device": jax.devices()[0].device_kind,
+            "num_chips": k,
+            "resnet50": best,
+            "transformer_lm": tr,
+            "fusion_sweep_grouped_allreduce": fusion,
+        },
     }))
 
 

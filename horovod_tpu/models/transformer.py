@@ -53,6 +53,11 @@ class TransformerConfig:
     attn: str = "ring"            # "ring" | "ulysses" | "local"
     microbatches: int = 1         # pipeline microbatches (≥ pp size ideal)
     dtype: Any = jnp.float32
+    # Rematerialize each layer in backward instead of saving residuals
+    # (notably the (B,H,S,S) attention matrices the layer scan would
+    # otherwise stack L-deep in HBM) — the standard TPU FLOPs-for-memory
+    # trade (jax.checkpoint; HBM is the usual bottleneck).
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -211,6 +216,13 @@ def _forward_local(params, tokens, cfg: TransformerConfig) -> jax.Array:
     def stage_fn(stage_params, act):
         def body(a, lp):
             return _layer(a, lp, cfg), None
+        if cfg.remat:
+            # Save projection/FFN matmul outputs (small, expensive to
+            # recompute); recompute batched-dot products — exactly the
+            # (B,H,S,S) attention matrices that blow up HBM.
+            body = jax.checkpoint(
+                body, prevent_cse=False,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
         out, _ = lax.scan(body, act, stage_params)
         return out
 
