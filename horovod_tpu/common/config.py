@@ -69,7 +69,6 @@ HOROVOD_DYNAMIC_PROCESS_SETS = "HOROVOD_DYNAMIC_PROCESS_SETS"
 HOROVOD_DISABLE_GROUP_FUSION = "HOROVOD_DISABLE_GROUP_FUSION"
 HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
 HOROVOD_ENABLE_ASYNC_COMPLETION = "HOROVOD_ENABLE_ASYNC_COMPLETION"
-HOROVOD_NUM_RANKS_PER_CHIP = "HOROVOD_NUM_RANKS_PER_CHIP"
 
 # Topology / launcher knobs (reference: injected by the launcher,
 # horovod/runner/gloo_run.py:69-75).

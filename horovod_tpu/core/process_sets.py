@@ -156,11 +156,26 @@ def _table() -> ProcessSetTable:
     return t
 
 
+def _require_dynamic() -> None:
+    """Post-init set mutation requires HOROVOD_DYNAMIC_PROCESS_SETS=1, the
+    reference's contract (operations.cc:771-788: dynamic registration is
+    coordinated in the background loop only when the knob is on; otherwise
+    add_process_set after init raises)."""
+    from horovod_tpu.core import topology
+    if not topology.state().config.dynamic_process_sets:
+        raise HorovodTpuError(
+            "adding/removing process sets after hvd.init() requires "
+            "HOROVOD_DYNAMIC_PROCESS_SETS=1 (reference: "
+            "horovod/common/process_sets.py:123 dynamic requirement); "
+            "alternatively pass process_sets=[...] to hvd.init()")
+
+
 def add_process_set(ranks_or_ps) -> ProcessSet:
     """Register a new process set after init (reference process_sets.py:123).
 
     In multi-process mode all processes must call this with identical ranks.
     """
+    _require_dynamic()
     ps = ranks_or_ps if isinstance(ranks_or_ps, ProcessSet) else ProcessSet(
         ranks_or_ps)
     _table().register(ps)
@@ -169,6 +184,7 @@ def add_process_set(ranks_or_ps) -> ProcessSet:
 
 def remove_process_set(ps: ProcessSet) -> None:
     """Deregister (reference process_sets.py:145)."""
+    _require_dynamic()
     _table().remove(ps)
 
 

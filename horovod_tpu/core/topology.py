@@ -58,6 +58,9 @@ class _GlobalState:
         self.process_index: int = 0
         self.num_processes: int = 1
         self.lock = threading.RLock()
+        # 2-axis ("dcn","ici") view of the same devices for hierarchical
+        # collectives (HOROVOD_TPU_MESH_SHAPE); None = flat world.
+        self.hier_mesh: Optional[Mesh] = None
         # Set lazily by sibling modules to avoid import cycles.
         self.process_set_table = None
         self.timeline = None
@@ -264,6 +267,8 @@ def init(process_sets: Optional[Sequence] = None,
         _state.devices = devs
         _state.size = len(devs)
         _state.mesh = Mesh(np.asarray(devs), (_AXIS,))
+        if cfg.mesh_shape:
+            _state.hier_mesh = _build_hier_mesh(cfg.mesh_shape, devs)
 
         pidx = jax.process_index()
         pcount = jax.process_count()
@@ -295,6 +300,25 @@ def init(process_sets: Optional[Sequence] = None,
             for ps in process_sets:
                 _state.process_set_table.register(ps)
 
+        if cfg.timeline_path:
+            # Reference: HOROVOD_TIMELINE auto-starts capture at init
+            # (operations.cc:531); manual hvd.start_timeline also works.
+            try:
+                from horovod_tpu.profiler.timeline import Timeline
+                _state.timeline = Timeline(
+                    cfg.timeline_path, mark_cycles=cfg.timeline_mark_cycles)
+                _state.timeline.start()
+            except Exception as e:
+                from horovod_tpu.common.hvd_logging import get_logger
+                get_logger().warning("could not start timeline at %s: %s",
+                                     cfg.timeline_path, e)
+        if cfg.cycle_time_ms > 0.0:
+            from horovod_tpu.common.hvd_logging import get_logger
+            get_logger().info(
+                "HOROVOD_CYCLE_TIME=%.1fms accepted but has no effect on "
+                "TPU: collectives are compiled into the XLA program, so "
+                "there is no background cycle to batch against "
+                "(reference: operations.cc RunLoopOnce)", cfg.cycle_time_ms)
         if cfg.autotune:
             from horovod_tpu.core.autotune import ParameterManager
             _state.parameter_manager = ParameterManager(cfg)
@@ -318,6 +342,42 @@ def init(process_sets: Optional[Sequence] = None,
         # after the flag flips or it exits on its first slice.
         if _state.stall_inspector is not None:
             _start_stall_watch(_state.stall_inspector, cfg)
+
+
+def _build_hier_mesh(spec: str, devs: Sequence[jax.Device]) -> Mesh:
+    """Parse HOROVOD_TPU_MESH_SHAPE ("dcn:2,ici:4" or "2x4") into a
+    2-axis ("dcn","ici") mesh over the same devices in the same order.
+    Reference structure: NCCLHierarchicalAllreduce's node×local split
+    (nccl_operations.cc:308) — here dcn=cross-slice, ici=within-slice.
+    """
+    axes = {"dcn": 1, "ici": 1}
+    s = spec.strip().lower()
+    try:
+        if "x" in s and ":" not in s:
+            a, b = s.split("x", 1)
+            axes["dcn"], axes["ici"] = int(a), int(b)
+        else:
+            for part in s.split(","):
+                name, n = part.split(":")
+                if name.strip() not in axes:
+                    raise ValueError(name)
+                axes[name.strip()] = int(n)
+    except (ValueError, TypeError):
+        raise HorovodTpuError(
+            f"bad HOROVOD_TPU_MESH_SHAPE '{spec}': expected 'dcn:A,ici:B' "
+            f"or 'AxB'")
+    if axes["dcn"] * axes["ici"] != len(devs):
+        raise HorovodTpuError(
+            f"HOROVOD_TPU_MESH_SHAPE '{spec}' = {axes['dcn']}x{axes['ici']} "
+            f"does not cover {len(devs)} devices")
+    return Mesh(np.asarray(devs).reshape(axes["dcn"], axes["ici"]),
+                ("dcn", "ici"))
+
+
+def hier_mesh() -> Optional[Mesh]:
+    """The ("dcn","ici") mesh when HOROVOD_TPU_MESH_SHAPE is set, else
+    None. Same devices and order as mesh() — a reshaped view."""
+    return _require_init().hier_mesh
 
 
 def _start_stall_watch(si, cfg: Config) -> None:

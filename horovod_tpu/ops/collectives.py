@@ -251,6 +251,70 @@ def _builder_allreduce(mesh: Mesh, k: int, op: T.ReduceOp,
 
 
 # --------------------------------------------------------------------------
+# Hierarchical (ici × dcn) variants
+# --------------------------------------------------------------------------
+
+_HIER_SPEC = P(("dcn", "ici"))  # dim 0 sharded over both axes, dcn-major —
+# row r lands on the same device as the flat P("hvd") layout, so inputs
+# lifted by _to_global need no resharding.
+
+
+def _hier_usable(ps: ProcessSet) -> Optional[Mesh]:
+    """The ("dcn","ici") mesh if hierarchical mode applies to this set."""
+    if ps.ranks is not None:  # sub-sets keep the flat path
+        return None
+    return topology.state().hier_mesh
+
+
+def _apply_reduce_hier(block: jax.Array, op: T.ReduceOp, k: int,
+                       k_ici: int, prescale: float,
+                       postscale: float) -> jax.Array:
+    """ReduceScatter over ici → Allreduce over dcn → Allgather over ici.
+
+    The reference's NCCLHierarchicalAllreduce structure
+    (nccl_operations.cc:308: intra-node ncclReduceScatter → cross-node
+    MPI_Allreduce → intra-node ncclAllgather), expressed as XLA
+    collectives over the two mesh axes: only 1/k_ici of the payload
+    crosses the slow dcn axis per rank.
+    """
+    x = block[0]
+    if prescale != 1.0:
+        x = x * jnp.asarray(prescale, x.dtype)
+    v = x.reshape(-1)
+    n = v.shape[0]
+    pad = -n % k_ici
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+    s = lax.psum_scatter(v, "ici", scatter_dimension=0, tiled=True)
+    s = lax.psum(s, "dcn")
+    v = lax.all_gather(s, "ici", axis=0, tiled=True)
+    if pad:
+        v = v[:n]
+    y = v.reshape(x.shape)
+    if op == T.ReduceOp.AVERAGE:
+        if jnp.issubdtype(y.dtype, jnp.integer):
+            y = y // jnp.asarray(k, y.dtype)
+        else:
+            y = y / jnp.asarray(k, y.dtype)
+    if postscale != 1.0:
+        y = y * jnp.asarray(postscale, y.dtype)
+    return y[None]
+
+
+def _builder_allreduce_hier(hmesh: Mesh, k: int, op: T.ReduceOp,
+                            prescale: float, postscale: float,
+                            donate: bool) -> Callable:
+    k_ici = hmesh.shape["ici"]
+
+    def body(block):
+        return _apply_reduce_hier(block, op, k, k_ici, prescale, postscale)
+
+    fn = jax.shard_map(body, mesh=hmesh, in_specs=_HIER_SPEC,
+                       out_specs=_HIER_SPEC, check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+# --------------------------------------------------------------------------
 # Public eager API
 # --------------------------------------------------------------------------
 
@@ -269,13 +333,23 @@ def allreduce(tensor: Any,
     match: default AVERAGE.
     """
     ps = _resolve_ps(process_set)
+    cfg = topology.state().config
     rop = _normalize_op(average, op)
+    donate = donate or cfg.donate_buffers
     g, stacked = _to_global(tensor, ps)
     k = ps.size()
+    hm = _hier_usable(ps) if (cfg.hierarchical_allreduce
+                              and rop in (T.ReduceOp.SUM,
+                                          T.ReduceOp.AVERAGE)) else None
     key = ("ar", g.shape, str(g.dtype), int(rop), ps.cache_token,
-           float(prescale_factor), float(postscale_factor), bool(donate))
-    fn = _cache.get_or_build(key, lambda: _builder_allreduce(
-        ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
+           float(prescale_factor), float(postscale_factor), bool(donate),
+           hm is not None)
+    if hm is not None:
+        fn = _cache.get_or_build(key, lambda: _builder_allreduce_hier(
+            hm, k, rop, prescale_factor, postscale_factor, donate))
+    else:
+        fn = _cache.get_or_build(key, lambda: _builder_allreduce(
+            ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
     _timeline_span(name or "allreduce", "ALLREDUCE")
     return _from_global(_execute(fn, g), stacked)
 
@@ -299,28 +373,37 @@ def grouped_allreduce(tensors: Sequence[Any],
         return []
     gs, stackeds = zip(*[_to_global(t, ps) for t in tensors])
     k = ps.size()
+    cfg = topology.state().config
+    hm = _hier_usable(ps) if (cfg.hierarchical_allreduce
+                              and rop in (T.ReduceOp.SUM,
+                                          T.ReduceOp.AVERAGE)) else None
     key = ("gar", tuple((g.shape, str(g.dtype)) for g in gs), int(rop),
            ps.cache_token, float(prescale_factor), float(postscale_factor),
-           topology.state().config.fusion_threshold_bytes,
-           topology.state().config.disable_group_fusion)
-    cfg = topology.state().config
+           cfg.fusion_threshold_bytes, cfg.disable_group_fusion,
+           hm is not None)
 
     def build() -> Callable:
         from horovod_tpu.ops import fusion
 
+        mesh_ = hm if hm is not None else ps.mesh
+        spec = _HIER_SPEC if hm is not None else P(_AXIS)
+        if hm is not None:
+            k_ici = hm.shape["ici"]
+            reduce_one = lambda b: _apply_reduce_hier(  # noqa: E731
+                b, rop, k, k_ici, prescale_factor, postscale_factor)
+        else:
+            reduce_one = lambda b: _apply_reduce(  # noqa: E731
+                b, rop, k, prescale_factor, postscale_factor)
+
         def body(*blocks):
             if cfg.disable_group_fusion or rop in (T.ReduceOp.ADASUM,):
-                return tuple(
-                    _apply_reduce(b, rop, k, prescale_factor, postscale_factor)
-                    for b in blocks)
+                return tuple(reduce_one(b) for b in blocks)
             return fusion.fused_reduce_blocks(
-                blocks, lambda b: _apply_reduce(
-                    b, rop, k, prescale_factor, postscale_factor),
-                cfg.fusion_threshold_bytes)
+                blocks, reduce_one, cfg.fusion_threshold_bytes)
 
-        fn = jax.shard_map(body, mesh=ps.mesh,
-                           in_specs=(P(_AXIS),) * len(gs),
-                           out_specs=(P(_AXIS),) * len(gs),
+        fn = jax.shard_map(body, mesh=mesh_,
+                           in_specs=(spec,) * len(gs),
+                           out_specs=(spec,) * len(gs),
                            check_vma=False)
         return jax.jit(fn)
 
@@ -377,10 +460,29 @@ def allgather(tensor: Any, name: Optional[str] = None,
     else:
         sizes = _exchange_sizes(int(g.shape[1]), ps)
     max_d0 = max(sizes) if sizes else 0
-    key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.cache_token)
+    cfg = topology.state().config
+    hm = _hier_usable(ps) if (cfg.hierarchical_allgather
+                              and len(set(sizes)) == 1) else None
+    key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.cache_token,
+           hm is not None)
 
     def build() -> Callable:
         total = sum(sizes)
+
+        if hm is not None:
+            # Even sizes: gather within the fast ici axis first, then
+            # across dcn — dcn-major rank order matches the flat layout
+            # (reference structure: hierarchical allgather,
+            # HOROVOD_HIERARCHICAL_ALLGATHER).
+            def hier_body(block):
+                x = block[0]
+                g1 = lax.all_gather(x, "ici", axis=0, tiled=True)
+                g2 = lax.all_gather(g1, "dcn", axis=0, tiled=True)
+                return g2[None]
+
+            fn = jax.shard_map(hier_body, mesh=hm, in_specs=_HIER_SPEC,
+                               out_specs=_HIER_SPEC, check_vma=False)
+            return jax.jit(fn)
 
         def body(block):
             x = block[0]  # (d0_local, *rest) — same static d0 across ranks here

@@ -42,6 +42,8 @@ def test_built_flags(hvd):
 
 
 def test_process_set_registration(hvd):
+    from horovod_tpu.core import topology
+    topology.raw_state().config.dynamic_process_sets = True
     ps = hvd.add_process_set([0, 1, 2, 3])
     assert ps.process_set_id is not None and ps.process_set_id > 0
     assert ps.size() == 4
@@ -56,3 +58,12 @@ def test_process_set_registration(hvd):
 
 def hvd_error(hvd):
     return hvd.HorovodTpuError
+
+
+def test_dynamic_process_sets_gate(hvd):
+    """add/remove after init requires HOROVOD_DYNAMIC_PROCESS_SETS
+    (reference: process_sets.py:123 dynamic contract)."""
+    from horovod_tpu.core import topology
+    topology.raw_state().config.dynamic_process_sets = False
+    with pytest.raises(hvd_error(hvd)):
+        hvd.add_process_set([0, 1])

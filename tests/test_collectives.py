@@ -189,7 +189,13 @@ def test_synchronize_returns_value(hvd):
 
 
 # ------------------------------------------------------------ process sets
+def _enable_dynamic():
+    from horovod_tpu.core import topology
+    topology.raw_state().config.dynamic_process_sets = True
+
+
 def test_allreduce_process_set(hvd):
+    _enable_dynamic()
     ps = hvd.add_process_set([0, 2, 4, 6])
     x = np.arange(4 * 3, dtype=np.float32).reshape(4, 3) + 1
     out = np.asarray(hvd.allreduce(x, op=hvd_mod.Sum, process_set=ps))
@@ -198,6 +204,7 @@ def test_allreduce_process_set(hvd):
 
 
 def test_broadcast_process_set(hvd):
+    _enable_dynamic()
     ps = hvd.add_process_set([1, 3, 5])
     x = stacked(hvd, (2,))[:3]
     out = np.asarray(hvd.broadcast(x, root_rank=3, process_set=ps))

@@ -1,0 +1,100 @@
+"""Hierarchical (ici × dcn) collective tests.
+
+Reference: NCCLHierarchicalAllreduce (nccl_operations.cc:308 — intra-node
+ReduceScatter → cross-node Allreduce → intra-node Allgather) and
+HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER knobs. Here the 8-device mesh is
+viewed as dcn:2 × ici:4; numerics must match the flat path exactly and the
+compiled program must actually contain the RS/AR/AG decomposition.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.core import topology
+
+
+@pytest.fixture()
+def hier(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TPU_MESH_SHAPE", "dcn:2,ici:4")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "1")
+    hvd_mod.init()
+    yield hvd_mod
+    hvd_mod.shutdown()
+
+
+def stacked(hvd, shape):
+    k = hvd.size()
+    return np.arange(int(np.prod((k,) + shape)), dtype=np.float32).reshape(
+        (k,) + shape) + 1.0
+
+
+def test_mesh_shape_parsed(hier):
+    hm = topology.hier_mesh()
+    assert hm is not None
+    assert dict(hm.shape) == {"dcn": 2, "ici": 4}
+    # Same devices, same (flat) order as the 1-D mesh.
+    assert list(hm.devices.flat) == list(topology.mesh().devices.flat)
+
+
+def test_bad_mesh_shape_raises(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TPU_MESH_SHAPE", "dcn:3,ici:3")
+    with pytest.raises(hvd_mod.HorovodTpuError):
+        hvd_mod.init()
+    hvd_mod.shutdown()
+
+
+def test_hierarchical_allreduce_matches_flat(hier):
+    x = stacked(hier, (5, 3))
+    out = np.asarray(hier.allreduce(x, op=ReduceOp.SUM))
+    np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-5)
+    avg = np.asarray(hier.allreduce(x))  # AVERAGE default
+    np.testing.assert_allclose(avg[0], x.mean(axis=0), rtol=1e-5)
+
+
+def test_hierarchical_allreduce_odd_sizes(hier):
+    # Payload not divisible by ici=4: exercises the pad/unpad path.
+    x = stacked(hier, (7,))
+    out = np.asarray(hier.allreduce(x, op=ReduceOp.SUM))
+    np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-5)
+
+
+def test_hierarchical_grouped_allreduce(hier):
+    xs = [stacked(hier, (4, 2)), stacked(hier, (3,)), stacked(hier, (5,))]
+    outs = hier.grouped_allreduce(xs, op=ReduceOp.SUM)
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o)[0], x.sum(axis=0),
+                                   rtol=1e-5)
+
+
+def test_hierarchical_allgather(hier):
+    x = stacked(hier, (2, 3))
+    out = np.asarray(hier.allgather(x))
+    expect = x.reshape(-1, 3)
+    np.testing.assert_allclose(out[0], expect)
+
+
+def test_hierarchical_program_contains_decomposition(hier):
+    """The knob must change the compiled program: reduce-scatter +
+    all-gather over the ici sub-axis instead of one global all-reduce."""
+    from horovod_tpu.ops import collectives as C
+    hm = topology.hier_mesh()
+    fn = C._builder_allreduce_hier(hm, 8, ReduceOp.SUM, 1.0, 1.0, False)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    g = jax.device_put(np.ones((8, 16), np.float32),
+                       NamedSharding(hm, P(("dcn", "ici"))))
+    hlo = fn.lower(g).compile().as_text()
+    assert "reduce-scatter" in hlo
+    assert "all-gather" in hlo
+    assert "all-reduce" in hlo  # the dcn-axis cross-group reduce
+
+
+def test_min_max_fall_back_to_flat(hier):
+    # Hierarchy covers SUM/AVERAGE; MIN/MAX must still be correct (flat).
+    x = stacked(hier, (4,))
+    out = np.asarray(hier.allreduce(x, op=ReduceOp.MAX))
+    np.testing.assert_allclose(out[0], x.max(axis=0))
