@@ -69,6 +69,7 @@ HOROVOD_DYNAMIC_PROCESS_SETS = "HOROVOD_DYNAMIC_PROCESS_SETS"
 HOROVOD_DISABLE_GROUP_FUSION = "HOROVOD_DISABLE_GROUP_FUSION"
 HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
 HOROVOD_ENABLE_ASYNC_COMPLETION = "HOROVOD_ENABLE_ASYNC_COMPLETION"
+HOROVOD_CONSISTENCY_CHECK = "HOROVOD_CONSISTENCY_CHECK"
 
 # Topology / launcher knobs (reference: injected by the launcher,
 # horovod/runner/gloo_run.py:69-75).
@@ -128,6 +129,9 @@ class Config:
 
     # Modes
     elastic: bool = False
+    # Debug negotiation: agree cross-rank on every eager collective's
+    # signature before running it (core/consistency.py).
+    consistency_check: bool = False
     dynamic_process_sets: bool = False
 
     # Topology overrides (launcher-injected)
@@ -175,6 +179,7 @@ class Config:
                 HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECONDS),
             stall_shutdown_seconds=_env_float(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, 0.0),
             elastic=_env_bool(HOROVOD_ELASTIC),
+            consistency_check=_env_bool(HOROVOD_CONSISTENCY_CHECK),
             dynamic_process_sets=_env_bool(HOROVOD_DYNAMIC_PROCESS_SETS),
             rank=opt_int(HOROVOD_RANK),
             size=opt_int(HOROVOD_SIZE),

@@ -1,0 +1,155 @@
+"""Cross-rank collective consistency checking (debug negotiation).
+
+Reference: the coordinator validates that every rank submitted the same
+named tensor with matching shape/dtype/device before constructing a
+response (horovod/common/controller.cc:74-447 ConstructResponse mismatch
+checks), and its cache fast path collapses agreement testing to two
+bitvector all-reductions (CrossRankBitwiseAnd/Or, controller.cc:159-190).
+
+TPU redesign: the SPMD contract makes per-op negotiation unnecessary for
+correctness — every process must issue identical collectives in identical
+order — but a VIOLATION of that contract is an undiagnosable deadlock.
+With HOROVOD_CONSISTENCY_CHECK=1, every eager collective first agrees on a
+16-byte signature hash through the native KV store's bitwise AND/OR +
+counted-get ops (native/src/kv_store.cc — the same two-combine pattern as
+the reference's cache coordination):
+
+  fast path   : every rank ORs and ANDs its hash; when all k arrived and
+                OR == AND == own hash, everyone agreed. Two tiny KV ops.
+  mismatch    : ranks publish their full signatures and everyone raises
+                TensorShapeMismatchError naming each rank's call.
+  missing rank: the counted-get times out; presence keys name exactly
+                which ranks never issued the collective — the
+                coordinator-side stall answer (reference:
+                stall_inspector.cc reports uncommitted ranks).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import List, Optional
+
+from horovod_tpu.common.exceptions import (HorovodTpuError,
+                                           TensorShapeMismatchError)
+
+_checker: Optional["ConsistencyChecker"] = None
+
+
+class ConsistencyChecker:
+    def __init__(self, client, rank: int, size: int,
+                 timeout: float = 60.0):
+        self._kv = client
+        self.rank = rank
+        self.size = size
+        self.timeout = timeout
+        self._seq = 0
+
+    # ------------------------------------------------------------------ api
+    def check(self, desc: str) -> None:
+        """Agree with every rank that collective #seq is `desc`.
+
+        Raises TensorShapeMismatchError on disagreement (naming ranks) and
+        HorovodTpuError on timeout (naming the ranks that never arrived).
+        """
+        seq = self._seq
+        self._seq += 1
+        h = hashlib.sha256(desc.encode()).digest()[:16]
+        self._kv.put(f"cc/seen/{seq}/{self.rank}", b"1")
+        self._kv.bitwise(f"cc/or/{seq}", h, op="or")
+        self._kv.bitwise(f"cc/and/{seq}", h, op="and")
+        combined_or = self._kv.get_when(f"cc/or/{seq}", expected=self.size,
+                                        timeout=self.timeout)
+        if combined_or is None:
+            missing = self._missing(seq)
+            raise HorovodTpuError(
+                f"consistency check timed out for collective #{seq} "
+                f"('{desc}'): rank(s) {missing} never issued it within "
+                f"{self.timeout:.0f}s — every process must run the same "
+                f"collectives in the same order (reference: "
+                f"controller.cc stall/mismatch detection)")
+        combined_and = self._kv.get_when(f"cc/and/{seq}", expected=self.size,
+                                         timeout=self.timeout)
+        if combined_or == h and combined_and == h:
+            return
+        # Disagreement: publish details, gather, raise a naming diagnostic.
+        self._kv.put(f"cc/detail/{seq}/{self.rank}", desc.encode())
+        deadline = time.monotonic() + self.timeout
+        details: List[str] = []
+        for r in range(self.size):
+            data = None
+            while time.monotonic() < deadline:
+                data = self._kv.get(f"cc/detail/{seq}/{r}")
+                if data is not None:
+                    break
+                time.sleep(0.01)
+            details.append(f"  rank {r}: "
+                           f"{data.decode() if data else '<no response>'}")
+        raise TensorShapeMismatchError(
+            f"ranks disagree on collective #{seq} (reference: "
+            f"controller.cc ConstructResponse mismatch checks):\n"
+            + "\n".join(details))
+
+    def _missing(self, seq: int) -> List[int]:
+        return [r for r in range(self.size)
+                if self._kv.get(f"cc/seen/{seq}/{r}") is None]
+
+    def lagging_ranks(self) -> List[int]:
+        """Ranks that have not reached this process's last collective —
+        surfaced in stall warnings so the report is coordinator-aware
+        (reference: stall_inspector.cc names uncommitted ranks)."""
+        if self._seq == 0:
+            return []
+        try:
+            return self._missing(self._seq - 1)
+        except Exception:
+            return []
+
+    def close(self) -> None:
+        try:
+            self._kv.close()
+        except Exception:
+            pass
+
+
+def maybe_init(cfg, rank: int, size: int) -> Optional[ConsistencyChecker]:
+    """Build the process-wide checker from launcher-injected env.
+
+    Requires the native KV server the launcher starts
+    (HOROVOD_NATIVE_KV_ADDR/PORT); logs and disables otherwise.
+    """
+    global _checker
+    if _checker is not None:
+        return _checker
+    if size <= 1:
+        return None
+    addr = os.environ.get("HOROVOD_NATIVE_KV_ADDR", "")
+    port = int(os.environ.get("HOROVOD_NATIVE_KV_PORT", "0") or 0)
+    from horovod_tpu.common.hvd_logging import get_logger
+    if not addr or not port:
+        get_logger().warning(
+            "HOROVOD_CONSISTENCY_CHECK=1 but no native KV server address "
+            "was injected (launcher too old or native build unavailable); "
+            "consistency checking disabled")
+        return None
+    try:
+        from horovod_tpu.native import NativeKVClient
+        client = NativeKVClient(addr, port)
+    except Exception as e:
+        get_logger().warning("consistency checking disabled: %s", e)
+        return None
+    timeout = float(os.environ.get("HOROVOD_CONSISTENCY_TIMEOUT", "60"))
+    _checker = ConsistencyChecker(client, rank, size, timeout)
+    return _checker
+
+
+def get() -> Optional[ConsistencyChecker]:
+    return _checker
+
+
+def reset() -> None:
+    global _checker
+    if _checker is not None:
+        _checker.close()
+    _checker = None

@@ -319,6 +319,9 @@ def init(process_sets: Optional[Sequence] = None,
                 "TPU: collectives are compiled into the XLA program, so "
                 "there is no background cycle to batch against "
                 "(reference: operations.cc RunLoopOnce)", cfg.cycle_time_ms)
+        if cfg.consistency_check:
+            from horovod_tpu.core import consistency
+            consistency.maybe_init(cfg, _state.rank, _state.size)
         if cfg.autotune:
             from horovod_tpu.core.autotune import ParameterManager
             _state.parameter_manager = ParameterManager(cfg)
@@ -392,11 +395,21 @@ def _start_stall_watch(si, cfg: Config) -> None:
         while _state.initialized and _state.stall_inspector is si:
             stalled, shut = si.check()
             if stalled:
+                who = ""
+                try:
+                    from horovod_tpu.core import consistency as _cc
+                    checker = _cc.get()
+                    if checker is not None:
+                        lag = checker.lagging_ranks()
+                        if lag:
+                            who = f"; rank(s) {lag} have not arrived"
+                except Exception:
+                    pass
                 get_logger().warning(
                     "One or more collectives stalled for over %.0fs: %s — "
-                    "some ranks may not have reached them "
+                    "some ranks may not have reached them%s "
                     "(HOROVOD_STALL_CHECK_TIME_SECONDS)",
-                    cfg.stall_warning_seconds, ", ".join(stalled))
+                    cfg.stall_warning_seconds, ", ".join(stalled), who)
             if shut:
                 get_logger().error(
                     "Stall exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; "
@@ -415,6 +428,8 @@ def shutdown() -> None:
             return
         if _state.timeline is not None:
             _state.timeline.shutdown()
+        from horovod_tpu.core import consistency as _cc
+        _cc.reset()
         from horovod_tpu.ops import collectives as _coll
         _coll.clear_compiled_cache()
         _state.reset()

@@ -350,6 +350,8 @@ def allreduce(tensor: Any,
     else:
         fn = _cache.get_or_build(key, lambda: _builder_allreduce(
             ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
+    _consistency(f"allreduce(shape={g.shape},dtype={g.dtype},op={int(rop)},"
+                 f"ps={ps.process_set_id})")
     _timeline_span(name or "allreduce", "ALLREDUCE")
     return _from_global(_execute(fn, g), stacked)
 
@@ -408,6 +410,9 @@ def grouped_allreduce(tensors: Sequence[Any],
         return jax.jit(fn)
 
     fn = _cache.get_or_build(key, build)
+    _consistency(f"grouped_allreduce(n={len(gs)},shapes="
+                 f"{[tuple(g.shape) for g in gs]},op={int(rop)},"
+                 f"ps={ps.process_set_id})")
     _timeline_span(name or "grouped_allreduce", "ALLREDUCE")
     outs = _execute(fn, *gs)
     return [_from_global(o, s) for o, s in zip(outs, stackeds)]
@@ -436,6 +441,8 @@ def broadcast(tensor: Any, root_rank: int,
         return jax.jit(fn)
 
     fn = _cache.get_or_build(key, build)
+    _consistency(f"broadcast(shape={g.shape},dtype={g.dtype},root={root},"
+                 f"ps={ps.process_set_id})")
     _timeline_span(name or "broadcast", "BROADCAST")
     return _from_global(_execute(fn, g), stacked)
 
@@ -513,6 +520,8 @@ def allgather(tensor: Any, name: Optional[str] = None,
                 [g, jnp.zeros((g.shape[0], pad) + g.shape[2:], g.dtype)], axis=1)
         key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.cache_token)
     fn = _cache.get_or_build(key, build)
+    _consistency(f"allgather(shape={g.shape},dtype={g.dtype},"
+                 f"ps={ps.process_set_id})")
     _timeline_span(name or "allgather", "ALLGATHER")
     return _from_global(_execute(fn, g), stacked)
 
@@ -577,6 +586,8 @@ def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
         return jax.jit(fn)
 
     fn = _cache.get_or_build(key, build)
+    _consistency(f"reducescatter(shape={g.shape},dtype={g.dtype},"
+                 f"op={int(rop)},ps={ps.process_set_id})")
     _timeline_span(name or "reducescatter", "REDUCESCATTER")
     out = _execute(fn, g)
     if even:
@@ -672,6 +683,8 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
         return jax.jit(fn)
 
     fn = _cache.get_or_build(key, build)
+    _consistency(f"alltoall(shape={g.shape},dtype={g.dtype},"
+                 f"ps={ps.process_set_id})")
     _timeline_span(name or "alltoall", "ALLTOALL")
     out = _execute(fn, g)  # (k_local_rows, k, max_chunk, *rest)
 
@@ -710,6 +723,7 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     L = max(1, _local_member_count(ps))
     ones = np.ones((L, 1), np.int32)
     g, _ = _to_global(ones if L > 1 else ones[0], ps)
+    _consistency(f"barrier(ps={ps.process_set_id})")
     _timeline_span("barrier", "BARRIER")
     # Blocking point: if another rank never arrives we hang here — exactly
     # what the stall inspector watches (reference: stall_inspector.cc).
@@ -822,6 +836,16 @@ def _stall_done(name: str) -> None:
     si = topology.raw_state().stall_inspector
     if si is not None:
         si.done(name)
+
+
+def _consistency(desc: str) -> None:
+    """Debug-mode cross-rank agreement on this collective's signature
+    (HOROVOD_CONSISTENCY_CHECK; core/consistency.py — the coordinator's
+    mismatch checking, controller.cc:74-447, as an opt-in)."""
+    from horovod_tpu.core import consistency as _cc
+    checker = _cc.get()
+    if checker is not None:
+        checker.check(desc)
 
 
 def _timeline_span(name: str, activity: str) -> None:

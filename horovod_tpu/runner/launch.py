@@ -156,12 +156,27 @@ def launch_static(np: int, host_spec: str, command: List[str],
     rdv_port = rdv.start()
     ip = coordinator_ip or _local_ip()
 
+    # Native TCP KV server (native/src/kv_store.cc): the coordination
+    # substrate for consistency checking's bitvector AND/OR agreement
+    # (reference: controller.cc:159-190 CrossRankBitwiseAnd/Or). Optional —
+    # workers fall back gracefully when the native build is unavailable.
+    nkv = None
+    try:
+        from horovod_tpu import native as native_mod
+        if native_mod.available():
+            nkv = native_mod.NativeKVServer()
+    except Exception:
+        nkv = None
+
     base_env = dict(extra_env)
     base_env.update({
         C.HOROVOD_RENDEZVOUS_ADDR: ip,
         C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
         C.HOROVOD_CONTROLLER: "tpu",
     })
+    if nkv is not None:
+        base_env["HOROVOD_NATIVE_KV_ADDR"] = ip
+        base_env["HOROVOD_NATIVE_KV_PORT"] = str(nkv.port)
     # Single-host: the launcher can pre-pick the jax.distributed
     # coordinator port (rank 0 binds it locally). Multi-host: rank 0 picks
     # a port on ITS host and publishes via the KV store instead
@@ -181,6 +196,8 @@ def launch_static(np: int, host_spec: str, command: List[str],
         for w in workers:
             w.terminate()
         rdv.stop()
+        if nkv is not None:
+            nkv.stop()
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         print(f"horovodrun-tpu: workers failed: {bad}", file=sys.stderr)

@@ -156,7 +156,47 @@ def scenario_autotune_sync(hvd, rank, size):
     check(len(vals) == 1, f"ranks disagree on tuned threshold: {vals}")
 
 
+def scenario_consistency_mismatch(hvd, rank, size):
+    """Rank 1 issues a DIFFERENT collective: every rank must get a clear
+    TensorShapeMismatchError naming the calls, not a deadlock (reference:
+    controller.cc ConstructResponse mismatch checking)."""
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+
+    x = np.ones((4,), np.float32)
+    try:
+        if rank == 1:
+            hvd.broadcast(x, root_rank=0)
+        else:
+            hvd.allreduce(x, op="sum")
+    except TensorShapeMismatchError as e:
+        msg = str(e)
+        check("allreduce" in msg and "broadcast" in msg, msg)
+        check("rank 0" in msg and "rank 1" in msg, msg)
+        return
+    check(False, f"rank {rank}: expected TensorShapeMismatchError")
+
+
+def scenario_consistency_missing(hvd, rank, size):
+    """Rank 1 never issues the collective: rank 0's check must time out
+    with a diagnostic NAMING rank 1 (coordinator-side stall detection,
+    reference: stall_inspector.cc reports uncommitted ranks)."""
+    from horovod_tpu.common.exceptions import (HorovodTpuError,
+                                               TensorShapeMismatchError)
+
+    if rank != 0:
+        return  # deliberately absent
+    try:
+        hvd.allreduce(np.ones((3,), np.float32), op="sum")
+    except HorovodTpuError as e:
+        check(not isinstance(e, TensorShapeMismatchError), str(e))
+        check("[1]" in str(e) or "rank(s) [1]" in str(e), str(e))
+        return
+    check(False, "rank 0: expected a timeout diagnostic")
+
+
 SCENARIOS = {
+    "consistency_mismatch": scenario_consistency_mismatch,
+    "consistency_missing": scenario_consistency_missing,
     "allreduce": scenario_allreduce,
     "grouped": scenario_grouped,
     "broadcast": scenario_broadcast,

@@ -62,3 +62,44 @@ def test_worker_failure_propagates(tmp_path):
             [sys.executable, "-c", "import sys; sys.exit(3)"],
             dict(WORKER_ENV), stdout=f)
     assert rc == 3
+
+
+def _native_kv_available():
+    from horovod_tpu import native
+    return native.available()
+
+
+@pytest.mark.skipif(not _native_kv_available(),
+                    reason="native KV unavailable")
+def test_consistency_mismatch_diagnosed(tmp_path):
+    """Rank 1 calls a different collective → diagnostic, not a hang
+    (reference: controller.cc:74-447 mismatch checks)."""
+    env = dict(WORKER_ENV)
+    env["HOROVOD_CONSISTENCY_CHECK"] = "1"
+    env["HOROVOD_CONSISTENCY_TIMEOUT"] = "30"
+    out_path = tmp_path / "out.txt"
+    with open(out_path, "w") as f:
+        rc = launch_static(2, "localhost:2",
+                           [sys.executable, WORKER, "consistency_mismatch"],
+                           env, stdout=f)
+    text = out_path.read_text()
+    assert rc == 0, text
+    for rank in range(2):
+        assert f"MP_WORKER_OK consistency_mismatch rank={rank}" in text, text
+
+
+@pytest.mark.skipif(not _native_kv_available(),
+                    reason="native KV unavailable")
+def test_consistency_missing_rank_named(tmp_path):
+    env = dict(WORKER_ENV)
+    env["HOROVOD_CONSISTENCY_CHECK"] = "1"
+    env["HOROVOD_CONSISTENCY_TIMEOUT"] = "3"
+    out_path = tmp_path / "out.txt"
+    with open(out_path, "w") as f:
+        rc = launch_static(2, "localhost:2",
+                           [sys.executable, WORKER, "consistency_missing"],
+                           env, stdout=f)
+    text = out_path.read_text()
+    assert rc == 0, text
+    for rank in range(2):
+        assert f"MP_WORKER_OK consistency_missing rank={rank}" in text, text
