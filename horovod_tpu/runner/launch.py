@@ -124,19 +124,38 @@ def _is_local(hostname: str) -> bool:
                         socket.getfqdn())
 
 
+def _worker_pythonpath(existing: Optional[str]) -> str:
+    """PYTHONPATH that lets workers import the launcher's horovod_tpu.
+
+    The reference assumes horovod is pip-installed on every host; we also
+    support running straight from a source checkout, where a spawned
+    `python train.py` has the script's directory — not the checkout root —
+    as sys.path[0]."""
+    import horovod_tpu
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.abspath(horovod_tpu.__file__)))
+    parts = [pkg_parent]
+    if existing:
+        parts += [p for p in existing.split(os.pathsep) if p != pkg_parent]
+    return os.pathsep.join(parts)
+
+
 def make_worker_cmd(slot: hosts_mod.SlotInfo, command: List[str],
                     base_env: Dict[str, str]) -> (List[str], Dict[str, str]):
     env = dict(os.environ)
     env.update(base_env)
     env.update(slot.to_env())
+    env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH"))
     if _is_local(slot.hostname):
         return list(command), env
     # Remote: ssh with env inlined (reference: gloo_run.py
     # get_remote_command). Everything user-controlled is shell-quoted —
     # cwd, env values (e.g. XLA_FLAGS with spaces), and command args.
     import shlex
+    remote_env = {**base_env, **slot.to_env()}
+    remote_env["PYTHONPATH"] = env["PYTHONPATH"]
     env_str = " ".join(f"{k}={shlex.quote(str(v))}"
-                       for k, v in {**base_env, **slot.to_env()}.items())
+                       for k, v in remote_env.items())
     remote = (f"cd {shlex.quote(os.getcwd())} && env {env_str} "
               + " ".join(shlex.quote(c) for c in command))
     return ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote], \
