@@ -70,6 +70,9 @@ HOROVOD_DISABLE_GROUP_FUSION = "HOROVOD_DISABLE_GROUP_FUSION"
 HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
 HOROVOD_ENABLE_ASYNC_COMPLETION = "HOROVOD_ENABLE_ASYNC_COMPLETION"
 HOROVOD_CONSISTENCY_CHECK = "HOROVOD_CONSISTENCY_CHECK"
+HOROVOD_CONSISTENCY_TIMEOUT = "HOROVOD_CONSISTENCY_TIMEOUT"
+HOROVOD_NATIVE_KV_ADDR = "HOROVOD_NATIVE_KV_ADDR"
+HOROVOD_NATIVE_KV_PORT = "HOROVOD_NATIVE_KV_PORT"
 
 # Topology / launcher knobs (reference: injected by the launcher,
 # horovod/runner/gloo_run.py:69-75).

@@ -22,6 +22,12 @@ the reference's cache coordination):
                 which ranks never issued the collective — the
                 coordinator-side stall answer (reference:
                 stall_inspector.cc reports uncommitted ranks).
+
+Sequencing is per process set (only member ranks issue collectives on a
+subset set, so each set has its own call-order contract — the reference
+likewise coordinates per ProcessSet, process_set.cc), and all keys carry
+an epoch prefix so a shutdown()+init() cycle within one launch never
+replays against a previous incarnation's combined values.
 """
 
 from __future__ import annotations
@@ -29,58 +35,89 @@ from __future__ import annotations
 import hashlib
 import os
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
+from horovod_tpu.common import config as C
 from horovod_tpu.common.exceptions import (HorovodTpuError,
                                            TensorShapeMismatchError)
 
 _checker: Optional["ConsistencyChecker"] = None
+# Counts init() incarnations in this process. Under the SPMD contract every
+# rank's Nth init() pairs with every other rank's Nth, so (elastic round,
+# init count) is a rank-agreed epoch without any extra coordination.
+_init_count = 0
+
+# Completed rounds are garbage-collected this many sequence numbers behind
+# the newest, leaving a window the stall watcher can still read.
+_GC_LAG = 8
 
 
 class ConsistencyChecker:
-    def __init__(self, client, rank: int, size: int,
+    def __init__(self, client, rank: int, size: int, epoch: str,
                  timeout: float = 60.0):
         self._kv = client
         self.rank = rank
         self.size = size
         self.timeout = timeout
-        self._seq = 0
+        self._pfx = f"cc/{epoch}"
+        self._seq: Dict[str, int] = {}
+        # (group, seq, ranks) of the most recent check, for lagging_ranks.
+        self._last: Optional[Tuple[str, int, Tuple[int, ...]]] = None
 
     # ------------------------------------------------------------------ api
-    def check(self, desc: str) -> None:
-        """Agree with every rank that collective #seq is `desc`.
+    def check(self, desc: str, ranks: Optional[Sequence[int]] = None,
+              group: str = "world") -> None:
+        """Agree with `ranks` (default: all) that their collective #seq on
+        `group` is `desc`.
 
         Raises TensorShapeMismatchError on disagreement (naming ranks) and
         HorovodTpuError on timeout (naming the ranks that never arrived).
         """
-        seq = self._seq
-        self._seq += 1
+        members: Tuple[int, ...] = (tuple(ranks) if ranks is not None
+                                    else tuple(range(self.size)))
+        seq = self._seq.get(group, 0)
+        self._seq[group] = seq + 1
+        self._last = (group, seq, members)
+        pfx = f"{self._pfx}/{group}"
         h = hashlib.sha256(desc.encode()).digest()[:16]
-        self._kv.put(f"cc/seen/{seq}/{self.rank}", b"1")
-        self._kv.bitwise(f"cc/or/{seq}", h, op="or")
-        self._kv.bitwise(f"cc/and/{seq}", h, op="and")
-        combined_or = self._kv.get_when(f"cc/or/{seq}", expected=self.size,
+        self._kv.put(f"{pfx}/seen/{seq}/{self.rank}", b"1")
+        self._kv.bitwise(f"{pfx}/or/{seq}", h, op="or")
+        self._kv.bitwise(f"{pfx}/and/{seq}", h, op="and")
+        combined_or = self._kv.get_when(f"{pfx}/or/{seq}",
+                                        expected=len(members),
                                         timeout=self.timeout)
         if combined_or is None:
-            missing = self._missing(seq)
-            raise HorovodTpuError(
-                f"consistency check timed out for collective #{seq} "
-                f"('{desc}'): rank(s) {missing} never issued it within "
-                f"{self.timeout:.0f}s — every process must run the same "
-                f"collectives in the same order (reference: "
-                f"controller.cc stall/mismatch detection)")
-        combined_and = self._kv.get_when(f"cc/and/{seq}", expected=self.size,
+            self._raise_missing(pfx, seq, members, desc, "or")
+        combined_and = self._kv.get_when(f"{pfx}/and/{seq}",
+                                         expected=len(members),
                                          timeout=self.timeout)
+        if combined_and is None:
+            # A rank died between its OR and AND contributions (or the KV
+            # dropped): that is a missing rank, not a program divergence.
+            self._raise_missing(pfx, seq, members, desc, "and")
         if combined_or == h and combined_and == h:
+            # Group rank 0 retires the round that is now _GC_LAG behind —
+            # everyone contributed to `seq`, so no one can still be inside
+            # check(seq - _GC_LAG) (KV entries would otherwise grow without
+            # bound over a training run).
+            if self.rank == members[0] and seq >= _GC_LAG:
+                old = seq - _GC_LAG
+                try:
+                    self._kv.delete(f"{pfx}/or/{old}")
+                    self._kv.delete(f"{pfx}/and/{old}")
+                    for r in members:
+                        self._kv.delete(f"{pfx}/seen/{old}/{r}")
+                except Exception:
+                    pass
             return
         # Disagreement: publish details, gather, raise a naming diagnostic.
-        self._kv.put(f"cc/detail/{seq}/{self.rank}", desc.encode())
+        self._kv.put(f"{pfx}/detail/{seq}/{self.rank}", desc.encode())
         deadline = time.monotonic() + self.timeout
         details: List[str] = []
-        for r in range(self.size):
+        for r in members:
             data = None
             while time.monotonic() < deadline:
-                data = self._kv.get(f"cc/detail/{seq}/{r}")
+                data = self._kv.get(f"{pfx}/detail/{seq}/{r}")
                 if data is not None:
                     break
                 time.sleep(0.01)
@@ -91,18 +128,31 @@ class ConsistencyChecker:
             f"controller.cc ConstructResponse mismatch checks):\n"
             + "\n".join(details))
 
-    def _missing(self, seq: int) -> List[int]:
-        return [r for r in range(self.size)
-                if self._kv.get(f"cc/seen/{seq}/{r}") is None]
+    def _raise_missing(self, pfx: str, seq: int,
+                       members: Tuple[int, ...], desc: str,
+                       phase: str) -> None:
+        missing = self._missing(pfx, seq, members)
+        raise HorovodTpuError(
+            f"consistency check ({phase}) timed out for collective #{seq} "
+            f"('{desc}'): rank(s) {missing or '<unknown>'} never issued it "
+            f"within {self.timeout:.0f}s — every member process must run "
+            f"the same collectives in the same order (reference: "
+            f"controller.cc stall/mismatch detection)")
+
+    def _missing(self, pfx: str, seq: int,
+                 members: Sequence[int]) -> List[int]:
+        return [r for r in members
+                if self._kv.get(f"{pfx}/seen/{seq}/{r}") is None]
 
     def lagging_ranks(self) -> List[int]:
         """Ranks that have not reached this process's last collective —
         surfaced in stall warnings so the report is coordinator-aware
         (reference: stall_inspector.cc names uncommitted ranks)."""
-        if self._seq == 0:
+        if self._last is None:
             return []
+        group, seq, members = self._last
         try:
-            return self._missing(self._seq - 1)
+            return self._missing(f"{self._pfx}/{group}", seq, members)
         except Exception:
             return []
 
@@ -119,13 +169,13 @@ def maybe_init(cfg, rank: int, size: int) -> Optional[ConsistencyChecker]:
     Requires the native KV server the launcher starts
     (HOROVOD_NATIVE_KV_ADDR/PORT); logs and disables otherwise.
     """
-    global _checker
+    global _checker, _init_count
     if _checker is not None:
         return _checker
     if size <= 1:
         return None
-    addr = os.environ.get("HOROVOD_NATIVE_KV_ADDR", "")
-    port = int(os.environ.get("HOROVOD_NATIVE_KV_PORT", "0") or 0)
+    addr = os.environ.get(C.HOROVOD_NATIVE_KV_ADDR, "")
+    port = int(os.environ.get(C.HOROVOD_NATIVE_KV_PORT, "0") or 0)
     from horovod_tpu.common.hvd_logging import get_logger
     if not addr or not port:
         get_logger().warning(
@@ -139,8 +189,16 @@ def maybe_init(cfg, rank: int, size: int) -> Optional[ConsistencyChecker]:
     except Exception as e:
         get_logger().warning("consistency checking disabled: %s", e)
         return None
-    timeout = float(os.environ.get("HOROVOD_CONSISTENCY_TIMEOUT", "60"))
-    _checker = ConsistencyChecker(client, rank, size, timeout)
+    timeout = float(os.environ.get(C.HOROVOD_CONSISTENCY_TIMEOUT, "60"))
+    _init_count += 1
+    round_env = os.environ.get("HOROVOD_ELASTIC_ROUND")
+    # Elastic: the launcher-assigned round id is the rank-agreed epoch —
+    # survivors (which bump it in-process on reset, elastic/__init__.py)
+    # and fresh joiners share it, while per-process init counts would
+    # diverge between them. Static launch: every rank's Nth init() pairs
+    # under the SPMD contract, so the init count is agreed.
+    epoch = f"r{round_env}" if round_env else f"i{_init_count}"
+    _checker = ConsistencyChecker(client, rank, size, epoch, timeout)
     return _checker
 
 

@@ -66,12 +66,16 @@ class HostDiscoveryScript(HostDiscovery):
 
 class _Blacklist:
     """Failed-host tracking with cooldown (reference: discovery.py:33-76
-    CooldownPeriod in HostState). Repeated failures back off exponentially."""
+    CooldownPeriod in HostState). Repeated failures back off exponentially;
+    the range is tunable (reference: --blacklist-cooldown-range,
+    launch.py)."""
 
     INIT_COOLDOWN = 10.0
     MAX_COOLDOWN = 300.0
 
-    def __init__(self):
+    def __init__(self, cooldown_range: Optional[tuple] = None):
+        if cooldown_range is not None:
+            self.INIT_COOLDOWN, self.MAX_COOLDOWN = cooldown_range
         self._entries: Dict[str, tuple] = {}  # host -> (until, count)
         self._lock = threading.Lock()
 
@@ -99,9 +103,10 @@ class _Blacklist:
 class HostManager:
     """Tracks current/available hosts (reference: discovery.py HostManager)."""
 
-    def __init__(self, discovery: HostDiscovery):
+    def __init__(self, discovery: HostDiscovery,
+                 cooldown_range: Optional[tuple] = None):
         self._discovery = discovery
-        self._blacklist = _Blacklist()
+        self._blacklist = _Blacklist(cooldown_range)
         self._current: Dict[str, int] = {}
         self._lock = threading.Lock()
 

@@ -299,9 +299,11 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
         make_worker_cmd
     from horovod_tpu.runner.rendezvous import RendezvousServer
 
-    hm = HostManager(HostDiscoveryScript(
-        args.host_discovery_script,
-        default_slots=args.slots_per_host or 1))
+    cooldown = getattr(args, "blacklist_cooldown_range", None)
+    hm = HostManager(
+        HostDiscoveryScript(args.host_discovery_script,
+                            default_slots=args.slots_per_host or 1),
+        cooldown_range=tuple(cooldown) if cooldown else None)
     rdv = RendezvousServer()
     rdv_port = rdv.start()
     ip = _local_ip()

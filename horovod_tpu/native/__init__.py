@@ -144,6 +144,9 @@ class NativeKVClient:
             st, data = self._req(OP_GET, key, b"", int(st))
         return data if st >= 0 else None
 
+    def delete(self, key: str) -> None:
+        self._req(OP_DEL, key)
+
     def add(self, key: str, delta: int) -> int:
         st, _ = self._req(OP_ADD, key,
                           int(delta).to_bytes(8, "little", signed=True))

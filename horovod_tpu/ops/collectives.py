@@ -36,6 +36,7 @@ stacked the same way (sharded over the mesh, so they stay distributed).
 from __future__ import annotations
 
 import collections
+import contextlib
 import functools
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -61,6 +62,26 @@ except AttributeError:  # older jax spelling
     from jax._src.lib import xla_client as _xc
     _COMM_ERRORS = (_xc.XlaRuntimeError,)
 
+# A dead peer does NOT always surface as a typed runtime error: the CPU
+# collectives backend raises plain ValueError("UNKNOWN: Gloo all-reduce
+# failed: ... Connection closed by peer ..."), and the coordination client
+# has its own wording. Message markers classify those.
+_COMM_FAILURE_MARKERS = (
+    "connection closed by peer", "connection reset", "connection refused",
+    "gloo", "all-reduce failed", "broken pipe", "socket",
+    "coordination service", "heartbeat", "task is unhealthy",
+    "peer is unavailable", "deadline exceeded",
+)
+
+
+def is_comm_failure(e: BaseException) -> bool:
+    """True if `e` looks like a transport/peer failure rather than user
+    error — the trigger for HorovodInternalError in elastic mode."""
+    if isinstance(e, _COMM_ERRORS):
+        return True
+    msg = str(e).lower()
+    return any(m in msg for m in _COMM_FAILURE_MARKERS)
+
 
 def _execute(fn: Callable, *args):
     """Run a compiled collective with failure propagation.
@@ -79,8 +100,8 @@ def _execute(fn: Callable, *args):
         if elastic:
             jax.block_until_ready(out)
         return out
-    except _COMM_ERRORS as e:
-        if elastic:
+    except Exception as e:
+        if elastic and is_comm_failure(e):
             raise HorovodInternalError(
                 f"collective execution failed: {e}") from e
         raise
@@ -109,12 +130,34 @@ class _CompiledCache:
         if key in self._cache:
             self._cache.move_to_end(key)
             return self._cache[key]
-        fn = builder()
+        fn = self._compile_timed(builder(), str(key[0]))
         self._cache[key] = fn
         cap = self._capacity()
         while cap > 0 and len(self._cache) > cap:
             self._cache.popitem(last=False)
         return fn
+
+    @staticmethod
+    def _compile_timed(fn: Callable, tag: str) -> Callable:
+        """Record the cache miss's trace+compile as a COMPILE timeline span
+        (reference: the timeline's per-tensor activity spans, timeline.cc).
+        jit defers compilation to the first invocation, so that call — not
+        the builder — is what gets timed."""
+        first = [True]
+
+        def wrapped(*args):
+            if first[0]:
+                first[0] = False
+                tl = topology.state().timeline
+                if tl is not None:
+                    tl.span_begin(tag, "COMPILE")
+                    try:
+                        return fn(*args)
+                    finally:
+                        tl.span_end(tag, "COMPILE")
+            return fn(*args)
+
+        return wrapped
 
     def clear(self) -> None:
         self._cache.clear()
@@ -351,9 +394,9 @@ def allreduce(tensor: Any,
         fn = _cache.get_or_build(key, lambda: _builder_allreduce(
             ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
     _consistency(f"allreduce(shape={g.shape},dtype={g.dtype},op={int(rop)},"
-                 f"ps={ps.process_set_id})")
-    _timeline_span(name or "allreduce", "ALLREDUCE")
-    return _from_global(_execute(fn, g), stacked)
+                 f"ps={ps.process_set_id})", ps)
+    with _timeline_span(name or "allreduce", "ALLREDUCE"):
+        return _from_global(_execute(fn, g), stacked)
 
 
 def grouped_allreduce(tensors: Sequence[Any],
@@ -412,9 +455,9 @@ def grouped_allreduce(tensors: Sequence[Any],
     fn = _cache.get_or_build(key, build)
     _consistency(f"grouped_allreduce(n={len(gs)},shapes="
                  f"{[tuple(g.shape) for g in gs]},op={int(rop)},"
-                 f"ps={ps.process_set_id})")
-    _timeline_span(name or "grouped_allreduce", "ALLREDUCE")
-    outs = _execute(fn, *gs)
+                 f"ps={ps.process_set_id})", ps)
+    with _timeline_span(name or "grouped_allreduce", "ALLREDUCE"):
+        outs = _execute(fn, *gs)
     return [_from_global(o, s) for o, s in zip(outs, stackeds)]
 
 
@@ -442,9 +485,9 @@ def broadcast(tensor: Any, root_rank: int,
 
     fn = _cache.get_or_build(key, build)
     _consistency(f"broadcast(shape={g.shape},dtype={g.dtype},root={root},"
-                 f"ps={ps.process_set_id})")
-    _timeline_span(name or "broadcast", "BROADCAST")
-    return _from_global(_execute(fn, g), stacked)
+                 f"ps={ps.process_set_id})", ps)
+    with _timeline_span(name or "broadcast", "BROADCAST"):
+        return _from_global(_execute(fn, g), stacked)
 
 
 def allgather(tensor: Any, name: Optional[str] = None,
@@ -461,6 +504,12 @@ def allgather(tensor: Any, name: Optional[str] = None,
         raise HorovodTpuError(
             "allgather requires per-rank tensors with at least one dimension")
     k = ps.size()
+    # Consistency check BEFORE the blocking size exchange — a rank calling a
+    # different collective would otherwise deadlock inside _exchange_sizes
+    # before the diagnostic could fire. The signature excludes dim 0, which
+    # may legitimately differ per rank (uneven allgather).
+    _consistency(f"allgather(rest={tuple(g.shape[2:])},ndim={g.ndim},"
+                 f"dtype={g.dtype},ps={ps.process_set_id})", ps)
     if stacked:
         # Single-controller stacked input: all rows share a shape — even path.
         sizes = (int(g.shape[1]),) * k
@@ -520,10 +569,8 @@ def allgather(tensor: Any, name: Optional[str] = None,
                 [g, jnp.zeros((g.shape[0], pad) + g.shape[2:], g.dtype)], axis=1)
         key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.cache_token)
     fn = _cache.get_or_build(key, build)
-    _consistency(f"allgather(shape={g.shape},dtype={g.dtype},"
-                 f"ps={ps.process_set_id})")
-    _timeline_span(name or "allgather", "ALLGATHER")
-    return _from_global(_execute(fn, g), stacked)
+    with _timeline_span(name or "allgather", "ALLGATHER"):
+        return _from_global(_execute(fn, g), stacked)
 
 
 def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
@@ -587,9 +634,9 @@ def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
 
     fn = _cache.get_or_build(key, build)
     _consistency(f"reducescatter(shape={g.shape},dtype={g.dtype},"
-                 f"op={int(rop)},ps={ps.process_set_id})")
-    _timeline_span(name or "reducescatter", "REDUCESCATTER")
-    out = _execute(fn, g)
+                 f"op={int(rop)},ps={ps.process_set_id})", ps)
+    with _timeline_span(name or "reducescatter", "REDUCESCATTER"):
+        out = _execute(fn, g)
     if even:
         return _from_global(out, stacked)
     # Trim each rank's padded slice to its true size.
@@ -646,6 +693,10 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
             raise HorovodTpuError("splits must have one entry per rank and "
                                   "sum to dim 0")
 
+    # Consistency check BEFORE the blocking splits exchange (see allgather);
+    # dim 0 = sum(splits) may legitimately differ per rank.
+    _consistency(f"alltoall(rest={tuple(g.shape[2:])},ndim={g.ndim},"
+                 f"dtype={g.dtype},ps={ps.process_set_id})", ps)
     # Exchange the full splits matrix (controller's AlltoallGetRecvSplits,
     # controller.h:63). In stacked mode rows share `my_splits`.
     if stacked and splits is not None:
@@ -683,10 +734,8 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
         return jax.jit(fn)
 
     fn = _cache.get_or_build(key, build)
-    _consistency(f"alltoall(shape={g.shape},dtype={g.dtype},"
-                 f"ps={ps.process_set_id})")
-    _timeline_span(name or "alltoall", "ALLTOALL")
-    out = _execute(fn, g)  # (k_local_rows, k, max_chunk, *rest)
+    with _timeline_span(name or "alltoall", "ALLTOALL"):
+        out = _execute(fn, g)  # (k_local_rows, k, max_chunk, *rest)
 
     def trim(rank_in_set: int, rowdata):
         pieces = [rowdata[i, : int(splits_matrix[i, rank_in_set])]
@@ -723,13 +772,13 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     L = max(1, _local_member_count(ps))
     ones = np.ones((L, 1), np.int32)
     g, _ = _to_global(ones if L > 1 else ones[0], ps)
-    _consistency(f"barrier(ps={ps.process_set_id})")
-    _timeline_span("barrier", "BARRIER")
+    _consistency(f"barrier(ps={ps.process_set_id})", ps)
     # Blocking point: if another rank never arrives we hang here — exactly
     # what the stall inspector watches (reference: stall_inspector.cc).
     _stall_submit("barrier")
     try:
-        jax.block_until_ready(_execute(fn, g))
+        with _timeline_span("barrier", "BARRIER"):
+            jax.block_until_ready(_execute(fn, g))
     finally:
         _stall_done("barrier")
 
@@ -742,8 +791,8 @@ def synchronize(handle: Any) -> Any:
     _stall_submit("synchronize")
     try:
         return jax.block_until_ready(handle)
-    except _COMM_ERRORS as e:
-        if topology.raw_state().config.elastic:
+    except Exception as e:
+        if topology.raw_state().config.elastic and is_comm_failure(e):
             raise HorovodInternalError(f"synchronize failed: {e}") from e
         raise
     finally:
@@ -817,8 +866,10 @@ def _exchange_rows(my_row: np.ndarray, ps: ProcessSet) -> np.ndarray:
         out = _execute(fn, g)
         shard = out.addressable_shards[0].data[0]
         return np.asarray(shard)
-    except _COMM_ERRORS as e:
-        if topology.raw_state().config.elastic:
+    except Exception as e:
+        if isinstance(e, HorovodInternalError):
+            raise
+        if topology.raw_state().config.elastic and is_comm_failure(e):
             raise HorovodInternalError(
                 f"size exchange failed: {e}") from e
         raise
@@ -838,17 +889,37 @@ def _stall_done(name: str) -> None:
         si.done(name)
 
 
-def _consistency(desc: str) -> None:
+def _consistency(desc: str, ps: ProcessSet) -> None:
     """Debug-mode cross-rank agreement on this collective's signature
     (HOROVOD_CONSISTENCY_CHECK; core/consistency.py — the coordinator's
-    mismatch checking, controller.cc:74-447, as an opt-in)."""
+    mismatch checking, controller.cc:74-447, as an opt-in). Agreement runs
+    among the process set's members only, on the set's own sequence —
+    subset-set collectives must not involve (or desynchronize) outsiders."""
     from horovod_tpu.core import consistency as _cc
     checker = _cc.get()
     if checker is not None:
-        checker.check(desc)
+        ranks = ps.ranks  # None ⇒ world
+        if ranks is None:
+            group = "world"
+        else:
+            import hashlib as _hl
+            member_tag = _hl.sha256(repr(tuple(ranks)).encode()).hexdigest()
+            group = f"ps{ps.process_set_id}-{member_tag[:12]}"
+        checker.check(desc, ranks=ranks, group=group)
 
 
-def _timeline_span(name: str, activity: str) -> None:
+@contextlib.contextmanager
+def _timeline_span(name: str, activity: str):
+    """EXECUTE-style duration span around eager dispatch (reference: the
+    per-tensor op-activity spans, timeline.cc + operations.cc:286-330).
+    Under async dispatch the span covers host-side dispatch; in elastic
+    mode (_execute forces completion) it covers the full collective."""
     tl = topology.state().timeline
-    if tl is not None:
-        tl.record_instant(name, activity)
+    if tl is None:
+        yield
+        return
+    tl.span_begin(name, activity)
+    try:
+        yield
+    finally:
+        tl.span_end(name, activity)

@@ -69,6 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots-per-host", type=int, default=None)
     p.add_argument("--elastic-timeout", type=int, default=600)
     p.add_argument("--reset-limit", type=int, default=None)
+    p.add_argument("--blacklist-cooldown-range", type=float, nargs=2,
+                   default=None, metavar=("MIN", "MAX"),
+                   help="seconds a failed host is excluded before retry "
+                        "(exponential backoff between MIN and MAX; "
+                        "reference: launch.py --blacklist-cooldown-range)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
     return p
@@ -194,8 +199,8 @@ def launch_static(np: int, host_spec: str, command: List[str],
         C.HOROVOD_CONTROLLER: "tpu",
     })
     if nkv is not None:
-        base_env["HOROVOD_NATIVE_KV_ADDR"] = ip
-        base_env["HOROVOD_NATIVE_KV_PORT"] = str(nkv.port)
+        base_env[C.HOROVOD_NATIVE_KV_ADDR] = ip
+        base_env[C.HOROVOD_NATIVE_KV_PORT] = str(nkv.port)
     # Single-host: the launcher can pre-pick the jax.distributed
     # coordinator port (rank 0 binds it locally). Multi-host: rank 0 picks
     # a port on ITS host and publishes via the KV store instead

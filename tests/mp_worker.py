@@ -194,9 +194,44 @@ def scenario_consistency_missing(hvd, rank, size):
     check(False, "rank 0: expected a timeout diagnostic")
 
 
+def scenario_consistency_subset(hvd, rank, size):
+    """Collectives on a subset process set involve member ranks only and
+    keep their own sequence — non-members proceeding to other collectives
+    must not falsely fail or desynchronize the world ordering (reference:
+    per-ProcessSet controllers, process_set.cc)."""
+    ps = hvd.add_process_set([0])
+    x = np.ones((4,), np.float32)
+    if rank == 0:
+        out = np.asarray(hvd.allreduce(x, op="sum", process_set=ps))
+        np.testing.assert_allclose(out, x)
+    # World collective right after: sequences must still agree everywhere.
+    out = np.asarray(hvd.allreduce(x, op="sum"))
+    np.testing.assert_allclose(out, x * size)
+
+
+def scenario_consistency_gather_mismatch(hvd, rank, size):
+    """Rank 0 calls allgather while rank 1 calls allreduce: the check must
+    fire BEFORE allgather's blocking size exchange, raising the naming
+    diagnostic instead of deadlocking inside _exchange_sizes."""
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+
+    try:
+        if rank == 0:
+            hvd.allgather(np.ones((2, 3), np.float32))
+        else:
+            hvd.allreduce(np.ones((4,), np.float32), op="sum")
+    except TensorShapeMismatchError as e:
+        msg = str(e)
+        check("allgather" in msg and "allreduce" in msg, msg)
+        return
+    check(False, f"rank {rank}: expected TensorShapeMismatchError")
+
+
 SCENARIOS = {
     "consistency_mismatch": scenario_consistency_mismatch,
     "consistency_missing": scenario_consistency_missing,
+    "consistency_subset": scenario_consistency_subset,
+    "consistency_gather_mismatch": scenario_consistency_gather_mismatch,
     "allreduce": scenario_allreduce,
     "grouped": scenario_grouped,
     "broadcast": scenario_broadcast,

@@ -222,3 +222,15 @@ def test_join_steps(hvd):
 def test_join(hvd):
     last = hvd.join()
     assert last == hvd.size() - 1 or last == hvd.rank()
+
+
+def test_is_comm_failure_classification():
+    """Peer-death errors from the CPU collectives backend are plain
+    ValueErrors; they must still map to HorovodInternalError in elastic
+    mode (SURVEY §5 failure propagation)."""
+    from horovod_tpu.ops.collectives import is_comm_failure
+    assert is_comm_failure(ValueError(
+        "UNKNOWN: Gloo all-reduce failed: [external/gloo/gloo/transport/"
+        "tcp/pair.cc:547] Connection closed by peer [127.0.0.1]:25986"))
+    assert is_comm_failure(RuntimeError("coordination service heartbeat"))
+    assert not is_comm_failure(ValueError("operands could not be broadcast"))

@@ -1,0 +1,165 @@
+"""Unit tests for the consistency-check protocol (core/consistency.py)
+against an in-memory fake of the native KV server — the pure-host tier of
+the test strategy (SURVEY §4 tier 1). The real-KV, real-process variants
+live in tests/test_multiprocess.py."""
+
+import hashlib
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common.exceptions import (HorovodTpuError,
+                                           TensorShapeMismatchError)
+from horovod_tpu.core.consistency import _GC_LAG, ConsistencyChecker
+
+
+class FakeKV:
+    """In-memory stand-in for NativeKVClient (native/src/kv_store.cc)."""
+
+    def __init__(self):
+        self.store = {}
+        self.counts = {}
+        self.cv = threading.Condition()
+
+    def put(self, key, val):
+        with self.cv:
+            self.store[key] = val
+            self.counts[key] = self.counts.get(key, 0) + 1
+            self.cv.notify_all()
+
+    def get(self, key, maxlen=1 << 20):
+        with self.cv:
+            return self.store.get(key)
+
+    def bitwise(self, key, bits, op="and"):
+        with self.cv:
+            cur = self.store.get(key)
+            if cur is None:
+                new = bits
+            elif op == "and":
+                new = bytes(a & b for a, b in zip(cur, bits))
+            else:
+                new = bytes(a | b for a, b in zip(cur, bits))
+            self.store[key] = new
+            self.counts[key] = self.counts.get(key, 0) + 1
+            self.cv.notify_all()
+            return self.counts[key]
+
+    def get_when(self, key, expected, timeout=60.0, maxlen=1 << 20):
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            while self.counts.get(key, 0) < expected:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self.cv.wait(remaining)
+            return self.store.get(key)
+
+    def delete(self, key):
+        with self.cv:
+            self.store.pop(key, None)
+            self.counts.pop(key, None)
+
+    def close(self):
+        pass
+
+
+def _pair(kv, epoch="t", timeout=5.0):
+    return [ConsistencyChecker(kv, r, 2, epoch, timeout) for r in range(2)]
+
+
+def _run_pair(c0, c1, desc0, desc1, **kw):
+    errs = [None, None]
+
+    def go(i, c, d):
+        try:
+            c.check(d, **kw)
+        except Exception as e:  # noqa: BLE001 — collected for assertions
+            errs[i] = e
+
+    threads = [threading.Thread(target=go, args=(0, c0, desc0)),
+               threading.Thread(target=go, args=(1, c1, desc1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errs
+
+
+def test_agreement_fast_path():
+    c0, c1 = _pair(FakeKV())
+    errs = _run_pair(c0, c1, "allreduce(x)", "allreduce(x)")
+    assert errs == [None, None]
+
+
+def test_mismatch_names_both_ranks():
+    c0, c1 = _pair(FakeKV())
+    errs = _run_pair(c0, c1, "allreduce(x)", "broadcast(y)")
+    for e in errs:
+        assert isinstance(e, TensorShapeMismatchError)
+        assert "rank 0" in str(e) and "rank 1" in str(e)
+        assert "allreduce(x)" in str(e) and "broadcast(y)" in str(e)
+
+
+def test_subset_group_keeps_own_sequence():
+    kv = FakeKV()
+    c0, c1 = _pair(kv)
+    # Rank 0 alone on a single-member group: returns instantly, no thread.
+    c0.check("sub-op", ranks=(0,), group="ps1")
+    # World sequence is unaffected: both ranks still at world seq 0.
+    errs = _run_pair(c0, c1, "allreduce(x)", "allreduce(x)")
+    assert errs == [None, None]
+    assert c0._seq["world"] == c1._seq["world"] == 1
+    assert c0._seq["ps1"] == 1 and "ps1" not in c1._seq
+
+
+def test_and_timeout_reports_missing_not_mismatch():
+    """A rank dying between its OR and AND contributions is a missing
+    rank, not a program divergence."""
+    kv = FakeKV()
+    c0 = ConsistencyChecker(kv, 0, 2, "t", timeout=1.0)
+    desc = "allreduce(x)"
+    h = hashlib.sha256(desc.encode()).digest()[:16]
+    # Simulate rank 1 contributing presence + OR, then dying before AND.
+    kv.put("cc/t/world/seen/0/1", b"1")
+    kv.bitwise("cc/t/world/or/0", h, op="or")
+    with pytest.raises(HorovodTpuError) as ei:
+        c0.check(desc)
+    assert not isinstance(ei.value, TensorShapeMismatchError)
+    assert "(and)" in str(ei.value)
+
+
+def test_gc_retires_old_rounds():
+    kv = FakeKV()
+    c0, c1 = _pair(kv)
+    n = _GC_LAG + 2
+    for _ in range(n):
+        errs = _run_pair(c0, c1, "op", "op")
+        assert errs == [None, None]
+    # Rounds more than _GC_LAG behind the newest are gone...
+    assert "cc/t/world/or/0" not in kv.store
+    assert "cc/t/world/seen/0/0" not in kv.store
+    assert "cc/t/world/seen/0/1" not in kv.store
+    # ...while recent rounds survive for the stall watcher.
+    assert f"cc/t/world/or/{n - 1}" in kv.store
+
+
+def test_epoch_prefix_separates_incarnations():
+    """A shutdown()+init() cycle must not replay against the previous
+    incarnation's combined values (keys carry an epoch prefix)."""
+    kv = FakeKV()
+    a0, a1 = _pair(kv, epoch="r0.1")
+    assert _run_pair(a0, a1, "opA", "opA") == [None, None]
+    # Same launch, new incarnation, DIFFERENT first collective: under a
+    # shared prefix the stale seq-0 combine would force a false mismatch.
+    b0, b1 = _pair(kv, epoch="r0.2")
+    assert _run_pair(b0, b1, "opB", "opB") == [None, None]
+
+
+def test_lagging_ranks_names_absentee():
+    kv = FakeKV()
+    c0 = ConsistencyChecker(kv, 0, 2, "t", timeout=0.2)
+    with pytest.raises(HorovodTpuError):
+        c0.check("solo-op")
+    assert c0.lagging_ranks() == [1]

@@ -49,13 +49,18 @@ def start_job(tmp_path, mode: str, extra_env=None, total_steps=12):
            "--slots-per-host", "1",
            "--min-num-proc", "1",
            "--elastic-timeout", "120",
+           # Crashed hosts stay out for the whole test: re-admission must
+           # come from the discovery file, not cooldown-expiry racing the
+           # survivor's recovery round.
+           "--blacklist-cooldown-range", "300", "600",
            sys.executable, WORKER, mode]
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
     return proc, hosts_file, progress
 
 
-def wait_for_step(progress, step: int, timeout: float = 90.0) -> None:
+def wait_for_step(progress, step: int, timeout: float = 90.0,
+                  proc=None) -> None:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
@@ -65,7 +70,12 @@ def wait_for_step(progress, step: int, timeout: float = 90.0) -> None:
         except FileNotFoundError:
             pass
         time.sleep(0.2)
-    raise TimeoutError(f"training never reached step {step}")
+    detail = ""
+    if proc is not None:
+        proc.kill()
+        out, _ = proc.communicate()
+        detail = f"; job output:\n{out}"
+    raise TimeoutError(f"training never reached step {step}{detail}")
 
 
 def finish(proc, timeout: float = 180.0) -> str:
@@ -82,7 +92,7 @@ def finish(proc, timeout: float = 180.0) -> str:
 def test_elastic_scale_down_preserves_survivors(tmp_path):
     proc, hosts_file, progress = start_job(tmp_path, "resize")
     write_hosts(hosts_file, "localhost:3")
-    wait_for_step(progress, 3)
+    wait_for_step(progress, 3, proc=proc)
     write_hosts(hosts_file, "localhost:2")
     out = finish(proc)
     # Exactly the 3 original processes booted — survivors were NOT respawned.
@@ -97,7 +107,7 @@ def test_elastic_scale_down_preserves_survivors(tmp_path):
 def test_elastic_scale_up_syncs_new_worker(tmp_path):
     proc, hosts_file, progress = start_job(tmp_path, "resize")
     write_hosts(hosts_file, "localhost:2")
-    wait_for_step(progress, 3)
+    wait_for_step(progress, 3, proc=proc)
     write_hosts(hosts_file, "localhost:3")
     out = finish(proc)
     # 2 original boots + 1 joiner; the joiner must catch up via state sync
@@ -119,7 +129,7 @@ def test_elastic_crash_recovers_from_last_commit(tmp_path):
     write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
     # Wait until past the crash point, then pin the host set to the
     # survivor so cooldown re-admission noise can't interfere.
-    wait_for_step(progress, 6)
+    wait_for_step(progress, 6, proc=proc)
     write_hosts(hosts_file, "localhost:1")
     out = finish(proc)
     assert "CRASHING host=127.0.0.1 step=5" in out, out

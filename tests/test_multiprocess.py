@@ -90,6 +90,47 @@ def test_consistency_mismatch_diagnosed(tmp_path):
 
 @pytest.mark.skipif(not _native_kv_available(),
                     reason="native KV unavailable")
+def test_consistency_subset_process_set(tmp_path):
+    """A subset-set collective must not involve (or desynchronize)
+    non-member ranks (reference: per-ProcessSet controllers)."""
+    env = dict(WORKER_ENV)
+    env["HOROVOD_CONSISTENCY_CHECK"] = "1"
+    env["HOROVOD_CONSISTENCY_TIMEOUT"] = "30"
+    env["HOROVOD_DYNAMIC_PROCESS_SETS"] = "1"
+    out_path = tmp_path / "out.txt"
+    with open(out_path, "w") as f:
+        rc = launch_static(2, "localhost:2",
+                           [sys.executable, WORKER, "consistency_subset"],
+                           env, stdout=f)
+    text = out_path.read_text()
+    assert rc == 0, text
+    for rank in range(2):
+        assert f"MP_WORKER_OK consistency_subset rank={rank}" in text, text
+
+
+@pytest.mark.skipif(not _native_kv_available(),
+                    reason="native KV unavailable")
+def test_consistency_mismatch_before_size_exchange(tmp_path):
+    """allgather-vs-allreduce divergence must be diagnosed before the
+    blocking size exchange can deadlock."""
+    env = dict(WORKER_ENV)
+    env["HOROVOD_CONSISTENCY_CHECK"] = "1"
+    env["HOROVOD_CONSISTENCY_TIMEOUT"] = "30"
+    out_path = tmp_path / "out.txt"
+    with open(out_path, "w") as f:
+        rc = launch_static(
+            2, "localhost:2",
+            [sys.executable, WORKER, "consistency_gather_mismatch"],
+            env, stdout=f)
+    text = out_path.read_text()
+    assert rc == 0, text
+    for rank in range(2):
+        assert (f"MP_WORKER_OK consistency_gather_mismatch rank={rank}"
+                in text), text
+
+
+@pytest.mark.skipif(not _native_kv_available(),
+                    reason="native KV unavailable")
 def test_consistency_missing_rank_named(tmp_path):
     env = dict(WORKER_ENV)
     env["HOROVOD_CONSISTENCY_CHECK"] = "1"
