@@ -17,6 +17,8 @@ reference-API users a drop-in surface:
 
 from __future__ import annotations
 
+import concurrent.futures
+import threading
 from typing import Any, Iterable, Optional, Tuple
 
 import numpy as np
@@ -40,6 +42,31 @@ def _torch():
     return torch
 
 
+class Compression:
+    """Gradient compression hooks (reference: torch/compression.py —
+    Compression.none / Compression.fp16)."""
+
+    class none:
+        @staticmethod
+        def compress(tensor):
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor
+
+    class fp16:
+        @staticmethod
+        def compress(tensor):
+            if tensor.dtype.is_floating_point:
+                return tensor.type(_torch().float16), tensor.dtype
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor.type(ctx) if ctx is not None else tensor
+
+
 def _to_np(t) -> np.ndarray:
     torch = _torch()
     if isinstance(t, torch.Tensor):
@@ -55,14 +82,92 @@ def _like(arr, ref):
     return out
 
 
+# --------------------------------------------------------------------------
+# Collective serialization (reference: the background thread serializes all
+# collective execution, operations.cc BackgroundThreadLoop).
+# EVERY torch-frontend collective — sync or async — runs on one executor
+# thread, so dispatch order == call order process-wide even while async
+# handles are in flight; interleaving a sync op past a pending async op
+# would break the cross-rank SPMD ordering contract.
+# --------------------------------------------------------------------------
+
+_POOL_THREAD_NAME = "hvd-torch-async"
+_async_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_async_lock = threading.Lock()
+
+
+def _pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _async_pool
+    with _async_lock:
+        if _async_pool is None:
+            _async_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=_POOL_THREAD_NAME)
+        return _async_pool
+
+
+def _on_pool_thread() -> bool:
+    return threading.current_thread().name.startswith(_POOL_THREAD_NAME)
+
+
+def _run_serialized(fn, *args, **kwargs):
+    """Run a collective in submission order with any pending async work
+    (direct call when already on the executor thread — nested collectives
+    like the sparse path's gathers must not self-deadlock)."""
+    if _on_pool_thread():
+        return fn(*args, **kwargs)
+    return _pool().submit(fn, *args, **kwargs).result()
+
+
+def _sparse_allreduce(tensor, average: Optional[bool], op,
+                      process_set: Optional[ProcessSet],
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    """Sparse allreduce = allgather of indices+values, coalesced sum
+    (reference: torch/mpi_ops.py:260 sparse path via allgather).
+    Pre/post scales apply to the values like the dense ScaleBuffer path."""
+    torch = _torch()
+    t = tensor.coalesce()
+    idx = t.indices()       # (ndim, nnz)
+    val = t.values()        # (nnz, *dense_dims)
+    if prescale_factor != 1.0:
+        val = val * prescale_factor
+    all_idx = _run_serialized(C.allgather, _to_np(idx.t().contiguous()),
+                              process_set=process_set)
+    all_val = _run_serialized(C.allgather, _to_np(val),
+                              process_set=process_set)
+    all_idx_t = _like(all_idx, idx).t().long()
+    all_val_t = _like(all_val, val)
+    out = torch.sparse_coo_tensor(all_idx_t, all_val_t,
+                                  size=t.shape).coalesce()
+    if op is None:
+        rop = Average if (average is None or average) else Sum
+    else:
+        rop = op
+    scale = postscale_factor
+    if rop == Average:
+        ps = process_set if process_set is not None else global_process_set
+        scale = scale / ps.size()
+    if scale != 1.0:
+        out = torch.sparse_coo_tensor(out.indices(), out.values() * scale,
+                                      size=t.shape).coalesce()
+    return out
+
+
 def allreduce(tensor, average: Optional[bool] = None, name=None, op=None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               process_set: Optional[ProcessSet] = None):
-    """Reference: hvd.allreduce (torch/mpi_ops.py:260)."""
-    out = C.allreduce(_to_np(tensor), average=average, name=name, op=op,
-                      prescale_factor=prescale_factor,
-                      postscale_factor=postscale_factor,
-                      process_set=process_set)
+    """Reference: hvd.allreduce (torch/mpi_ops.py:260). Sparse tensors take
+    the allgather-and-coalesce path like the reference."""
+    torch = _torch()
+    if isinstance(tensor, torch.Tensor) and tensor.is_sparse:
+        return _sparse_allreduce(tensor, average, op, process_set,
+                                 prescale_factor=prescale_factor,
+                                 postscale_factor=postscale_factor)
+    out = _run_serialized(C.allreduce, _to_np(tensor), average=average,
+                          name=name, op=op,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set)
     return _like(out, tensor)
 
 
@@ -74,14 +179,16 @@ def allreduce_(tensor, **kw):
 
 
 def grouped_allreduce(tensors, **kw):
-    outs = C.grouped_allreduce([_to_np(t) for t in tensors], **kw)
+    outs = _run_serialized(C.grouped_allreduce,
+                           [_to_np(t) for t in tensors], **kw)
     return [_like(o, t) for o, t in zip(outs, tensors)]
 
 
 def broadcast(tensor, root_rank: int, name=None,
               process_set: Optional[ProcessSet] = None):
-    out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
-                      process_set=process_set)
+    out = _run_serialized(C.broadcast, _to_np(tensor),
+                          root_rank=root_rank, name=name,
+                          process_set=process_set)
     return _like(out, tensor)
 
 
@@ -91,40 +198,103 @@ def broadcast_(tensor, root_rank: int, **kw):
 
 
 def allgather(tensor, name=None, process_set: Optional[ProcessSet] = None):
-    out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
+    out = _run_serialized(C.allgather, _to_np(tensor), name=name,
+                          process_set=process_set)
     return _like(out, tensor)
 
 
 def reducescatter(tensor, op=Average,
                   process_set: Optional[ProcessSet] = None, **kw):
-    out = C.reducescatter(_to_np(tensor), op=op, process_set=process_set,
-                          **kw)
+    out = _run_serialized(C.reducescatter, _to_np(tensor), op=op,
+                          process_set=process_set, **kw)
     return _like(out, tensor)
 
 
 def alltoall(tensor, splits=None, name=None,
              process_set: Optional[ProcessSet] = None):
-    out, recv = C.alltoall(_to_np(tensor), splits=splits, name=name,
-                           process_set=process_set)
+    out, recv = _run_serialized(C.alltoall, _to_np(tensor), splits=splits,
+                                name=name, process_set=process_set)
     return _like(out, tensor), _like(recv, tensor).long()
 
 
 def barrier(process_set: Optional[ProcessSet] = None):
-    C.barrier(process_set=process_set)
+    _run_serialized(C.barrier, process_set=process_set)
 
 
-# Async API parity: dispatch is synchronous through numpy, so the handle is
-# the result (reference handles: torch/handle_manager.h).
-def allreduce_async(tensor, **kw):
-    return allreduce(tensor, **kw)
+# --------------------------------------------------------------------------
+# Async API (reference: torch/handle_manager.h + mpi_ops.py *_async).
+# Handles wrap futures on the shared single-thread executor; `poll` reports
+# real completion.
+# --------------------------------------------------------------------------
+
+class _Handle:
+    """An in-flight collective (reference: HandleManager handles)."""
+
+    def __init__(self, future, ref, target=None):
+        self.future = future
+        self.ref = ref
+        self.target = target  # in-place variants copy back on synchronize
+
+    def done(self) -> bool:
+        return self.future.done()
+
+
+def allreduce_async(tensor, average: Optional[bool] = None, name=None,
+                    op=None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set: Optional[ProcessSet] = None):
+    arr = _to_np(tensor)  # snapshot on the caller thread
+    fut = _pool().submit(C.allreduce, arr, average=average, name=name,
+                         op=op, prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor,
+                         process_set=process_set)
+    return _Handle(fut, tensor)
+
+
+def allreduce_async_(tensor, **kw):
+    h = allreduce_async(tensor, **kw)
+    h.target = tensor
+    return h
+
+
+def broadcast_async(tensor, root_rank: int, name=None,
+                    process_set: Optional[ProcessSet] = None):
+    arr = _to_np(tensor)
+    fut = _pool().submit(C.broadcast, arr, root_rank=root_rank, name=name,
+                         process_set=process_set)
+    return _Handle(fut, tensor)
+
+
+def broadcast_async_(tensor, root_rank: int, **kw):
+    h = broadcast_async(tensor, root_rank, **kw)
+    h.target = tensor
+    return h
+
+
+def allgather_async(tensor, name=None,
+                    process_set: Optional[ProcessSet] = None):
+    arr = _to_np(tensor)
+    fut = _pool().submit(C.allgather, arr, name=name,
+                         process_set=process_set)
+    return _Handle(fut, tensor)
 
 
 def synchronize(handle):
-    return handle
+    """Wait for an async handle and return its result (reference:
+    mpi_ops.py:1269). Non-handle values pass through (sync-API results)."""
+    if not isinstance(handle, _Handle):
+        return handle
+    out = _like(handle.future.result(), handle.ref)
+    if handle.target is not None:
+        handle.target.copy_(out)
+        return handle.target
+    return out
 
 
 def poll(handle) -> bool:
-    return True
+    """True once the collective has completed (reference: poll, the handle
+    is safe to synchronize without blocking)."""
+    return handle.done() if isinstance(handle, _Handle) else True
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:
@@ -157,33 +327,70 @@ class DistributedOptimizer:
     """Reference: torch/optimizer.py:36 `_DistributedOptimizer` — allreduce
     gradients before each step. Hook-free variant: gradients are averaged
     in `step()` (grouped/fused), matching the semantics of the reference's
-    synchronize()+step path."""
+    synchronize()+step path. `compression` wraps each gradient (reference
+    :174 _allreduce_grad_async applies compress/decompress around the
+    collective); `gradient_predivide_factor` splits the averaging into
+    pre/post scales to tame fp16 overflow (reference :84-97 — Average
+    only); sparse gradients take the allgather path (or densify with
+    `sparse_as_dense`, reference :52)."""
 
     def __init__(self, optimizer, named_parameters=None,
                  compression=None, backward_passes_per_step: int = 1,
                  op=Average, gradient_predivide_factor: float = 1.0,
+                 sparse_as_dense: bool = False,
                  process_set: Optional[ProcessSet] = None):
+        if gradient_predivide_factor != 1.0 and op != Average:
+            raise ValueError(
+                "gradient_predivide_factor not supported with op != Average "
+                "(reference: torch/optimizer.py)")
         self.opt = optimizer
         self.op = op
         self.process_set = process_set
+        self.compression = compression or Compression.none
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.sparse_as_dense = sparse_as_dense
         self._bpps = backward_passes_per_step
         self._count = 0
 
     def __getattr__(self, name):
         return getattr(self.opt, name)
 
+    def _reduce_grads(self) -> None:
+        dense, sparse = [], []
+        for group in self.opt.param_groups:
+            for p in group["params"]:
+                if p.grad is None:
+                    continue
+                if p.grad.is_sparse:
+                    if self.sparse_as_dense:
+                        p.grad = p.grad.to_dense()
+                        dense.append(p)
+                    else:
+                        sparse.append(p)
+                else:
+                    dense.append(p)
+        if dense:
+            pre = post = 1.0
+            if self.gradient_predivide_factor != 1.0:
+                # mean = (Σ g/f) · f / k — numerically gentler in fp16.
+                pre = 1.0 / self.gradient_predivide_factor
+                post = self.gradient_predivide_factor
+            pairs = [self.compression.compress(p.grad.data) for p in dense]
+            reduced = grouped_allreduce(
+                [t for t, _ in pairs], op=self.op,
+                prescale_factor=pre, postscale_factor=post,
+                process_set=self.process_set)
+            for p, r, (_, ctx) in zip(dense, reduced, pairs):
+                p.grad.data.copy_(self.compression.decompress(r, ctx))
+        for p in sparse:
+            p.grad = _sparse_allreduce(
+                p.grad, average=(self.op == Average),
+                op=self.op, process_set=self.process_set)
+
     def step(self, closure=None):
         self._count += 1
         if self._count % self._bpps == 0:
-            params_with_grad = [
-                p for group in self.opt.param_groups
-                for p in group["params"] if p.grad is not None]
-            if params_with_grad:
-                grads = [p.grad.data for p in params_with_grad]
-                reduced = grouped_allreduce(grads, op=self.op,
-                                            process_set=self.process_set)
-                for p, g in zip(params_with_grad, reduced):
-                    p.grad.data.copy_(g)
+            self._reduce_grads()
         return self.opt.step(closure)
 
     def zero_grad(self, *a, **kw):
