@@ -58,16 +58,79 @@ class RayExecutor:
                 os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port)
 
             def execute(self, fn, *args, **kwargs):
-                return fn(*args, **kwargs)
+                from horovod_tpu.runner.results import capture
+                return capture(fn, *args, **kwargs)
 
+        self._worker_cls = Worker
         self._actors = [Worker.remote(i, self.num_workers, self.env_vars)
                         for i in range(self.num_workers)]
 
-    def run(self, fn: Callable, args=(), kwargs=None) -> List[Any]:
+    def _collect(self, fn, args, kwargs):
+        """Submit `fn` to every actor; gather (results, dead_ranks).
+
+        Uses ray.wait so an actor DEATH is observed even while survivors
+        are blocked inside a collective against the dead peer (peer death
+        does not reliably surface as an error in the survivors — the same
+        reality is_comm_failure handles in the elastic launcher path).
+        Returns as soon as a death is seen; the caller decides whether to
+        fail the job or restart the ring."""
         ray = _require_ray()
-        kwargs = kwargs or {}
-        return ray.get([a.execute.remote(fn, *args, **kwargs)
-                        for a in self._actors])
+
+        from horovod_tpu.runner.results import PerRankResults
+        futures = {a.execute.remote(fn, *args, **kwargs): rank
+                   for rank, a in enumerate(self._actors)}
+        collected = PerRankResults(self.num_workers)
+        pending = list(futures)
+        dead: List[int] = []
+        while pending and not dead:
+            done, pending = ray.wait(pending, num_returns=1)
+            for fut in done:
+                rank = futures[fut]
+                try:
+                    ok, payload = ray.get(fut)
+                    collected.add(rank, ok, payload)
+                except Exception:  # RayActorError — the actor process died
+                    dead.append(rank)
+        return collected, dead
+
+    def _restart_ring(self) -> None:
+        """Kill every actor and recreate the full ring: survivors may be
+        blocked inside a collective against a dead peer and cannot accept
+        new work (reference: elastic reset re-forms the whole Gloo ring)."""
+        ray = _require_ray()
+        for a in self._actors:
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
+        self._actors = [self._worker_cls.remote(i, self.num_workers,
+                                                self.env_vars)
+                        for i in range(self.num_workers)]
+
+    def run(self, fn: Callable, args=(), kwargs=None) -> List[Any]:
+        """Execute `fn` on every worker; per-rank results in rank order.
+        A failing rank raises RemoteJobError naming it with its remote
+        traceback (reference: run_remote + ray.get surface task errors)."""
+        from horovod_tpu.runner.results import RemoteJobError
+        collected, dead = self._collect(fn, args, kwargs or {})
+        if dead:
+            self._restart_ring()  # unblock survivors; job has failed
+            raise RemoteJobError(
+                f"worker actor(s) for rank(s) {sorted(dead)} died "
+                f"(preemption or crash); surviving workers were restarted")
+        return collected.values()
+
+    def execute_single(self, fn: Callable, rank: int = 0,
+                       args=(), kwargs=None) -> Any:
+        """Run `fn` on one worker (reference: RayExecutor.execute_single)."""
+        ray = _require_ray()
+        ok, payload = ray.get(
+            self._actors[rank].execute.remote(fn, *(args or ()),
+                                              **(kwargs or {})))
+        if not ok:
+            from horovod_tpu.runner.results import RemoteJobError
+            raise RemoteJobError(f"rank {rank} failed:\n{payload}")
+        return payload
 
     def shutdown(self) -> None:
         ray = _require_ray()
@@ -77,3 +140,33 @@ class RayExecutor:
         if self._rdv is not None:
             self._rdv.stop()
             self._rdv = None
+
+
+class ElasticRayExecutor(RayExecutor):
+    """Elastic variant: dead actors are recreated and the function retried
+    (reference: ray/elastic_v2.py — workers lost to preemption are
+    restarted from the autoscaler pool within retry limits). State recovery
+    rides the same hvd.elastic.run/State machinery as the launcher path."""
+
+    def __init__(self, *args, max_restarts: int = 3, **kwargs):
+        super().__init__(*args, **kwargs)
+        from horovod_tpu.runner.results import RestartPolicy
+        self.policy = RestartPolicy(max_restarts=max_restarts)
+
+    def run(self, fn: Callable, args=(), kwargs=None) -> List[Any]:
+        from horovod_tpu.runner.results import RemoteJobError
+        kwargs = kwargs or {}
+        while True:
+            collected, dead = self._collect(fn, args, kwargs)
+            if not dead:
+                return collected.values()
+            for rank in dead:
+                if not self.policy.should_restart(rank):
+                    raise RemoteJobError(
+                        f"rank {rank} exceeded {self.policy.max_restarts} "
+                        f"restarts (reference: elastic_v2 retry limits)")
+                self.policy.record_restart(rank)
+            # The whole ring restarts (survivors are blocked against the
+            # dead peer); in-actor state recovers through the user's
+            # hvd.elastic.State commit/restore like the launcher path.
+            self._restart_ring()

@@ -55,20 +55,29 @@ def run(fn: Callable[[], Any], args=(), kwargs=None, num_proc: Optional[int] = N
 
     def task_fn(index, _it):
         # Reference: _task_fn (spark/runner.py:49) — set worker identity env
-        # then run the user function.
+        # then run the user function. Exceptions travel back as data so the
+        # driver can name the failing rank(s) with their remote tracebacks
+        # instead of surfacing an opaque Spark task failure.
         import os as _os
         import cloudpickle as _cp
+
+        from horovod_tpu.runner.results import capture
         _os.environ.update(env)
         _os.environ["HOROVOD_RANK"] = str(index)
         _os.environ["HOROVOD_SIZE"] = str(np_)
         _os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = addr
         _os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port)
         f, a, kw = _cp.loads(payload)
-        yield (index, f(*a, **kw))
+        ok, result = capture(f, *a, **kw)
+        yield (index, ok, result)
 
+    from horovod_tpu.runner.results import PerRankResults
+    collected = PerRankResults(np_)
     try:
-        results = (sc.parallelize(range(np_), np_)
-                   .mapPartitionsWithIndex(task_fn).collect())
+        for index, ok, result in (sc.parallelize(range(np_), np_)
+                                  .mapPartitionsWithIndex(task_fn)
+                                  .collect()):
+            collected.add(index, ok, result)
     finally:
         rdv.stop()
-    return [r for _, r in sorted(results)]
+    return collected.values()

@@ -1,0 +1,52 @@
+"""Per-rank result/error collection (runner/results.py) — the shared logic
+behind the Spark/Ray integrations' driver-side error reporting (reference:
+spark/runner.py task error surfacing, ray/elastic_v2.py retry limits)."""
+
+import pytest
+
+from horovod_tpu.runner.results import (PerRankResults, RemoteJobError,
+                                        RestartPolicy, capture)
+
+
+def test_capture_roundtrips_result_and_traceback():
+    ok, val = capture(lambda x: x + 1, 41)
+    assert ok and val == 42
+    ok, tb = capture(lambda: 1 / 0)
+    assert not ok
+    assert "ZeroDivisionError" in tb
+
+
+def test_per_rank_results_ordered():
+    r = PerRankResults(3)
+    for rank in (2, 0, 1):  # out-of-order arrival
+        r.add(rank, True, f"v{rank}")
+    assert r.values() == ["v0", "v1", "v2"]
+
+
+def test_per_rank_results_names_failures():
+    r = PerRankResults(3)
+    r.add(0, True, "ok")
+    r.add(1, False, "Traceback ... boom")
+    r.add(2, True, "ok")
+    with pytest.raises(RemoteJobError) as ei:
+        r.values()
+    assert "rank 1 failed" in str(ei.value)
+    assert "boom" in str(ei.value)
+
+
+def test_per_rank_results_names_missing():
+    r = PerRankResults(2)
+    r.add(0, True, "ok")
+    with pytest.raises(RemoteJobError) as ei:
+        r.values()
+    assert "[1]" in str(ei.value)
+
+
+def test_restart_policy_limits():
+    p = RestartPolicy(max_restarts=2)
+    assert p.should_restart(0)
+    p.record_restart(0)
+    p.record_restart(0)
+    assert not p.should_restart(0)
+    assert p.should_restart(1)  # per-rank accounting
+    assert p.restarts(0) == 2
