@@ -1,0 +1,40 @@
+# CI entry points — the reference's three-tier test strategy in miniature
+# (SURVEY.md §4; reference: .buildkite/gen-pipeline.sh):
+#   tier 1  unit suites on an 8-device virtual CPU mesh (tests/conftest.py)
+#   tier 2  multi-process collective correctness over loopback
+#   tier 3  end-to-end launcher/elastic jobs + the driver entry hooks
+#
+#   make test        everything (what CI runs)
+#   make test-fast   tier 1 only, minus the slow e2e suites
+#   make native      build the native control-plane library
+#   make bench       one-line JSON benchmark (real accelerator if present)
+
+PYTHON ?= python
+PYTEST ?= $(PYTHON) -m pytest -q
+
+.PHONY: test test-fast test-unit test-multiprocess test-e2e entry native bench lint
+
+test: test-unit test-multiprocess test-e2e entry
+
+test-fast:
+	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
+	    --ignore=tests/test_elastic_e2e.py -x
+
+test-unit:
+	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
+	    --ignore=tests/test_elastic_e2e.py
+
+test-multiprocess:
+	$(PYTEST) tests/test_multiprocess.py
+
+test-e2e:
+	$(PYTEST) tests/test_elastic_e2e.py
+
+entry:
+	$(PYTHON) __graft_entry__.py
+
+native:
+	$(MAKE) -C horovod_tpu/native
+
+bench:
+	$(PYTHON) bench.py
