@@ -1,0 +1,323 @@
+"""TensorFlow frontend: the `horovod.tensorflow` API surface over the TPU
+engine.
+
+Reference: horovod/tensorflow/mpi_ops.py (collectives),
+horovod/tensorflow/__init__.py `DistributedGradientTape` (:1125) /
+`DistributedOptimizer` (:896) / `broadcast_variables`,
+horovod/tensorflow/compression.py, horovod/_keras/callbacks.py.
+
+TF tensors cross the boundary as numpy; the collective itself runs as a
+compiled XLA program over the mesh (the reference's own XLA custom-call
+path, tensorflow/xla_mpi_ops.cc, is the pattern this generalizes). Eager
+TF2 only — the graph-mode AsyncOpKernel machinery has no TPU-side analog
+to build against.
+
+    import horovod_tpu.frontends.tensorflow as hvd
+    hvd.init()
+    tape = hvd.DistributedGradientTape(tape)
+    hvd.broadcast_variables(model.variables, root_rank=0)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from horovod_tpu.common import types as T
+from horovod_tpu.core.topology import (  # noqa: F401
+    init, is_initialized, local_rank, local_size, rank, shutdown, size,
+)
+from horovod_tpu.core.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, global_process_set, remove_process_set,
+)
+from horovod_tpu.ops import collectives as C
+
+Average = T.ReduceOp.AVERAGE
+Sum = T.ReduceOp.SUM
+Adasum = T.ReduceOp.ADASUM
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+class Compression:
+    """Reference: tensorflow/compression.py — Compression.none/.fp16."""
+
+    class none:
+        @staticmethod
+        def compress(tensor):
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor
+
+    class fp16:
+        @staticmethod
+        def compress(tensor):
+            tf = _tf()
+            if tensor.dtype.is_floating:
+                return tf.cast(tensor, tf.float16), tensor.dtype
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return _tf().cast(tensor, ctx) if ctx is not None else tensor
+
+
+def _to_np(t) -> np.ndarray:
+    tf = _tf()
+    if isinstance(t, tf.Tensor) or isinstance(t, tf.Variable):
+        return t.numpy()
+    return np.asarray(t)
+
+
+def _like(arr, ref, keep_shape: bool = False):
+    tf = _tf()
+    out = tf.convert_to_tensor(np.ascontiguousarray(np.asarray(arr)))
+    ref_dtype = getattr(ref, "dtype", None)
+    if ref_dtype is not None and out.dtype != ref_dtype:
+        out = tf.cast(out, ref_dtype)
+    if keep_shape:
+        # Same-shape collectives (allreduce/broadcast): restore the exact
+        # input shape — the engine's per-rank lifting turns () into (1,).
+        ref_shape = getattr(ref, "shape", None)
+        if ref_shape is not None and tuple(out.shape) != tuple(ref_shape):
+            out = tf.reshape(out, ref_shape)
+    return out
+
+
+def allreduce(tensor, average: Optional[bool] = None, name=None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None):
+    """Reference: hvd.allreduce (tensorflow/mpi_ops.py)."""
+    out = C.allreduce(_to_np(tensor), average=average, name=name, op=op,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set)
+    return _like(out, tensor, keep_shape=True)
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None, name=None,
+                      op=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      process_set: Optional[ProcessSet] = None) -> List[Any]:
+    outs = C.grouped_allreduce([_to_np(t) for t in tensors],
+                               average=average, op=op,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor,
+                               process_set=process_set)
+    return [_like(o, t, keep_shape=True) for o, t in zip(outs, tensors)]
+
+
+def broadcast(tensor, root_rank: int, name=None,
+              process_set: Optional[ProcessSet] = None):
+    out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
+                      process_set=process_set)
+    return _like(out, tensor, keep_shape=True)
+
+
+def allgather(tensor, name=None, process_set: Optional[ProcessSet] = None):
+    out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
+    return _like(out, tensor)
+
+
+def reducescatter(tensor, op=Average,
+                  process_set: Optional[ProcessSet] = None, **kw):
+    out = C.reducescatter(_to_np(tensor), op=op, process_set=process_set,
+                          **kw)
+    return _like(out, tensor)
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set: Optional[ProcessSet] = None):
+    out, recv = C.alltoall(_to_np(tensor), splits=splits, name=name,
+                           process_set=process_set)
+    tf = _tf()
+    return _like(out, tensor), tf.cast(_like(recv, tensor), tf.int64)
+
+
+def barrier(process_set: Optional[ProcessSet] = None):
+    C.barrier(process_set=process_set)
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """In-place sync of tf.Variables from root (reference:
+    tensorflow/__init__.py broadcast_variables)."""
+    for v in variables:
+        v.assign(broadcast(v, root_rank))
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None):
+    from horovod_tpu.optim.functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank, name=name)
+
+
+def _make_allreduce_grads_fn(op, gradient_predivide_factor: float,
+                             compression, process_set):
+    """Reference: tensorflow/__init__.py:631 _make_allreduce_grads_fn —
+    compression + predivide-split averaging around one grouped allreduce."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average "
+            "(reference: tensorflow/__init__.py)")
+    pre = post = 1.0
+    if gradient_predivide_factor != 1.0:
+        pre = 1.0 / gradient_predivide_factor
+        post = gradient_predivide_factor
+
+    def allreduce_grads(grads):
+        idxs = [i for i, g in enumerate(grads) if g is not None]
+        comp = [compression.compress(grads[i]) for i in idxs]
+        reduced = grouped_allreduce(
+            [t for t, _ in comp], op=op, prescale_factor=pre,
+            postscale_factor=post, process_set=process_set) if comp else []
+        out: List[Any] = [None] * len(grads)
+        for i, r, (_, ctx) in zip(idxs, reduced, comp):
+            out[i] = compression.decompress(r, ctx)
+        return out
+
+    return allreduce_grads
+
+
+class DistributedGradientTape:
+    """Reference: tensorflow/__init__.py:1125 — wraps tf.GradientTape so
+    gradient() returns cross-rank (grouped, fused) reduced gradients."""
+
+    def __init__(self, gradtape, compression=None, op=Average,
+                 gradient_predivide_factor: float = 1.0,
+                 process_set: Optional[ProcessSet] = None):
+        self.tape = gradtape
+        self._allreduce_grads = _make_allreduce_grads_fn(
+            op, gradient_predivide_factor,
+            compression or Compression.none, process_set)
+
+    def __enter__(self):
+        return self.tape.__enter__()
+
+    def __exit__(self, *args):
+        return self.tape.__exit__(*args)
+
+    def __getattr__(self, name):
+        return getattr(self.tape, name)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self.tape.gradient(target, sources, output_gradients)
+        single = not isinstance(grads, (list, tuple))
+        out = self._allreduce_grads([grads] if single else list(grads))
+        return out[0] if single else out
+
+
+class DistributedOptimizer:
+    """Keras-3 optimizer wrapper (reference: tensorflow/__init__.py:896 +
+    keras/__init__.py DistributedOptimizer): gradients are reduced across
+    ranks before apply, with local aggregation every
+    `backward_passes_per_step` steps."""
+
+    def __init__(self, optimizer, compression=None, op=Average,
+                 gradient_predivide_factor: float = 1.0,
+                 backward_passes_per_step: int = 1,
+                 process_set: Optional[ProcessSet] = None):
+        self.opt = optimizer
+        self._allreduce_grads = _make_allreduce_grads_fn(
+            op, gradient_predivide_factor,
+            compression or Compression.none, process_set)
+        self._bpps = backward_passes_per_step
+        self._count = 0
+        self._accum: Optional[List[Any]] = None
+
+    def __getattr__(self, name):
+        return getattr(self.opt, name)
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        tf = _tf()
+        grads, tvars = zip(*list(grads_and_vars))
+        self._count += 1
+        if self._bpps > 1:
+            # Local gradient aggregation (reference:
+            # tensorflow/gradient_aggregation_eager.py).
+            if self._accum is None:
+                self._accum = [tf.zeros_like(g) if g is not None else None
+                               for g in grads]
+            self._accum = [a + g if g is not None else a
+                           for a, g in zip(self._accum, grads)]
+            if self._count % self._bpps != 0:
+                return
+            grads = [a / self._bpps if a is not None else None
+                     for a in self._accum]
+            self._accum = None
+        reduced = self._allreduce_grads(list(grads))
+        return self.opt.apply_gradients(zip(reduced, tvars), **kwargs)
+
+
+# -- Keras callbacks (reference: horovod/_keras/callbacks.py) --------------
+
+def _keras_callback_base():
+    import keras
+    return keras.callbacks.Callback
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast initial variables from root at train start (reference:
+    _keras/callbacks.py:23). Implemented as a factory returning a Keras
+    callback so the keras import stays lazy."""
+
+    def __new__(cls, root_rank: int = 0):
+        Base = _keras_callback_base()
+
+        class _CB(Base):
+            def __init__(self, root):
+                super().__init__()
+                self.root = root
+                self._done = False
+
+            def on_train_begin(self, logs=None):
+                if not self._done:
+                    broadcast_variables(self.model.variables, self.root)
+                    self._done = True
+
+        return _CB(root_rank)
+
+
+class MetricAverageCallback:
+    """Average logged metrics across ranks at epoch end (reference:
+    _keras/callbacks.py:62)."""
+
+    def __new__(cls):
+        Base = _keras_callback_base()
+
+        class _CB(Base):
+            def on_epoch_end(self, epoch, logs=None):
+                if logs:
+                    for k, v in list(logs.items()):
+                        logs[k] = float(np.asarray(
+                            C.allreduce(np.asarray(v, np.float32),
+                                        op=Average)))
+
+        return _CB()
+
+
+class LearningRateWarmupCallback:
+    """Linear LR warmup over the first epochs (reference:
+    _keras/callbacks.py:193 — scale to size after warmup)."""
+
+    def __new__(cls, initial_lr: float, warmup_epochs: int = 5,
+                verbose: int = 0):
+        Base = _keras_callback_base()
+
+        class _CB(Base):
+            def __init__(self):
+                super().__init__()
+                self.initial_lr = initial_lr
+                self.warmup_epochs = warmup_epochs
+
+            def on_epoch_begin(self, epoch, logs=None):
+                if epoch < self.warmup_epochs:
+                    factor = (epoch + 1) / self.warmup_epochs
+                    self.model.optimizer.learning_rate.assign(
+                        self.initial_lr * factor)
+
+        return _CB()

@@ -74,11 +74,15 @@ def _to_np(t) -> np.ndarray:
     return np.asarray(t)
 
 
-def _like(arr, ref):
+def _like(arr, ref, keep_shape: bool = False):
     torch = _torch()
     out = torch.from_numpy(np.ascontiguousarray(np.asarray(arr)))
     if isinstance(ref, torch.Tensor):
-        return out.to(dtype=ref.dtype, device=ref.device)
+        out = out.to(dtype=ref.dtype, device=ref.device)
+        if keep_shape and out.shape != ref.shape:
+            # Same-shape collectives: restore the exact input shape — the
+            # engine's per-rank lifting turns () into (1,).
+            out = out.reshape(ref.shape)
     return out
 
 
@@ -168,7 +172,7 @@ def allreduce(tensor, average: Optional[bool] = None, name=None, op=None,
                           prescale_factor=prescale_factor,
                           postscale_factor=postscale_factor,
                           process_set=process_set)
-    return _like(out, tensor)
+    return _like(out, tensor, keep_shape=True)
 
 
 def allreduce_(tensor, **kw):
@@ -181,7 +185,7 @@ def allreduce_(tensor, **kw):
 def grouped_allreduce(tensors, **kw):
     outs = _run_serialized(C.grouped_allreduce,
                            [_to_np(t) for t in tensors], **kw)
-    return [_like(o, t) for o, t in zip(outs, tensors)]
+    return [_like(o, t, keep_shape=True) for o, t in zip(outs, tensors)]
 
 
 def broadcast(tensor, root_rank: int, name=None,
@@ -189,7 +193,7 @@ def broadcast(tensor, root_rank: int, name=None,
     out = _run_serialized(C.broadcast, _to_np(tensor),
                           root_rank=root_rank, name=name,
                           process_set=process_set)
-    return _like(out, tensor)
+    return _like(out, tensor, keep_shape=True)
 
 
 def broadcast_(tensor, root_rank: int, **kw):
@@ -230,10 +234,11 @@ def barrier(process_set: Optional[ProcessSet] = None):
 class _Handle:
     """An in-flight collective (reference: HandleManager handles)."""
 
-    def __init__(self, future, ref, target=None):
+    def __init__(self, future, ref, target=None, same_shape=False):
         self.future = future
         self.ref = ref
         self.target = target  # in-place variants copy back on synchronize
+        self.same_shape = same_shape  # allreduce/broadcast keep the shape
 
     def done(self) -> bool:
         return self.future.done()
@@ -248,7 +253,7 @@ def allreduce_async(tensor, average: Optional[bool] = None, name=None,
                          op=op, prescale_factor=prescale_factor,
                          postscale_factor=postscale_factor,
                          process_set=process_set)
-    return _Handle(fut, tensor)
+    return _Handle(fut, tensor, same_shape=True)
 
 
 def allreduce_async_(tensor, **kw):
@@ -262,7 +267,7 @@ def broadcast_async(tensor, root_rank: int, name=None,
     arr = _to_np(tensor)
     fut = _pool().submit(C.broadcast, arr, root_rank=root_rank, name=name,
                          process_set=process_set)
-    return _Handle(fut, tensor)
+    return _Handle(fut, tensor, same_shape=True)
 
 
 def broadcast_async_(tensor, root_rank: int, **kw):
@@ -284,7 +289,8 @@ def synchronize(handle):
     mpi_ops.py:1269). Non-handle values pass through (sync-API results)."""
     if not isinstance(handle, _Handle):
         return handle
-    out = _like(handle.future.result(), handle.ref)
+    out = _like(handle.future.result(), handle.ref,
+                keep_shape=handle.same_shape)
     if handle.target is not None:
         handle.target.copy_(out)
         return handle.target

@@ -1,0 +1,94 @@
+"""TensorFlow frontend tests (reference analog: test/parallel/
+test_tensorflow.py — collective semantics through the TF API surface)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+def test_tf_allreduce_roundtrip(hvd):
+    import horovod_tpu.frontends.tensorflow as tfvd
+    x = tf.reshape(tf.range(6, dtype=tf.float32), (2, 3))
+    y = tfvd.allreduce(x)  # average of identical copies == identity
+    assert isinstance(y, tf.Tensor)
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+    s = tfvd.allreduce(x, op=tfvd.Sum)
+    np.testing.assert_allclose(s.numpy(), x.numpy() * tfvd.size())
+
+
+def test_tf_broadcast_variables(hvd):
+    import horovod_tpu.frontends.tensorflow as tfvd
+    v = tf.Variable(tf.ones((3,)) * (tfvd.rank() + 7))
+    tfvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), 7.0)
+
+
+def test_tf_allgather_alltoall(hvd):
+    import horovod_tpu.frontends.tensorflow as tfvd
+    k = tfvd.size()
+    g = tfvd.allgather(tf.ones((2, 3)))
+    assert g.shape == (2 * k, 3)
+    out, recv = tfvd.alltoall(tf.ones((2 * k, 3)))
+    assert out.shape == (2 * k, 3)
+    np.testing.assert_array_equal(recv.numpy(), np.full(k, 2))
+
+
+def test_tf_distributed_gradient_tape(hvd):
+    import horovod_tpu.frontends.tensorflow as tfvd
+    w = tf.Variable([[2.0]])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(w * 3.0)
+    dtape = tfvd.DistributedGradientTape(tape)
+    (grad,) = dtape.gradient(loss, [w])
+    # identical ranks → average == local gradient
+    np.testing.assert_allclose(grad.numpy(), [[3.0]], rtol=1e-6)
+
+
+def test_tf_tape_compression_and_predivide(hvd):
+    import horovod_tpu.frontends.tensorflow as tfvd
+    with pytest.raises(ValueError):
+        tfvd.DistributedGradientTape(tf.GradientTape(), op=tfvd.Sum,
+                                     gradient_predivide_factor=2.0)
+    w = tf.Variable(tf.ones((4, 4)))
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(w * 0.5)
+    dtape = tfvd.DistributedGradientTape(
+        tape, compression=tfvd.Compression.fp16,
+        gradient_predivide_factor=4.0)
+    (grad,) = dtape.gradient(loss, [w])
+    assert grad.dtype == tf.float32  # decompressed back
+    np.testing.assert_allclose(grad.numpy(), 0.5, rtol=1e-2)
+
+
+def test_tf_distributed_optimizer(hvd):
+    import keras
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+    v = tf.Variable(1.0)
+    opt = tfvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.1))
+    opt.apply_gradients([(tf.constant(2.0), v)])
+    # mean grad over identical ranks == 2.0 → v = 1 - 0.1*2
+    np.testing.assert_allclose(v.numpy(), 0.8, rtol=1e-6)
+
+
+def test_tf_optimizer_local_aggregation(hvd):
+    import keras
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+    v = tf.Variable(0.0)
+    opt = tfvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=1.0),
+                                    backward_passes_per_step=2)
+    opt.apply_gradients([(tf.constant(1.0), v)])
+    np.testing.assert_allclose(v.numpy(), 0.0)  # first pass only accumulates
+    opt.apply_gradients([(tf.constant(3.0), v)])
+    # second pass applies the local mean (1+3)/2 = 2
+    np.testing.assert_allclose(v.numpy(), -2.0, rtol=1e-6)
+
+
+def test_tf_metric_average_callback(hvd):
+    import horovod_tpu.frontends.tensorflow as tfvd
+    cb = tfvd.MetricAverageCallback()
+    logs = {"loss": 4.0}
+    cb.on_epoch_end(0, logs)
+    np.testing.assert_allclose(logs["loss"], 4.0)  # identical ranks
