@@ -38,6 +38,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import functools
+import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -47,7 +48,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from horovod_tpu.common import types as T
-from horovod_tpu.common.exceptions import (HorovodInternalError,
+from horovod_tpu.common.exceptions import (DuplicateNameError,
+                                           HorovodInternalError,
                                            HorovodTpuError)
 from horovod_tpu.core import topology
 from horovod_tpu.core.process_sets import ProcessSet, global_process_set
@@ -597,36 +599,8 @@ def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
 
     def build() -> Callable:
         def body(block):
-            x = block
-            if prescale_factor != 1.0:
-                x = x * jnp.asarray(prescale_factor, x.dtype)
-            if even:
-                y = lax.psum_scatter(x[0], _AXIS, scatter_dimension=0,
-                                     tiled=True)
-                if rop == T.ReduceOp.AVERAGE:
-                    y = y / jnp.asarray(k, y.dtype)
-                if postscale_factor != 1.0:
-                    y = y * jnp.asarray(postscale_factor, y.dtype)
-                return y[None]
-            # Uneven: full psum then per-rank slice of varying size. The
-            # slice sizes differ per rank, which SPMD can't express with one
-            # static shape — pad every slice to ceil and mark valid length;
-            # the wrapper trims on the way out.
-            y = lax.psum(x[0], _AXIS)
-            if rop == T.ReduceOp.AVERAGE:
-                y = y / jnp.asarray(k, y.dtype)
-            if postscale_factor != 1.0:
-                y = y * jnp.asarray(postscale_factor, y.dtype)
-            idx = lax.axis_index(_AXIS)
-            big = d0 // k + 1
-            rem = d0 % k
-            start = jnp.minimum(idx, rem) * big + \
-                jnp.maximum(idx - rem, 0) * (big - 1)
-            sl = lax.dynamic_slice_in_dim(
-                jnp.concatenate(
-                    [y, jnp.zeros((big,) + y.shape[1:], y.dtype)], axis=0),
-                start, big, axis=0)
-            return sl[None]
+            return _rs_block(block[0], k, rop, prescale_factor,
+                             postscale_factor, d0)[None]
 
         fn = jax.shard_map(body, mesh=ps.mesh, in_specs=P(_AXIS),
                            out_specs=P(_AXIS), check_vma=False)
@@ -637,35 +611,184 @@ def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
                  f"op={int(rop)},ps={ps.process_set_id})", ps)
     with _timeline_span(name or "reducescatter", "REDUCESCATTER"):
         out = _execute(fn, g)
-    if even:
+    return _rs_trim(out, stacked, d0, k, ps)
+
+
+def _rs_block(x, k: int, rop, prescale_factor: float,
+              postscale_factor: float, d0: int):
+    """Per-tensor reduce-scatter body (shared by single + grouped paths)."""
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, x.dtype)
+    if d0 % k == 0:
+        y = lax.psum_scatter(x, _AXIS, scatter_dimension=0, tiled=True)
+        if rop == T.ReduceOp.AVERAGE:
+            y = y / jnp.asarray(k, y.dtype)
+        if postscale_factor != 1.0:
+            y = y * jnp.asarray(postscale_factor, y.dtype)
+        return y
+    # Uneven: full psum then per-rank slice of varying size. The slice
+    # sizes differ per rank, which SPMD can't express with one static
+    # shape — pad every slice to ceil; the wrapper trims on the way out.
+    y = lax.psum(x, _AXIS)
+    if rop == T.ReduceOp.AVERAGE:
+        y = y / jnp.asarray(k, y.dtype)
+    if postscale_factor != 1.0:
+        y = y * jnp.asarray(postscale_factor, y.dtype)
+    idx = lax.axis_index(_AXIS)
+    big = d0 // k + 1
+    rem = d0 % k
+    start = jnp.minimum(idx, rem) * big + \
+        jnp.maximum(idx - rem, 0) * (big - 1)
+    return lax.dynamic_slice_in_dim(
+        jnp.concatenate(
+            [y, jnp.zeros((big,) + y.shape[1:], y.dtype)], axis=0),
+        start, big, axis=0)
+
+
+def _rs_trim(out, stacked: bool, d0: int, k: int, ps: ProcessSet):
+    """Undo the uneven-path padding (shared by single + grouped paths)."""
+    if d0 % k == 0:
         return _from_global(out, stacked)
-    # Trim each rank's padded slice to its true size.
     big = d0 // k + 1
     rem = d0 % k
     sizes = [big if i < rem else big - 1 for i in range(k)]
     if stacked:
-        # Return list-like stacked is impossible with ragged sizes; trim to
-        # per-rank sizes on host view.
-        rows = [out[i, :sizes[i]] for i in range(k)]
-        return rows
+        # Ragged per-rank sizes cannot stay stacked; trim on host view.
+        return [out[i, :sizes[i]] for i in range(k)]
     my = _from_global(out, stacked)
     my_rank_in_set = ps.rank_index(topology.rank())
     return my[: sizes[my_rank_in_set]]
 
 
 def grouped_reducescatter(tensors: Sequence[Any], op: Any = T.ReduceOp.AVERAGE,
-                          process_set: Optional[ProcessSet] = None,
-                          **kw) -> List[Any]:
-    """Reference: grouped reducescatter (tensorflow/mpi_ops.cc:1415)."""
-    return [reducescatter(t, op=op, process_set=process_set, **kw)
-            for t in tensors]
+                          name: Optional[str] = None,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0,
+                          process_set: Optional[ProcessSet] = None) -> List[Any]:
+    """Atomic fused reduce-scatter of a group: ONE XLA program for all
+    tensors (reference: grouped RS is an atomic fused response,
+    tensorflow/mpi_ops.cc:1415 — not a loop of singles)."""
+    ps = _resolve_ps(process_set)
+    if not tensors:
+        return []
+    rop = _normalize_op(None, op) if op is not None else T.ReduceOp.AVERAGE
+    if rop not in (T.ReduceOp.SUM, T.ReduceOp.AVERAGE):
+        raise HorovodTpuError("reducescatter supports SUM and AVERAGE only")
+    gs, stackeds = zip(*[_to_global(t, ps) for t in tensors])
+    k = ps.size()
+    d0s = [int(g.shape[1]) for g in gs]
+    key = ("grs", tuple((g.shape, str(g.dtype)) for g in gs), int(rop),
+           ps.cache_token, float(prescale_factor), float(postscale_factor))
+
+    def build() -> Callable:
+        def body(*blocks):
+            return tuple(
+                _rs_block(b[0], k, rop, prescale_factor, postscale_factor,
+                          d0s[i])[None]
+                for i, b in enumerate(blocks))
+
+        fn = jax.shard_map(body, mesh=ps.mesh,
+                           in_specs=(P(_AXIS),) * len(gs),
+                           out_specs=(P(_AXIS),) * len(gs), check_vma=False)
+        return jax.jit(fn)
+
+    fn = _cache.get_or_build(key, build)
+    _consistency(f"grouped_reducescatter(n={len(gs)},shapes="
+                 f"{[tuple(g.shape) for g in gs]},op={int(rop)},"
+                 f"ps={ps.process_set_id})", ps)
+    with _timeline_span(name or "grouped_reducescatter", "REDUCESCATTER"):
+        outs = _execute(fn, *gs)
+    return [_rs_trim(o, st, d0, k, ps)
+            for o, st, d0 in zip(outs, stackeds, d0s)]
 
 
 def grouped_allgather(tensors: Sequence[Any],
-                      process_set: Optional[ProcessSet] = None,
-                      **kw) -> List[Any]:
-    """Reference: grouped allgather (tensorflow/mpi_ops.cc:788)."""
-    return [allgather(t, process_set=process_set, **kw) for t in tensors]
+                      name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None) -> List[Any]:
+    """Atomic fused allgather of a group: ONE XLA program and ONE size
+    exchange for the whole group (reference: grouped allgather is an
+    atomic fused response, tensorflow/mpi_ops.cc:788; the single-tensor
+    path pays one blocking size exchange per call — the group pays one)."""
+    ps = _resolve_ps(process_set)
+    if not tensors:
+        return []
+    gs = []
+    stackeds = []
+    for t in tensors:
+        g, st = _to_global(t, ps)
+        if g.ndim < 2:
+            raise HorovodTpuError(
+                "allgather requires per-rank tensors with at least one "
+                "dimension")
+        gs.append(g)
+        stackeds.append(st)
+    k = ps.size()
+    n = len(gs)
+    _consistency(f"grouped_allgather(n={n},"
+                 f"rests={[tuple(g.shape[2:]) for g in gs]},"
+                 f"dtypes={[str(g.dtype) for g in gs]},"
+                 f"ps={ps.process_set_id})", ps)
+    if jax.process_count() == 1:
+        sizes_matrix = np.tile(
+            np.asarray([[int(g.shape[1]) for g in gs]], np.int64), (k, 1))
+    else:
+        sizes_matrix = _exchange_rows(
+            np.asarray([int(g.shape[1]) for g in gs], np.int64), ps)
+    max_d0 = sizes_matrix.max(axis=0)  # per tensor
+    padded = []
+    for i, g in enumerate(gs):
+        pad = int(max_d0[i]) - int(g.shape[1])
+        if pad > 0:
+            g = jnp.concatenate(
+                [g, jnp.zeros((g.shape[0], pad) + g.shape[2:], g.dtype)],
+                axis=1)
+        padded.append(g)
+    cfg = topology.state().config
+    all_even = all(len(set(sizes_matrix[:, i].tolist())) == 1
+                   for i in range(n))
+    hm = _hier_usable(ps) if (cfg.hierarchical_allgather
+                              and all_even) else None
+    key = ("gag", tuple((g.shape, str(g.dtype)) for g in padded),
+           tuple(map(tuple, sizes_matrix.tolist())), ps.cache_token,
+           hm is not None)
+
+    def build() -> Callable:
+        sm = sizes_matrix
+
+        if hm is not None:
+            # Even sizes: gather within the fast ici axis, then across dcn
+            # — the same HOROVOD_HIERARCHICAL_ALLGATHER decomposition as
+            # the single-tensor path, applied per group member.
+            def hier_body(*blocks):
+                outs = []
+                for b in blocks:
+                    g1 = lax.all_gather(b[0], "ici", axis=0, tiled=True)
+                    g2 = lax.all_gather(g1, "dcn", axis=0, tiled=True)
+                    outs.append(g2[None])
+                return tuple(outs)
+
+            fn = jax.shard_map(hier_body, mesh=hm,
+                               in_specs=(_HIER_SPEC,) * n,
+                               out_specs=(_HIER_SPEC,) * n, check_vma=False)
+            return jax.jit(fn)
+
+        def body(*blocks):
+            outs = []
+            for i, b in enumerate(blocks):
+                gathered = lax.all_gather(b[0], _AXIS, axis=0)
+                pieces = [lax.slice_in_dim(gathered[r], 0, int(sm[r, i]),
+                                           axis=0) for r in range(k)]
+                outs.append(jnp.concatenate(pieces, axis=0)[None])
+            return tuple(outs)
+
+        fn = jax.shard_map(body, mesh=ps.mesh, in_specs=(P(_AXIS),) * n,
+                           out_specs=(P(_AXIS),) * n, check_vma=False)
+        return jax.jit(fn)
+
+    fn = _cache.get_or_build(key, build)
+    with _timeline_span(name or "grouped_allgather", "ALLGATHER"):
+        outs = _execute(fn, *padded)
+    return [_from_global(o, st) for o, st in zip(outs, stackeds)]
 
 
 def alltoall(tensor: Any, splits: Optional[Any] = None,
@@ -722,9 +845,12 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
                 [jnp.zeros((1,), my.dtype), jnp.cumsum(my)[:-1]])
             xpad = jnp.concatenate(
                 [x, jnp.zeros((max_chunk,) + x.shape[1:], x.dtype)], axis=0)
-            chunks = jnp.stack([
-                lax.dynamic_slice_in_dim(xpad, starts[j], max_chunk, axis=0)
-                for j in range(k)])  # (k, max_chunk, *rest)
+            # One gather for all destinations — O(1) program size where a
+            # per-destination dynamic-slice loop would be O(k) (matters at
+            # 256 ranks).
+            row_idx = starts[:, None] + \
+                jnp.arange(max_chunk, dtype=starts.dtype)[None, :]
+            chunks = xpad[row_idx]  # (k, max_chunk, *rest)
             recvd = lax.all_to_all(chunks, _AXIS, split_axis=0, concat_axis=0)
             # recvd[i] = chunk sent by rank i to me, padded to max_chunk.
             return recvd[None]
@@ -822,6 +948,37 @@ reducescatter_async = reducescatter
 # --------------------------------------------------------------------------
 # Helpers
 # --------------------------------------------------------------------------
+
+# In-flight named-operation registry (reference: TensorQueue's duplicate
+# name detection -> DUPLICATE_NAME_ERROR, common/tensor_queue.cc:29-70).
+# Sync eager ops complete before returning, so only truly-async surfaces
+# (frontend async handles) can overlap; they register their name for the
+# handle's lifetime.
+_inflight_names: set = set()
+_inflight_lock = threading.Lock()
+
+
+def register_inflight_name(name: Optional[str]) -> bool:
+    """Claim `name` until release_inflight_name; raises DuplicateNameError
+    if an operation with that name is still pending. Returns False for
+    anonymous ops (no claim)."""
+    if not name:
+        return False
+    with _inflight_lock:
+        if name in _inflight_names:
+            raise DuplicateNameError(
+                f"an operation named '{name}' is already in flight — "
+                f"synchronize it before reusing the name (reference: "
+                f"DUPLICATE_NAME_ERROR, common/tensor_queue.cc)")
+        _inflight_names.add(name)
+        return True
+
+
+def release_inflight_name(name: Optional[str]) -> None:
+    if name:
+        with _inflight_lock:
+            _inflight_names.discard(name)
+
 
 def _normalize_op(average: Optional[bool], op: Any) -> T.ReduceOp:
     if average is not None and op is not None:

@@ -115,6 +115,24 @@ def scenario_reducescatter(hvd, rank, size):
     np.testing.assert_allclose(out, full[start:start + mine], rtol=1e-6)
 
 
+def scenario_grouped_allgather(hvd, rank, size):
+    """Fused grouped allgather with per-rank-uneven first dims: one size
+    exchange + one program for the whole group."""
+    ts = [np.ones((rank + 1, 2), np.float32) * rank,
+          np.full((2, 3), rank, np.float32)]
+    outs = hvd.grouped_allgather(ts)
+    total0 = sum(r + 1 for r in range(size))
+    assert np.asarray(outs[0]).shape == (total0, 2)
+    row = 0
+    for r in range(size):
+        seg = np.asarray(outs[0])[row:row + r + 1]
+        np.testing.assert_allclose(seg, r)
+        row += r + 1
+    want1 = np.concatenate([np.full((2, 3), r, np.float32)
+                            for r in range(size)])
+    np.testing.assert_allclose(np.asarray(outs[1]), want1)
+
+
 def scenario_broadcast_object(hvd, rank, size):
     from horovod_tpu.optim.functions import broadcast_object
 
@@ -238,6 +256,7 @@ SCENARIOS = {
     "allgather_uneven": scenario_allgather_uneven,
     "alltoall": scenario_alltoall,
     "reducescatter": scenario_reducescatter,
+    "grouped_allgather": scenario_grouped_allgather,
     "broadcast_object": scenario_broadcast_object,
     "barrier": scenario_barrier,
     "autotune_sync": scenario_autotune_sync,

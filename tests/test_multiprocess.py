@@ -38,7 +38,7 @@ def run_scenarios(np_procs: int, scenarios: str, tmp_path) -> str:
 @pytest.mark.parametrize("np_procs", [2, 4])
 def test_collectives_multiprocess(np_procs, tmp_path):
     scenarios = ("allreduce,grouped,broadcast,allgather_uneven,alltoall,"
-                 "reducescatter,broadcast_object,barrier")
+                 "reducescatter,grouped_allgather,broadcast_object,barrier")
     text = run_scenarios(np_procs, scenarios, tmp_path)
     for name in scenarios.split(","):
         for rank in range(np_procs):
