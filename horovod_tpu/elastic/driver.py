@@ -304,7 +304,9 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
         HostDiscoveryScript(args.host_discovery_script,
                             default_slots=args.slots_per_host or 1),
         cooldown_range=tuple(cooldown) if cooldown else None)
-    rdv = RendezvousServer()
+    from horovod_tpu.runner import secret as secret_mod
+    job_secret = secret_mod.make_secret_key()
+    rdv = RendezvousServer(secret=job_secret.encode())
     rdv_port = rdv.start()
     ip = _local_ip()
 
@@ -339,6 +341,7 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
         env.update({
             C.HOROVOD_RENDEZVOUS_ADDR: ip,
             C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
+            secret_mod.SECRET_ENV: job_secret,
             C.HOROVOD_ELASTIC: "1",
             "HOROVOD_ELASTIC_ROUND": str(round_id),
             "HOROVOD_ELASTIC_TIMEOUT": str(args.elastic_timeout),

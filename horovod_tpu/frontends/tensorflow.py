@@ -137,7 +137,10 @@ def alltoall(tensor, splits=None, name=None,
     out, recv = C.alltoall(_to_np(tensor), splits=splits, name=name,
                            process_set=process_set)
     tf = _tf()
-    return _like(out, tensor), tf.cast(_like(recv, tensor), tf.int64)
+    # recv counts stay integral end-to-end — routing them through the input
+    # dtype (e.g. fp16) would corrupt counts above the mantissa range.
+    return _like(out, tensor), tf.convert_to_tensor(
+        np.asarray(recv).astype(np.int64))
 
 
 def barrier(process_set: Optional[ProcessSet] = None):
@@ -301,8 +304,9 @@ class MetricAverageCallback:
 
 
 class LearningRateWarmupCallback:
-    """Linear LR warmup over the first epochs (reference:
-    _keras/callbacks.py:193 — scale to size after warmup)."""
+    """Linear LR warmup from `initial_lr` to `initial_lr * size` over the
+    first epochs (reference: _keras/callbacks.py:193 — gradually scale to
+    the size-multiplied rate; a no-op at size 1)."""
 
     def __new__(cls, initial_lr: float, warmup_epochs: int = 5,
                 verbose: int = 0):
@@ -313,11 +317,17 @@ class LearningRateWarmupCallback:
                 super().__init__()
                 self.initial_lr = initial_lr
                 self.warmup_epochs = warmup_epochs
+                self.verbose = verbose
 
             def on_epoch_begin(self, epoch, logs=None):
-                if epoch < self.warmup_epochs:
-                    factor = (epoch + 1) / self.warmup_epochs
-                    self.model.optimizer.learning_rate.assign(
-                        self.initial_lr * factor)
+                k = size()
+                if epoch >= self.warmup_epochs or k == 1:
+                    return
+                progress = (epoch + 1) / self.warmup_epochs
+                lr = self.initial_lr * (1.0 + (k - 1) * progress)
+                self.model.optimizer.learning_rate.assign(lr)
+                if self.verbose:
+                    print(f"Epoch {epoch}: LearningRateWarmupCallback "
+                          f"sets learning rate to {lr:.6g}")
 
         return _CB()

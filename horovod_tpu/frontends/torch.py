@@ -244,15 +244,39 @@ class _Handle:
         return self.future.done()
 
 
+def _submit_named(op_name, fn, *args, **kwargs):
+    """Submit an async collective, holding the name for the handle's
+    lifetime (reference: DUPLICATE_NAME_ERROR for overlapping same-name
+    submissions, tensor_queue.cc). The name is released on the worker
+    thread BEFORE the future resolves — a done-callback would race
+    synchronize(): result() waiters wake before callbacks run, so
+    `synchronize(h); allreduce_async(name=...)` could spuriously collide."""
+    claimed = C.register_inflight_name(op_name)
+    if not claimed:
+        return _pool().submit(fn, *args, **kwargs)
+
+    def call():
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            C.release_inflight_name(op_name)
+
+    try:
+        return _pool().submit(call)
+    except BaseException:
+        C.release_inflight_name(op_name)
+        raise
+
+
 def allreduce_async(tensor, average: Optional[bool] = None, name=None,
                     op=None, prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
                     process_set: Optional[ProcessSet] = None):
     arr = _to_np(tensor)  # snapshot on the caller thread
-    fut = _pool().submit(C.allreduce, arr, average=average, name=name,
-                         op=op, prescale_factor=prescale_factor,
-                         postscale_factor=postscale_factor,
-                         process_set=process_set)
+    fut = _submit_named(name, C.allreduce, arr, average=average, name=name,
+                        op=op, prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
     return _Handle(fut, tensor, same_shape=True)
 
 
@@ -265,8 +289,8 @@ def allreduce_async_(tensor, **kw):
 def broadcast_async(tensor, root_rank: int, name=None,
                     process_set: Optional[ProcessSet] = None):
     arr = _to_np(tensor)
-    fut = _pool().submit(C.broadcast, arr, root_rank=root_rank, name=name,
-                         process_set=process_set)
+    fut = _submit_named(name, C.broadcast, arr, root_rank=root_rank,
+                        name=name, process_set=process_set)
     return _Handle(fut, tensor, same_shape=True)
 
 
@@ -279,8 +303,8 @@ def broadcast_async_(tensor, root_rank: int, **kw):
 def allgather_async(tensor, name=None,
                     process_set: Optional[ProcessSet] = None):
     arr = _to_np(tensor)
-    fut = _pool().submit(C.allgather, arr, name=name,
-                         process_set=process_set)
+    fut = _submit_named(name, C.allgather, arr, name=name,
+                        process_set=process_set)
     return _Handle(fut, tensor)
 
 

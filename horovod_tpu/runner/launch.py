@@ -104,6 +104,33 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
     return env
 
 
+def detect_tpu_pod_hosts(default_slots: int = 4) -> Optional[str]:
+    """Derive the host spec from a TPU pod environment.
+
+    GKE/GCE TPU pod slices publish the worker list in
+    TPU_WORKER_HOSTNAMES (one entry per host); slots default to the
+    typical chips-per-host and can be overridden with
+    HOROVOD_TPU_SLOTS_PER_HOST. The reference discovers hosts by probing
+    NICs with driver/task services (runner/driver/driver_service.py) —
+    on TPU pods the runtime already knows the topology, so the launcher
+    reads it instead of probing.
+    """
+    names = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if not names:
+        return None
+    try:
+        slots = int(os.environ.get("HOROVOD_TPU_SLOTS_PER_HOST", "")
+                    or default_slots)
+    except ValueError:
+        from horovod_tpu.common.hvd_logging import get_logger
+        get_logger().warning(
+            "ignoring malformed HOROVOD_TPU_SLOTS_PER_HOST=%r",
+            os.environ.get("HOROVOD_TPU_SLOTS_PER_HOST"))
+        slots = default_slots
+    hosts = [h.strip() for h in names.split(",") if h.strip()]
+    return ",".join(f"{h}:{slots}" for h in hosts) or None
+
+
 def _local_ip(interface: Optional[str] = None) -> str:
     if interface:
         try:
@@ -176,7 +203,12 @@ def launch_static(np: int, host_spec: str, command: List[str],
     host_list = hosts_mod.parse_hosts(host_spec)
     slots = hosts_mod.get_host_assignments(host_list, np)
 
-    rdv = RendezvousServer()
+    # Per-job HMAC secret: control-plane writes are authenticated
+    # (reference: runner/common/util/secret.py; previously the KV accepted
+    # writes from anyone on the network).
+    from horovod_tpu.runner import secret as secret_mod
+    job_secret = secret_mod.make_secret_key()
+    rdv = RendezvousServer(secret=job_secret.encode())
     rdv_port = rdv.start()
     ip = coordinator_ip or _local_ip()
 
@@ -197,6 +229,7 @@ def launch_static(np: int, host_spec: str, command: List[str],
         C.HOROVOD_RENDEZVOUS_ADDR: ip,
         C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
         C.HOROVOD_CONTROLLER: "tpu",
+        secret_mod.SECRET_ENV: job_secret,
     })
     if nkv is not None:
         base_env[C.HOROVOD_NATIVE_KV_ADDR] = ip
@@ -253,7 +286,16 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         return run_elastic(args, command, args_to_env(args))
 
     np = args.num_proc
-    hosts = args.hosts or f"localhost:{np or 1}"
+    hosts = args.hosts
+    if hosts is None:
+        detected = detect_tpu_pod_hosts()
+        if detected is not None and (np is None or np <= sum(
+                h.slots for h in hosts_mod.parse_hosts(detected))):
+            hosts = detected
+        else:
+            # An explicit -np larger than the pod's detected slots must not
+            # be silently capped — fall back to local oversubscription.
+            hosts = f"localhost:{np or 1}"
     if np is None:
         np = sum(h.slots for h in hosts_mod.parse_hosts(hosts))
     return launch_static(np, hosts, command, args_to_env(args),

@@ -10,6 +10,11 @@ Protocol (same shape as the reference):
   PUT  /<scope>/<key>   body = value bytes
   GET  /<scope>/<key>   200 + bytes | 404
   DELETE /<scope>/<key>
+
+When a job secret is set (HOROVOD_SECRET_KEY, reference:
+runner/common/util/secret.py), every request must carry an HMAC digest
+header; unauthenticated requests get 403 — the control plane no longer
+accepts writes from anyone on the network.
 """
 
 from __future__ import annotations
@@ -18,10 +23,13 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from horovod_tpu.runner import secret as secret_mod
+
 
 class _KVHandler(BaseHTTPRequestHandler):
     store: Dict[str, bytes] = {}
     lock = threading.Lock()
+    secret: Optional[bytes] = None
 
     def log_message(self, fmt, *args):  # silence request logging
         pass
@@ -29,15 +37,30 @@ class _KVHandler(BaseHTTPRequestHandler):
     def _key(self) -> str:
         return self.path.lstrip("/")
 
+    def _authorized(self, body: bytes) -> bool:
+        if self.secret is None:
+            return True
+        return secret_mod.check_digest(
+            self.secret, self.command, self.path, body,
+            self.headers.get(secret_mod.DIGEST_HEADER))
+
+    def _reject(self) -> None:
+        self.send_response(403)
+        self.end_headers()
+
     def do_PUT(self):
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
+        if not self._authorized(body):
+            return self._reject()
         with self.lock:
             self.store[self._key()] = body
         self.send_response(200)
         self.end_headers()
 
     def do_GET(self):
+        if not self._authorized(b""):
+            return self._reject()
         with self.lock:
             val = self.store.get(self._key())
         if val is None:
@@ -50,6 +73,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.wfile.write(val)
 
     def do_DELETE(self):
+        if not self._authorized(b""):
+            return self._reject()
         with self.lock:
             self.store.pop(self._key(), None)
         self.send_response(200)
@@ -59,9 +84,10 @@ class _KVHandler(BaseHTTPRequestHandler):
 class RendezvousServer:
     """Threaded KV store (reference: RendezvousServer, http_server.py:259)."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, secret: Optional[bytes] = None):
         handler = type("Handler", (_KVHandler,),
-                       {"store": {}, "lock": threading.Lock()})
+                       {"store": {}, "lock": threading.Lock(),
+                        "secret": secret})
         self._handler = handler
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
         self.port = self._httpd.server_address[1]
@@ -86,28 +112,42 @@ class RendezvousServer:
         self._httpd.server_close()
 
 
-class KVClient:
-    """Worker-side client (reference: http_client.py read_data_from_kvstore)."""
+_FROM_ENV = object()  # sentinel: secret=None must mean "really unsigned"
 
-    def __init__(self, addr: str, port: int):
+
+class KVClient:
+    """Worker-side client (reference: http_client.py read_data_from_kvstore).
+    By default signs with the job secret from HOROVOD_SECRET_KEY; pass
+    secret=None explicitly for an unsigned client, or secret=<bytes> to
+    override."""
+
+    def __init__(self, addr: str, port: int, secret=_FROM_ENV):
         self.base = f"http://{addr}:{port}"
+        self.secret = secret_mod.secret_from_env() \
+            if secret is _FROM_ENV else secret
+
+    def _request(self, method: str, path: str, data: Optional[bytes]):
+        import urllib.request
+        req = urllib.request.Request(f"{self.base}{path}", data=data,
+                                     method=method)
+        if self.secret is not None:
+            req.add_header(
+                secret_mod.DIGEST_HEADER,
+                secret_mod.compute_digest(self.secret, method, path,
+                                          data or b""))
+        return urllib.request.urlopen(req, timeout=30 if data else 10)
 
     def put(self, scope: str, key: str, value: bytes) -> None:
-        import urllib.request
-        req = urllib.request.Request(f"{self.base}/{scope}/{key}",
-                                     data=value, method="PUT")
-        urllib.request.urlopen(req, timeout=30).read()
+        self._request("PUT", f"/{scope}/{key}", value).read()
 
     def get(self, scope: str, key: str,
             timeout: float = 30.0) -> Optional[bytes]:
         import time
         import urllib.error
-        import urllib.request
         deadline = time.monotonic() + timeout
         while True:
             try:
-                return urllib.request.urlopen(
-                    f"{self.base}/{scope}/{key}", timeout=10).read()
+                return self._request("GET", f"/{scope}/{key}", None).read()
             except urllib.error.HTTPError as e:
                 if e.code != 404 or time.monotonic() > deadline:
                     if e.code == 404:

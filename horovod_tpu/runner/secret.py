@@ -1,0 +1,45 @@
+"""Shared-secret HMAC signing for control-plane RPC.
+
+Reference: horovod/runner/common/util/secret.py — the launcher generates a
+per-job secret and every service request carries an HMAC digest so the
+rendezvous/KV accepts writes only from job members (previously anyone on
+the network could poison assignments).
+
+The secret travels to workers via the HOROVOD_SECRET_KEY env var the
+launcher injects (the reference marshals it through its Settings object).
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import secrets as _secrets
+from typing import Optional
+
+SECRET_ENV = "HOROVOD_SECRET_KEY"
+DIGEST_HEADER = "X-Horovod-HMAC"
+_HASH = "sha256"
+
+
+def make_secret_key() -> str:
+    """Reference: secret.make_secret_key (random per-job key)."""
+    return _secrets.token_hex(32)
+
+
+def secret_from_env() -> Optional[bytes]:
+    val = os.environ.get(SECRET_ENV, "")
+    return val.encode() if val else None
+
+
+def compute_digest(secret: bytes, method: str, path: str,
+                   body: bytes) -> str:
+    msg = method.encode() + b"\n" + path.encode() + b"\n" + body
+    return hmac.new(secret, msg, _HASH).hexdigest()
+
+
+def check_digest(secret: bytes, method: str, path: str, body: bytes,
+                 digest: Optional[str]) -> bool:
+    if not digest:
+        return False
+    want = compute_digest(secret, method, path, body)
+    return hmac.compare_digest(want, digest)

@@ -140,3 +140,15 @@ def test_interactive_run_returns_per_rank_results():
 
     results = run(fn, np=2)
     assert results == [0, 10]
+
+
+def test_detect_tpu_pod_hosts(monkeypatch):
+    """GKE/GCE TPU pods publish the worker list; the launcher derives the
+    host spec from it (the reference probes NICs via driver services)."""
+    from horovod_tpu.runner.launch import detect_tpu_pod_hosts
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    assert detect_tpu_pod_hosts() is None
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1k-w-0,t1k-w-1")
+    assert detect_tpu_pod_hosts() == "t1k-w-0:4,t1k-w-1:4"
+    monkeypatch.setenv("HOROVOD_TPU_SLOTS_PER_HOST", "8")
+    assert detect_tpu_pod_hosts() == "t1k-w-0:8,t1k-w-1:8"

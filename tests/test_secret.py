@@ -1,0 +1,58 @@
+"""Control-plane authentication (reference: runner/common/util/secret.py —
+HMAC-signed service RPC; previously the KV accepted writes from anyone)."""
+
+import urllib.error
+
+import pytest
+
+from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+from horovod_tpu.runner.secret import (compute_digest, check_digest,
+                                       make_secret_key)
+
+
+def test_digest_roundtrip():
+    secret = make_secret_key().encode()
+    d = compute_digest(secret, "PUT", "/s/k", b"value")
+    assert check_digest(secret, "PUT", "/s/k", b"value", d)
+    assert not check_digest(secret, "PUT", "/s/k", b"othervalue", d)
+    assert not check_digest(secret, "GET", "/s/k", b"value", d)
+    assert not check_digest(b"other-secret", "PUT", "/s/k", b"value", d)
+    assert not check_digest(secret, "PUT", "/s/k", b"value", None)
+
+
+def test_rendezvous_rejects_unsigned_requests():
+    secret = make_secret_key()
+    srv = RendezvousServer(secret=secret.encode())
+    port = srv.start()
+    try:
+        good = KVClient("127.0.0.1", port, secret=secret.encode())
+        good.put("scope", "k", b"v1")
+        assert good.get("scope", "k") == b"v1"
+
+        anon = KVClient("127.0.0.1", port, secret=None)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            anon.put("scope", "k", b"poison")
+        assert ei.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            anon.get("scope", "k", timeout=1.0)
+        assert ei.value.code == 403
+
+        bad = KVClient("127.0.0.1", port, secret=b"wrong-key")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            bad.put("scope", "k", b"poison")
+        assert ei.value.code == 403
+        # The value was never overwritten by unauthorized writers.
+        assert good.get("scope", "k") == b"v1"
+    finally:
+        srv.stop()
+
+
+def test_rendezvous_open_without_secret():
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        c = KVClient("127.0.0.1", port, secret=None)
+        c.put("s", "k", b"x")
+        assert c.get("s", "k") == b"x"
+    finally:
+        srv.stop()

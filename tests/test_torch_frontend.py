@@ -152,3 +152,22 @@ def test_torch_sparse_allreduce(hvd):
     emb2(torch.tensor([0, 2])).sum().backward()
     opt2.step()
     assert not emb2.weight.grad.is_sparse
+
+
+def test_torch_duplicate_name_error(hvd):
+    """Overlapping async ops sharing a name raise DuplicateNameError
+    (reference: DUPLICATE_NAME_ERROR, common/tensor_queue.cc)."""
+    import horovod_tpu.frontends.torch as thvd
+    from horovod_tpu.common.exceptions import DuplicateNameError
+
+    h1 = thvd.allreduce_async(torch.ones(1024), name="grad0")
+    try:
+        with pytest.raises(DuplicateNameError):
+            thvd.allreduce_async(torch.ones(1024), name="grad0")
+    finally:
+        thvd.synchronize(h1)
+    # After synchronize the name is free IMMEDIATELY (release happens
+    # before the future resolves) — the canonical per-step reuse pattern.
+    for _ in range(5):
+        h = thvd.allreduce_async(torch.ones(4), name="grad0")
+        thvd.synchronize(h)
