@@ -129,6 +129,46 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup):
 # Transformer LM (the framework flagship; MXU-bound)
 # --------------------------------------------------------------------------
 
+def bench_flash_attention(S=8192, iters=10):
+    """Long-context attention: the Pallas flash kernel
+    (ops/flash_attention.py) vs XLA's score-materializing attention,
+    fwd+bwd at S=8192 — the long-sequence regime the kernel exists for."""
+    import time
+
+    from horovod_tpu.ops.flash_attention import flash_attention
+    from horovod_tpu.parallel.ring_attention import (
+        blockwise_attention_reference)
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (2, 16, S, 128), jnp.bfloat16)
+               for kk in ks)
+
+    def timed(fn):
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        # Generous warmup: the first post-compile executions through the
+        # tunnel are 5-6x slower (deferred transfers/allocation) and would
+        # dominate a short timed loop.
+        for _ in range(5):
+            out = g(q, k, v)
+        jax.block_until_ready(out)
+        np.asarray(out[0][0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(q, k, v)
+        jax.block_until_ready(out)
+        np.asarray(out[0][0, 0, 0])  # force readback through the tunnel
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    t_flash = timed(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    t_naive = timed(lambda q, k, v: blockwise_attention_reference(
+        q, k, v, causal=True))
+    return {"flash_fwd_bwd_ms": round(t_flash, 2),
+            "naive_fwd_bwd_ms": round(t_naive, 2),
+            "speedup": round(t_naive / t_flash, 2)}
+
+
 def bench_transformer(on_cpu, steps, warmup):
     if on_cpu:
         cfg = tfm.TransformerConfig(vocab=256, d_model=64, n_heads=4,
@@ -246,6 +286,7 @@ def main():
             / peak, 4)
 
     fusion = bench_fusion_sweep(on_cpu)
+    flash = None if on_cpu else bench_flash_attention()
 
     per_chip_ips = best["images_per_sec_per_chip"]
     print(json.dumps({
@@ -260,6 +301,7 @@ def main():
             "resnet50": best,
             "transformer_lm": tr,
             "fusion_sweep_grouped_allreduce": fusion,
+            "flash_attention_s8192": flash,
         },
     }))
 

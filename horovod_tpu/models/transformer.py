@@ -50,7 +50,7 @@ class TransformerConfig:
     max_seq: int = 2048
     num_experts: int = 0          # 0 → dense FFN; >0 → MoE every layer
     capacity_factor: float = 2.0
-    attn: str = "ring"            # "ring" | "ulysses" | "local"
+    attn: str = "ring"            # "ring" | "ulysses" | "flash" | "local"
     microbatches: int = 1         # pipeline microbatches (≥ pp size ideal)
     dtype: Any = jnp.float32
     # Rematerialize each layer in backward instead of saving residuals
@@ -179,6 +179,16 @@ def _layer(x: jax.Array, lp: Dict[str, Any], cfg: TransformerConfig):
         a = ring_attention(q, k, v, "sp", causal=True)
     elif cfg.attn == "ulysses":
         a = ulysses_mod.ulysses_attention(q, k, v, "sp", causal=True)
+    elif cfg.attn == "flash":
+        # Pallas flash kernel (ops/flash_attention.py) computes
+        # shard-LOCAL attention; silently wrong under a sequence-sharded
+        # mesh, so refuse — sharded sequences ride ring/Ulysses.
+        if lax.axis_size("sp") > 1:
+            raise HorovodTpuError(
+                "attn='flash' requires sp=1 (shard-local attention); use "
+                "attn='ring' or 'ulysses' for sequence parallelism")
+        from horovod_tpu.ops.flash_attention import flash_attention
+        a = flash_attention(q, k, v, causal=True)
     else:
         a = blockwise_attention_reference(q, k, v, causal=True)
     o = jnp.einsum("bhsk,hkd->bsd", a, lp["wo"])

@@ -40,10 +40,13 @@ class RayExecutor:
     def start(self) -> None:
         ray = _require_ray()
 
+        from horovod_tpu.runner import secret as secret_mod
         from horovod_tpu.runner.launch import _local_ip
         from horovod_tpu.runner.rendezvous import RendezvousServer
 
-        self._rdv = RendezvousServer()
+        job_secret = secret_mod.make_secret_key()
+        self.env_vars[secret_mod.SECRET_ENV] = job_secret
+        self._rdv = RendezvousServer(secret=job_secret.encode())
         port = self._rdv.start()
         addr = _local_ip()
 

@@ -48,10 +48,13 @@ def run(fn: Callable[[], Any], args=(), kwargs=None, num_proc: Optional[int] = N
     np_ = num_proc or int(sc.defaultParallelism)
     payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
 
-    rdv = RendezvousServer()
+    from horovod_tpu.runner import secret as secret_mod
+    job_secret = secret_mod.make_secret_key()
+    rdv = RendezvousServer(secret=job_secret.encode())
     port = rdv.start()
     addr = _local_ip()
     env = dict(extra_env or {})
+    env[secret_mod.SECRET_ENV] = job_secret
 
     def task_fn(index, _it):
         # Reference: _task_fn (spark/runner.py:49) — set worker identity env
