@@ -331,3 +331,8 @@ class LearningRateWarmupCallback:
                           f"sets learning rate to {lr:.6g}")
 
         return _CB()
+
+
+# Elastic substate (reference: horovod/tensorflow/elastic.py) —
+# hvd.elastic.TfKerasState, @hvd.elastic.run.
+from horovod_tpu.frontends import tensorflow_elastic as elastic  # noqa: E402,F401

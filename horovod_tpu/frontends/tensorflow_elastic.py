@@ -1,0 +1,60 @@
+"""Elastic state for the TensorFlow frontend.
+
+Reference: horovod/tensorflow/elastic.py — TensorFlowKerasState snapshots
+model/optimizer variables in memory and syncs them by broadcast after a
+topology change.
+
+    import horovod_tpu.frontends.tensorflow as hvd
+    state = hvd.elastic.TfKerasState(model=model, optimizer=opt, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        ...
+        state.commit()
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from horovod_tpu.elastic import run  # noqa: F401  (re-exported: @elastic.run)
+from horovod_tpu.elastic.state import ObjectState
+
+
+class TfKerasState(ObjectState):
+    """In-memory checkpoint of Keras model + optimizer variables
+    (reference: tensorflow/elastic.py TensorFlowKerasState)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._saved_vars: Optional[List[Any]] = None
+        super().__init__(**kwargs)
+        self._known_attrs -= {"model", "optimizer"}
+
+    def _all_vars(self) -> List[Any]:
+        out: List[Any] = []
+        if self.model is not None:
+            out.extend(self.model.variables)
+        if self.optimizer is not None:
+            out.extend(getattr(self.optimizer, "variables", []))
+        return out
+
+    def save(self) -> None:
+        self._saved_vars = [v.numpy().copy() for v in self._all_vars()]
+        super().save()
+
+    def restore(self) -> None:
+        if self._saved_vars is not None:
+            for v, s in zip(self._all_vars(), self._saved_vars):
+                v.assign(s)
+        super().restore()
+
+    def sync(self) -> None:
+        from horovod_tpu.frontends.tensorflow import broadcast_variables
+        broadcast_variables(self._all_vars(), root_rank=0)
+        super().sync()
+
+
+# Reference exposes the non-Keras variant under the same module.
+TensorFlowKerasState = TfKerasState

@@ -434,3 +434,8 @@ class DistributedOptimizer:
 
     def load_state_dict(self, sd):
         self.opt.load_state_dict(sd)
+
+
+# Elastic substate (reference: horovod/torch/elastic/) — hvd.elastic.TorchState,
+# hvd.elastic.ElasticSampler, @hvd.elastic.run.
+from horovod_tpu.frontends import torch_elastic as elastic  # noqa: E402,F401

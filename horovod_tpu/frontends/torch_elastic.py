@@ -1,0 +1,144 @@
+"""Elastic state + sampler for the torch frontend.
+
+Reference: horovod/torch/elastic/state.py TorchState (in-memory copy of
+model/optimizer state dicts, broadcast-based sync) and
+horovod/torch/elastic/sampler.py ElasticSampler (rank-sharded indices with
+mid-epoch resume after a topology change).
+
+Usage mirrors the reference:
+
+    import horovod_tpu.frontends.torch as hvd
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        ...
+        state.commit()
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Iterator, List, Optional
+
+from horovod_tpu.elastic import run  # noqa: F401  (re-exported: @elastic.run)
+from horovod_tpu.elastic.state import ObjectState
+
+
+def _torch():
+    import torch
+    return torch
+
+
+class TorchState(ObjectState):
+    """In-memory checkpoint of a torch model + optimizer (reference:
+    torch/elastic/state.py:27-110). commit() snapshots state dicts;
+    restore() rolls back; sync() broadcasts rank 0's weights and optimizer
+    state so rejoining workers pick up the survivors' progress."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._saved_model: Optional[Dict[str, Any]] = None
+        self._saved_opt: Optional[Dict[str, Any]] = None
+        super().__init__(**kwargs)
+        self._known_attrs -= {"model", "optimizer"}
+
+    def save(self) -> None:
+        torch = _torch()
+        if self.model is not None:
+            self._saved_model = {
+                k: v.detach().cpu().clone() if isinstance(v, torch.Tensor)
+                else copy.deepcopy(v)
+                for k, v in self.model.state_dict().items()}
+        if self.optimizer is not None:
+            self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
+        super().save()
+
+    def restore(self) -> None:
+        if self.model is not None and self._saved_model is not None:
+            self.model.load_state_dict(copy.deepcopy(self._saved_model))
+        if self.optimizer is not None and self._saved_opt is not None:
+            self.optimizer.load_state_dict(copy.deepcopy(self._saved_opt))
+        super().restore()
+
+    def sync(self) -> None:
+        from horovod_tpu.frontends.torch import (broadcast_optimizer_state,
+                                                 broadcast_parameters)
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        super().sync()
+
+
+class ElasticSampler:
+    """Rank-sharded sampler with mid-epoch resume (reference:
+    torch/elastic/sampler.py). Tracks processed indices; after a topology
+    change, `set_epoch`/state sync re-shards only the REMAINING indices
+    over the new world, so no sample is dropped or repeated within the
+    epoch. Duck-types torch.utils.data.Sampler (iter/len/set_epoch)."""
+
+    def __init__(self, dataset, shuffle: bool = True, seed: int = 0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: List[int] = []
+        self._reshard()
+
+    # -- topology ----------------------------------------------------------
+    def _rank_size(self):
+        from horovod_tpu.frontends.torch import rank, size
+        return rank(), size()
+
+    def _reshard(self) -> None:
+        import random
+        n = len(self.dataset)
+        remaining = sorted(set(range(n)) - set(self.processed_indices))
+        if self.shuffle:
+            random.Random(self.seed + self.epoch).shuffle(remaining)
+        r, k = self._rank_size()
+        # Drop the tail so every rank sees the same number of batches
+        # (reference: num_samples = len(remaining) // num_replicas).
+        per_rank = len(remaining) // k
+        self.indices = remaining[r * per_rank:(r + 1) * per_rank]
+
+    # -- Sampler API -------------------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.processed_indices = []
+        self._reshard()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark this rank's slice of the batch as processed (reference:
+        ElasticSampler.record_batch)."""
+        start = batch_idx * batch_size
+        self.processed_indices.extend(
+            self.indices[start:start + batch_size])
+
+    def sync(self) -> None:
+        """Union processed indices across ranks and re-shard the remainder
+        over the (possibly new) world — call from a reset callback
+        (reference: SamplerStateHandler allgathers processed indices)."""
+        from horovod_tpu.optim.functions import allgather_object
+        union: set = set()
+        for p in allgather_object(self.processed_indices):
+            union.update(p)
+        self.processed_indices = sorted(union)
+        self._reshard()
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.epoch = sd["epoch"]
+        self.processed_indices = list(sd["processed_indices"])
+        self._reshard()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"epoch": self.epoch,
+                "processed_indices": list(self.processed_indices)}
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return len(self.indices)

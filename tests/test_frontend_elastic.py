@@ -1,0 +1,89 @@
+"""Frontend elastic state objects (reference: torch/elastic/state.py
+TorchState + sampler.py ElasticSampler; tensorflow/elastic.py)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def test_torch_state_commit_restore(hvd):
+    import horovod_tpu.frontends.torch as thvd
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = thvd.elastic.TorchState(model=model, optimizer=opt, epoch=0,
+                                    batch=0)
+    w0 = model.weight.detach().clone()
+    state.commit()
+
+    # Mutate weights + bookkeeping, then roll back.
+    with torch.no_grad():
+        model.weight += 1.0
+    state.epoch = 5
+    state.restore()
+    assert torch.allclose(model.weight, w0)
+    assert state.epoch == 0
+
+    # Commit after a real step persists the new weights.
+    model(torch.randn(4, 3)).sum().backward()
+    opt.step()
+    w1 = model.weight.detach().clone()
+    state.commit()
+    with torch.no_grad():
+        model.weight.zero_()
+    state.restore()
+    assert torch.allclose(model.weight, w1)
+
+
+def test_torch_state_sync(hvd):
+    import horovod_tpu.frontends.torch as thvd
+    model = torch.nn.Linear(2, 2)
+    state = thvd.elastic.TorchState(model=model, epoch=3)
+    state.sync()  # identical ranks: broadcast is an identity, must not die
+    assert state.epoch == 3
+
+
+def test_elastic_sampler_reshard_and_resume(hvd):
+    import horovod_tpu.frontends.torch as thvd
+    k = thvd.size()
+    n = 10 * k
+    data = list(range(n))
+    s = thvd.elastic.ElasticSampler(data, shuffle=False)
+    per_rank = n // k
+    assert len(s) == per_rank  # sharded over the world
+    # This in-process "rank" is rank 0: its shard is the first slice.
+    assert s.indices == list(range(per_rank))
+
+    s.record_batch(0, 4)
+    assert s.processed_indices == [0, 1, 2, 3]
+    sd = s.state_dict()
+
+    s2 = thvd.elastic.ElasticSampler(data, shuffle=False)
+    s2.load_state_dict(sd)
+    # Resumed sampler shards only the REMAINING n-4 indices.
+    assert len(s2) == (n - 4) // k
+    assert not set(s2.indices) & {0, 1, 2, 3}
+    s2.sync()  # allgather union across (identical) ranks
+    assert not set(s2.indices) & {0, 1, 2, 3}
+
+    s2.set_epoch(1)  # new epoch: everything back in play
+    assert len(s2) == per_rank
+
+
+def test_tf_keras_state_commit_restore(hvd):
+    tf = pytest.importorskip("tensorflow")
+    import keras
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+    model = keras.Sequential([keras.layers.Dense(2, input_shape=(3,))])
+    state = tfvd.elastic.TfKerasState(model=model, epoch=0)
+    w0 = [v.numpy().copy() for v in model.variables]
+    state.commit()
+    for v in model.variables:
+        v.assign(v + 1.0)
+    state.epoch = 2
+    state.restore()
+    for v, w in zip(model.variables, w0):
+        np.testing.assert_allclose(v.numpy(), w)
+    assert state.epoch == 0
+    state.sync()  # identity broadcast across identical ranks
