@@ -69,6 +69,7 @@ HOROVOD_DYNAMIC_PROCESS_SETS = "HOROVOD_DYNAMIC_PROCESS_SETS"
 HOROVOD_DISABLE_GROUP_FUSION = "HOROVOD_DISABLE_GROUP_FUSION"
 HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
 HOROVOD_ENABLE_ASYNC_COMPLETION = "HOROVOD_ENABLE_ASYNC_COMPLETION"
+HOROVOD_ADASUM_HALVING = "HOROVOD_ADASUM_HALVING"
 HOROVOD_CONSISTENCY_CHECK = "HOROVOD_CONSISTENCY_CHECK"
 HOROVOD_CONSISTENCY_TIMEOUT = "HOROVOD_CONSISTENCY_TIMEOUT"
 HOROVOD_NATIVE_KV_ADDR = "HOROVOD_NATIVE_KV_ADDR"
@@ -110,6 +111,7 @@ class Config:
     fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
     cycle_time_ms: float = 0.0          # TPU default 0: no background batching delay
     cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    adasum_halving: bool = False
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     disable_group_fusion: bool = False
@@ -163,6 +165,7 @@ class Config:
                 HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES),
             cycle_time_ms=_env_float(HOROVOD_CYCLE_TIME, 0.0),
             cache_capacity=_env_int(HOROVOD_CACHE_CAPACITY, DEFAULT_CACHE_CAPACITY),
+            adasum_halving=_env_bool(HOROVOD_ADASUM_HALVING),
             hierarchical_allreduce=_env_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             disable_group_fusion=_env_bool(HOROVOD_DISABLE_GROUP_FUSION),

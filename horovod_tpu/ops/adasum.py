@@ -9,13 +9,21 @@ dot(a,b), ‖a‖², ‖b‖² and combines
 which removes the common (parallel) component once instead of twice, making
 the reduction insensitive to learning-rate scaling across replicas.
 
-TPU redesign: the reference halves vectors to spread bandwidth across an
-MPI tree (adasum.h FusedAllreduce). On a TPU mesh the exchange is
-`lax.ppermute` over ICI at distance 2^l per level — log2(k) exchanges of the
-full vector. ICI bandwidth makes halving unnecessary at the gradient sizes
-involved, and whole-vector exchange keeps every rank's dot products local
-(no extra reduction round per level, where the reference needs an
-MPI_Allreduce of [a·b, ‖a‖², ‖b‖²] per pair-group).
+TPU redesign: two exchange strategies, same math.
+
+  default — full-vector ppermute at distance 2^l per level: log2(k)·n
+  traffic, but every rank's dot products stay local (no reduction round
+  per level). The right trade when gradients fit ICI bandwidth and
+  latency dominates.
+
+  HOROVOD_ADASUM_HALVING — the reference's true vector-halving
+  distance-doubling (adasum.h:195 FusedAllreduce): each level exchanges
+  only half the remaining segment (~2·n total traffic incl. the final
+  allgather), with the pair's full-vector dots computed as distributed
+  partials psum'd over the growing 2^(l+1)-rank subgroup (reference:
+  FusedPairwiseReduceWithComm + per-level reduction communicator,
+  adasum_mpi.cc). The right trade for very large gradients or
+  bandwidth-constrained (DCN-spanning) sets.
 
 The combine is associative only pairwise, so the pairing order matches the
 reference's hypercube order: level l pairs rank i with i XOR 2^l. For
@@ -47,12 +55,15 @@ def _combine(a: jax.Array, b: jax.Array) -> jax.Array:
     return (ca * af + cb * bf).astype(a.dtype)
 
 
-def adasum_reduce_block(block: jax.Array, axis: str, k: int) -> jax.Array:
+def adasum_reduce_block(block: jax.Array, axis: str, k: int,
+                        halving: bool = False) -> jax.Array:
     """Adasum-allreduce one (1, *shape) per-rank block inside shard_map.
 
     After log2(p2) ppermute levels every rank in the power-of-two core holds
     the identical combined vector; surplus ranks (non-power-of-two sets) are
-    folded in before and read back after.
+    folded in before and read back after. With `halving`
+    (HOROVOD_ADASUM_HALVING) the levels run the reference's true VHDD
+    exchange — see _vhdd_core.
     """
     x = block[0]
     p2 = 1
@@ -67,13 +78,16 @@ def adasum_reduce_block(block: jax.Array, axis: str, k: int) -> jax.Array:
         has_partner = idx < (k - p2)
         x = jnp.where(has_partner, _combine(x, folded), x)
 
-    d = 1
-    while d < p2:
-        pairs = [(i, i ^ d) for i in range(p2)]
-        other = lax.ppermute(x, axis, perm=pairs)
-        in_core = idx < p2
-        x = jnp.where(in_core, _combine(x, other), x)
-        d *= 2
+    if halving and p2 > 1:
+        x = _vhdd_core(x, axis, p2, idx)
+    else:
+        d = 1
+        while d < p2:
+            pairs = [(i, i ^ d) for i in range(p2)]
+            other = lax.ppermute(x, axis, perm=pairs)
+            in_core = idx < p2
+            x = jnp.where(in_core, _combine(x, other), x)
+            d *= 2
 
     if p2 != k:
         # Send results back to the surplus ranks.
@@ -81,6 +95,77 @@ def adasum_reduce_block(block: jax.Array, axis: str, k: int) -> jax.Array:
         back = lax.ppermute(x, axis, perm=perm_out)
         x = jnp.where(idx >= p2, back, x)
     return x[None]
+
+
+def _bitrev(j: int, bits: int) -> int:
+    out = 0
+    for _ in range(bits):
+        out = (out << 1) | (j & 1)
+        j >>= 1
+    return out
+
+
+def _vhdd_core(x: jax.Array, axis: str, p2: int, idx) -> jax.Array:
+    """True vector-halving distance-doubling (reference: adasum.h:195
+    FusedAllreduce). At level l only 1/2^(l+1) of the vector crosses the
+    wire; each pair's full-vector dot products are computed as distributed
+    partials summed over the pair (reference: FusedPairwiseReduceWithComm
+    partial dots + per-pair allreduce). Total traffic ≈ 2·n vs the
+    full-vector path's log2(p2)·n.
+    """
+    dtype = x.dtype
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.size
+    pad = (-n) % p2
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    cur = flat
+    levels = p2.bit_length() - 1
+
+    k_axis = lax.axis_size(axis)
+    d = 1
+    while d < p2:
+        pairs = [(i, i ^ d) for i in range(p2)]
+        half = cur.size // 2
+        h0, h1 = cur[:half], cur[half:]
+        bit = (idx // d) % 2            # which half this rank keeps
+        keep = jnp.where(bit == 0, h0, h1)
+        send = jnp.where(bit == 0, h1, h0)
+        recv = lax.ppermute(send, axis, perm=pairs)
+        # The level combines subtree vectors A (bit==0 side) and B; their
+        # segments are spread over the whole 2d-rank subgroup, so the
+        # full-vector dots are a psum of per-rank partials over that group
+        # (reference: the growing reduction communicator in
+        # FusedPairwiseReduceWithComm, adasum_mpi.cc). Partials are tagged
+        # by which side this rank's keep/recv segments belong to.
+        kk = jnp.vdot(keep, keep)
+        rr = jnp.vdot(recv, recv)
+        part = jnp.stack([
+            jnp.vdot(keep, recv),                  # A·B piece
+            jnp.where(bit == 0, kk, rr),           # |A|² piece
+            jnp.where(bit == 0, rr, kk),           # |B|² piece
+        ])
+        groups = [list(range(g * 2 * d, (g + 1) * 2 * d))
+                  for g in range(p2 // (2 * d))]
+        if k_axis > p2:
+            groups.append(list(range(p2, k_axis)))
+        dot, na, nb = lax.psum(part, axis, axis_index_groups=groups)
+        ca = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)),
+                       1.0)
+        cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)),
+                       1.0)
+        # own segment: A-side ranks hold A_seg in keep; B-side in recv.
+        cur = jnp.where(bit == 0, ca * keep + cb * recv,
+                        cb * keep + ca * recv)
+        d *= 2
+
+    # Rank r holds global segment bit_reverse(r): level 0's bit picks the
+    # biggest split, so the segment index reads the rank's bits MSB-first.
+    gathered = lax.all_gather(cur, axis, axis=0)     # (k, n_pad / p2)
+    combined = jnp.concatenate(
+        [gathered[_bitrev(j, levels)] for j in range(p2)])
+    return combined[:n].reshape(shape).astype(dtype)
 
 
 def adasum_numpy_reference(tensors) -> "np.ndarray":

@@ -272,7 +272,8 @@ def _apply_reduce(block: jax.Array, op: T.ReduceOp, k: int,
         y = jnp.prod(g, axis=0)
     elif op == T.ReduceOp.ADASUM:
         from horovod_tpu.ops import adasum as adasum_mod
-        y = adasum_mod.adasum_reduce_block(x, _AXIS, k)
+        y = adasum_mod.adasum_reduce_block(
+            x, _AXIS, k, halving=topology.state().config.adasum_halving)
     else:
         raise HorovodTpuError(f"unsupported reduce op {op}")
     if postscale != 1.0:

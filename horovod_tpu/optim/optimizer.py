@@ -95,7 +95,9 @@ def reduce_gradients_in_jit(grads: Any,
                 y = y / jnp.asarray(k, y.dtype)
         elif rop == T.ReduceOp.ADASUM:
             from horovod_tpu.ops import adasum as adasum_mod
-            y = adasum_mod.adasum_reduce_block(x, axis, k)
+            from horovod_tpu.core import topology as _topo
+            y = adasum_mod.adasum_reduce_block(
+                x, axis, k, halving=_topo.state().config.adasum_halving)
         else:
             raise HorovodTpuError(f"unsupported gradient reduce op {rop}")
         if post != 1.0:

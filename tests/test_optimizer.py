@@ -167,3 +167,32 @@ def test_allgather_object(hvd):
     objs = hvd_mod.allgather_object({"rank": hvd.rank()})
     assert len(objs) == hvd.size()
     assert all(o == {"rank": 0} for o in objs)
+
+
+def test_adasum_halving_matches_full_vector(hvd):
+    """HOROVOD_ADASUM_HALVING's VHDD exchange (reference adasum.h:195 —
+    halved payloads, distributed pair dots) must produce the SAME result
+    as the full-vector path and the numpy oracle, including sizes that
+    need padding and a non-power-of-two set."""
+    from horovod_tpu.core.topology import raw_state
+    from horovod_tpu.ops.adasum import adasum_numpy_reference
+    from horovod_tpu.ops.collectives import clear_compiled_cache
+
+    k = hvd.size()
+    rng = np.random.RandomState(11)
+    for n in (32, 37):  # 37: not divisible by the p2 core → padding path
+        x = rng.randn(k, n).astype(np.float32)
+        expect = adasum_numpy_reference([x[i] for i in range(k)])
+
+        cfg = raw_state().config
+        old = cfg.adasum_halving
+        try:
+            cfg.adasum_halving = True
+            clear_compiled_cache()  # knob is baked into the compiled body
+            out = np.asarray(hvd_mod.allreduce(x, op=hvd_mod.Adasum))
+        finally:
+            cfg.adasum_halving = old
+            clear_compiled_cache()
+        for r in range(k):
+            np.testing.assert_allclose(out[r], expect, rtol=1e-4,
+                                       atol=1e-5, err_msg=f"n={n} rank {r}")
