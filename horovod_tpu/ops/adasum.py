@@ -41,17 +41,19 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _coeffs(dot, na, nb):
+    """Projection coefficients with zero-norm guards (reference: adasum.h
+    checks normsq == 0 → plain sum)."""
+    ca = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)), 1.0)
+    cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)), 1.0)
+    return ca, cb
+
+
 def _combine(a: jax.Array, b: jax.Array) -> jax.Array:
     """Pairwise Adasum combine in float32 (adasum.h:346+ math)."""
     af = a.astype(jnp.float32)
     bf = b.astype(jnp.float32)
-    dot = jnp.vdot(af, bf)
-    na = jnp.vdot(af, af)
-    nb = jnp.vdot(bf, bf)
-    # Guards: zero-norm operand contributes nothing to the projection
-    # (reference: adasum.h checks normsq == 0 → plain sum).
-    ca = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)), 1.0)
-    cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)), 1.0)
+    ca, cb = _coeffs(jnp.vdot(af, bf), jnp.vdot(af, af), jnp.vdot(bf, bf))
     return (ca * af + cb * bf).astype(a.dtype)
 
 
@@ -123,7 +125,6 @@ def _vhdd_core(x: jax.Array, axis: str, p2: int, idx) -> jax.Array:
     cur = flat
     levels = p2.bit_length() - 1
 
-    k_axis = lax.axis_size(axis)
     d = 1
     while d < p2:
         pairs = [(i, i ^ d) for i in range(p2)]
@@ -135,26 +136,31 @@ def _vhdd_core(x: jax.Array, axis: str, p2: int, idx) -> jax.Array:
         recv = lax.ppermute(send, axis, perm=pairs)
         # The level combines subtree vectors A (bit==0 side) and B; their
         # segments are spread over the whole 2d-rank subgroup, so the
-        # full-vector dots are a psum of per-rank partials over that group
+        # full-vector dots are a sum of per-rank partials over that group
         # (reference: the growing reduction communicator in
         # FusedPairwiseReduceWithComm, adasum_mpi.cc). Partials are tagged
         # by which side this rank's keep/recv segments belong to.
         kk = jnp.vdot(keep, keep)
         rr = jnp.vdot(recv, recv)
+        in_core = (idx < p2).astype(jnp.float32)
         part = jnp.stack([
             jnp.vdot(keep, recv),                  # A·B piece
             jnp.where(bit == 0, kk, rr),           # |A|² piece
             jnp.where(bit == 0, rr, kk),           # |B|² piece
-        ])
-        groups = [list(range(g * 2 * d, (g + 1) * 2 * d))
-                  for g in range(p2 // (2 * d))]
-        if k_axis > p2:
-            groups.append(list(range(p2, k_axis)))
-        dot, na, nb = lax.psum(part, axis, axis_index_groups=groups)
-        ca = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)),
-                       1.0)
-        cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)),
-                       1.0)
+        ]) * in_core                               # surplus contributes 0
+        # Group-psum as ONE uniform full-axis psum of group-bucketed
+        # partials: TPU lowering rejects unequal axis_index_groups, which
+        # any non-power-of-two set would need (core groups of 2d + a
+        # surplus remainder). Scatter into this rank's group row instead.
+        num_groups = p2 // (2 * d)
+        gid = jnp.clip(idx // (2 * d), 0, num_groups - 1)
+        buckets = jnp.zeros((num_groups, 3), jnp.float32)
+        buckets = lax.dynamic_update_slice(buckets, part[None],
+                                           (gid, jnp.int32(0)))
+        totals = lax.psum(buckets, axis)           # (num_groups, 3)
+        mine = lax.dynamic_slice(totals, (gid, jnp.int32(0)), (1, 3))[0]
+        dot, na, nb = mine[0], mine[1], mine[2]
+        ca, cb = _coeffs(dot, na, nb)
         # own segment: A-side ranks hold A_seg in keep; B-side in recv.
         cur = jnp.where(bit == 0, ca * keep + cb * recv,
                         cb * keep + ca * recv)

@@ -389,7 +389,8 @@ def allreduce(tensor: Any,
                                           T.ReduceOp.AVERAGE)) else None
     key = ("ar", g.shape, str(g.dtype), int(rop), ps.cache_token,
            float(prescale_factor), float(postscale_factor), bool(donate),
-           hm is not None)
+           hm is not None,
+           bool(cfg.adasum_halving) and rop == T.ReduceOp.ADASUM)
     if hm is not None:
         fn = _cache.get_or_build(key, lambda: _builder_allreduce_hier(
             hm, k, rop, prescale_factor, postscale_factor, donate))
@@ -428,7 +429,8 @@ def grouped_allreduce(tensors: Sequence[Any],
     key = ("gar", tuple((g.shape, str(g.dtype)) for g in gs), int(rop),
            ps.cache_token, float(prescale_factor), float(postscale_factor),
            cfg.fusion_threshold_bytes, cfg.disable_group_fusion,
-           hm is not None)
+           hm is not None,
+           bool(cfg.adasum_halving) and rop == T.ReduceOp.ADASUM)
 
     def build() -> Callable:
         from horovod_tpu.ops import fusion

@@ -172,27 +172,58 @@ def test_allgather_object(hvd):
 def test_adasum_halving_matches_full_vector(hvd):
     """HOROVOD_ADASUM_HALVING's VHDD exchange (reference adasum.h:195 —
     halved payloads, distributed pair dots) must produce the SAME result
-    as the full-vector path and the numpy oracle, including sizes that
-    need padding and a non-power-of-two set."""
+    as the full-vector path and the numpy oracle, including vector sizes
+    that need padding."""
     from horovod_tpu.core.topology import raw_state
     from horovod_tpu.ops.adasum import adasum_numpy_reference
-    from horovod_tpu.ops.collectives import clear_compiled_cache
 
     k = hvd.size()
     rng = np.random.RandomState(11)
-    for n in (32, 37):  # 37: not divisible by the p2 core → padding path
-        x = rng.randn(k, n).astype(np.float32)
-        expect = adasum_numpy_reference([x[i] for i in range(k)])
-
-        cfg = raw_state().config
-        old = cfg.adasum_halving
-        try:
-            cfg.adasum_halving = True
-            clear_compiled_cache()  # knob is baked into the compiled body
+    cfg = raw_state().config
+    old = cfg.adasum_halving
+    try:
+        cfg.adasum_halving = True
+        for n in (32, 37):  # 37: not divisible by the p2 core → padding
+            x = rng.randn(k, n).astype(np.float32)
+            expect = adasum_numpy_reference([x[i] for i in range(k)])
             out = np.asarray(hvd_mod.allreduce(x, op=hvd_mod.Adasum))
-        finally:
-            cfg.adasum_halving = old
-            clear_compiled_cache()
-        for r in range(k):
-            np.testing.assert_allclose(out[r], expect, rtol=1e-4,
-                                       atol=1e-5, err_msg=f"n={n} rank {r}")
+            for r in range(k):
+                np.testing.assert_allclose(out[r], expect, rtol=1e-4,
+                                           atol=1e-5,
+                                           err_msg=f"n={n} rank {r}")
+    finally:
+        cfg.adasum_halving = old
+
+
+def test_adasum_halving_non_power_of_two_set(hvd):
+    """Non-power-of-two rank count: the surplus fold + the uniform
+    (group-bucketed, full-axis) dot psum must both work — unequal
+    axis_index_groups would be rejected by the TPU lowering, so the
+    implementation must not use them."""
+    from horovod_tpu.core.topology import raw_state
+    from horovod_tpu.ops.adasum import adasum_numpy_reference
+
+    k = hvd.size()
+    if k < 3:
+        pytest.skip("needs >2 ranks")
+    sub = list(range(k - 2))  # e.g. 6 of 8: non-power-of-two core + fold
+    cfg = raw_state().config
+    old_dyn, old_halving = cfg.dynamic_process_sets, cfg.adasum_halving
+    cfg.dynamic_process_sets = True
+    try:
+        ps = hvd_mod.add_process_set(sub)
+        rng = np.random.RandomState(13)
+        x = rng.randn(len(sub), 33).astype(np.float32)
+        expect = adasum_numpy_reference([x[i] for i in range(len(sub))])
+        for halving in (False, True):
+            cfg.adasum_halving = halving
+            out = np.asarray(hvd_mod.allreduce(x, op=hvd_mod.Adasum,
+                                               process_set=ps))
+            for r in range(len(sub)):
+                np.testing.assert_allclose(
+                    out[r], expect, rtol=1e-4, atol=1e-5,
+                    err_msg=f"halving={halving} rank {r}")
+        hvd_mod.remove_process_set(ps)
+    finally:
+        cfg.dynamic_process_sets = old_dyn
+        cfg.adasum_halving = old_halving
