@@ -36,7 +36,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
-    out = blockwise_attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    # After the re-shard each device holds the FULL sequence for its head
+    # group, so the local attention is exactly the single-device problem —
+    # the Pallas flash kernel applies directly (it falls back to the exact
+    # reference off-TPU-untileable shapes).
+    from horovod_tpu.ops.flash_attention import flash_attention
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
     # Back to sequence-sharded layout.
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)
