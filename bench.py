@@ -253,6 +253,64 @@ def bench_fusion_sweep(on_cpu):
     return out
 
 
+def bench_autotune(on_cpu):
+    """Run the autotuner in anger on the eager grouped-allreduce path
+    (reference: ParameterManager warmup->Bayesian-opt->freeze,
+    docs/autotune.rst): feed it the real fusion-sweep workload until it
+    freezes and report what it picked."""
+    from horovod_tpu.core.autotune import ParameterManager
+    from horovod_tpu.ops.collectives import clear_compiled_cache
+
+    sizes = [(1000, 2048), (2048,)] + [(512, 512, 3, 3)] * 4 + \
+        [(512,)] * 20
+    if on_cpu:
+        sizes = sizes[:4]
+    tensors = [jnp.ones(s, jnp.float32) for s in sizes]
+    nbytes = sum(int(np.prod(s)) * 4 for s in sizes)
+
+    cfg = topology.raw_state().config
+    orig = cfg.fusion_threshold_bytes
+    saved = (cfg.autotune_warmup_samples, cfg.autotune_steps_per_sample,
+             cfg.autotune_bayes_opt_max_samples)
+    # Tight sampling budget: the bench wants a frozen choice in ~30 steps,
+    # not a long production warmup.
+    cfg.autotune_warmup_samples = 2
+    cfg.autotune_steps_per_sample = 3
+    cfg.autotune_bayes_opt_max_samples = 8
+    cfg.autotune = True
+    pm = ParameterManager(cfg)
+    steps = 0
+    try:
+        while not pm.frozen and steps < 400:
+            t0 = time.perf_counter()
+            outs = hvd.grouped_allreduce(tensors, op="sum")
+            jax.block_until_ready(outs)
+            float(np.asarray(outs[0]).ravel()[0])
+            pm.record(nbytes, time.perf_counter() - t0)
+            if pm.update():
+                clear_compiled_cache()  # threshold changed: new buckets
+            steps += 1
+        tuned_mb = cfg.fusion_threshold_bytes / (1024 * 1024)
+        # Score the frozen choice.
+        outs = hvd.grouped_allreduce(tensors, op="sum")
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            outs = hvd.grouped_allreduce(tensors, op="sum")
+        jax.block_until_ready(outs)
+        float(np.asarray(outs[0]).ravel()[0])
+        tuned_ms = (time.perf_counter() - t0) / 5 * 1e3
+    finally:
+        cfg.autotune = False
+        cfg.fusion_threshold_bytes = orig
+        (cfg.autotune_warmup_samples, cfg.autotune_steps_per_sample,
+         cfg.autotune_bayes_opt_max_samples) = saved
+        clear_compiled_cache()
+    return {"frozen": pm.frozen, "steps": steps,
+            "tuned_threshold_mb": round(tuned_mb, 1),
+            "tuned_ms": round(tuned_ms, 2)}
+
+
 def main():
     hvd.init()
     mesh = topology.mesh()
@@ -286,6 +344,7 @@ def main():
             / peak, 4)
 
     fusion = bench_fusion_sweep(on_cpu)
+    autotune = bench_autotune(on_cpu)
     flash = None if on_cpu else bench_flash_attention()
 
     per_chip_ips = best["images_per_sec_per_chip"]
@@ -301,6 +360,7 @@ def main():
             "resnet50": best,
             "transformer_lm": tr,
             "fusion_sweep_grouped_allreduce": fusion,
+            "autotune": autotune,
             "flash_attention_s8192": flash,
         },
     }))

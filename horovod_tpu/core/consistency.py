@@ -79,6 +79,24 @@ class ConsistencyChecker:
         self._seq[group] = seq + 1
         self._last = (group, seq, members)
         pfx = f"{self._pfx}/{group}"
+        # NEGOTIATE span (reference: the timeline's NEGOTIATE_* phases,
+        # common.h:83-116 — here the agreement round IS the negotiation).
+        tl = None
+        try:
+            from horovod_tpu.core import topology as _topo
+            tl = _topo.raw_state().timeline
+        except Exception:
+            pass
+        if tl is not None:
+            tl.span_begin(f"{group}/{seq}", "NEGOTIATE")
+        try:
+            self._check_inner(pfx, seq, members, desc)
+        finally:
+            if tl is not None:
+                tl.span_end(f"{group}/{seq}", "NEGOTIATE")
+
+    def _check_inner(self, pfx: str, seq: int,
+                     members: Tuple[int, ...], desc: str) -> None:
         h = hashlib.sha256(desc.encode()).digest()[:16]
         self._kv.put(f"{pfx}/seen/{seq}/{self.rank}", b"1")
         self._kv.bitwise(f"{pfx}/or/{seq}", h, op="or")
