@@ -96,9 +96,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
-    bh, s, dh = q.shape
-    nq = s // block_q
-    nk = s // block_k
+    bh, sq, dh = q.shape
+    sk = k.shape[1]
+    nq = sq // block_q
+    nk = sk // block_k
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k)
     o, lse = pl.pallas_call(
@@ -114,8 +115,8 @@ def _fwd(q, k, v, causal, scale, block_q, block_k):
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, dh), jnp.float32),
@@ -154,9 +155,14 @@ def _delta_block(o_ref, do_ref):
                    * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
 
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                     dk_ref, dv_ref, dk_acc, dv_acc,
-                     *, scale, causal, block_q, block_k):
+def _bwd_dkdv_kernel(*refs, scale, causal, block_q, block_k, has_dlse):
+    if has_dlse:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dlse_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        dlse_ref = None
     ik = pl.program_id(1)
     iq = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -181,11 +187,16 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        # ds = p ∘ (do·vᵀ − delta) · scale ;  dk += dsᵀ · q
+        # ds = p ∘ (do·vᵀ − delta [+ dlse]) · scale ;  dk += dsᵀ · q
+        # (dlse: ∂lse/∂s = p — the lse output is differentiable so block
+        # results can be merged OUTSIDE the kernel, e.g. per ring hop.)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # (bq, bk)
-        ds = p * (dp - delta) * scale
+        bracket = dp - delta
+        if dlse_ref is not None:
+            bracket = bracket + dlse_ref[0][:, :1]
+        ds = p * bracket * scale
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -196,8 +207,14 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_dlse):
+    if has_dlse:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dlse_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+         dq_ref, dq_acc) = refs
+        dlse_ref = None
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -221,7 +238,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                  # (bq, bk)
+        bracket = dp - delta
+        if dlse_ref is not None:
+            bracket = bracket + dlse_ref[0][:, :1]
+        ds = p * bracket * scale                       # (bq, bk)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -231,53 +251,90 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k):
-    bh, s, dh = q.shape
-    nq = s // block_q
-    nk = s // block_k
+def _bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k):
+    """dlse=None compiles lse-cotangent-free kernels (the plain
+    flash_attention path never pays for a zero dlse buffer)."""
+    bh, sq, dh = q.shape
+    sk = k.shape[1]
+    nq = sq // block_q
+    nk = sk // block_k
+    has_dlse = dlse is not None
 
     q_by_j = pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, j, 0))
     kv_by_i = pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, i, 0))
     lse_by_j = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, j, 0))
+    in_specs = [q_by_j, kv_by_i, kv_by_i, q_by_j, q_by_j, lse_by_j]
+    operands = [q, k, v, o, do, lse]
+    if has_dlse:
+        in_specs.append(lse_by_j)
+        operands.append(dlse)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          has_dlse=has_dlse),
         grid=(bh, nk, nq),
-        in_specs=[q_by_j, kv_by_i, kv_by_i, q_by_j, q_by_j, lse_by_j],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, dh), q.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, dh), jnp.float32),
             pltpu.VMEM((block_k, dh), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, o, do, lse)
+    )(*operands)
 
     q_by_i = pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0))
     kv_by_j = pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0))
     lse_by_i = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    in_specs = [q_by_i, kv_by_j, kv_by_j, q_by_i, q_by_i, lse_by_i]
+    operands = [q, k, v, o, do, lse]
+    if has_dlse:
+        in_specs.append(lse_by_i)
+        operands.append(dlse)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          has_dlse=has_dlse),
         grid=(bh, nq, nk),
-        in_specs=[q_by_i, kv_by_j, kv_by_j, q_by_i, q_by_i, lse_by_i],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, dh), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, o, do, lse)
+    )(*operands)
     return dq, dk, dv
 
 
 # --------------------------------------------------------------------------
 # Public API with custom VJP
 # --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_chunk(q, k, v, causal, scale, block_q, block_k):
+    """Differentiable (o, lse) pair — lse cotangents feed the ds term so
+    block results can be merged OUTSIDE the kernel (per ring hop)."""
+    return _fwd(q, k, v, causal, scale, block_q, block_k)
+
+
+def _flash_chunk_fwd(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_k)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_chunk_bwd(causal, scale, block_q, block_k, res, cot):
+    q, k, v, o, lse = res
+    do, dlse = cot
+    return _bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k)
+
+
+_flash_chunk.defvjp(_flash_chunk_fwd, _flash_chunk_bwd)
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, scale, block_q, block_k):
@@ -292,10 +349,57 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
 
 def _flash_bwd(causal, scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k)
+    # dlse=None: the o-only API never pays for a zero lse cotangent.
+    return _bwd(q, k, v, o, lse, do, None, causal, scale, block_q, block_k)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def can_tile(Sq: int, Sk: Optional[int] = None,
+             causal: bool = False) -> bool:
+    """Public tileability predicate: True when the kernel path handles
+    these sequence lengths (callers like ring_attention auto-dispatch on
+    this instead of re-deriving the kernel's constraints)."""
+    if _auto_block(Sq) is None:
+        return False
+    if Sk is not None and _auto_block(Sk) is None:
+        return False
+    if causal and Sk is not None and Sq != Sk:
+        return False
+    return True
+
+
+def flash_attention_chunk(q, k, v, causal: bool = False,
+                          scale: Optional[float] = None,
+                          block_q: Optional[int] = None,
+                          block_k: Optional[int] = None):
+    """One attention chunk with mergeable outputs.
+
+    q: (B, H, Sq, dh); k, v: (B, H, Sk, dh) — Sq and Sk may differ (ring
+    hops attend local queries against a circulating K/V block). Returns
+    (o, lse) with o: (B, H, Sq, dh) normalized within the chunk and
+    lse: (B, H, Sq) float32; merge chunks with
+    L = logaddexp(L1, L2), o = e^{L1−L}·o1 + e^{L2−L}·o2. Differentiable
+    through BOTH outputs.
+    """
+    B, H, Sq, dh = q.shape
+    Sk = k.shape[2]
+    if scale is None:
+        scale = dh ** -0.5
+    bq = min(block_q, Sq) if block_q else _auto_block(Sq)
+    bk = min(block_k, Sk) if block_k else _auto_block(Sk)
+    if (bq is None or bk is None or Sq % bq or Sk % bk
+            or (causal and Sq != Sk)):
+        raise ValueError(
+            f"flash_attention_chunk cannot tile Sq={Sq}, Sk={Sk} "
+            f"(blocks {bq}, {bk}); causal chunks must be square")
+    o, lse = _flash_chunk(q.reshape(B * H, Sq, dh),
+                          k.reshape(B * H, Sk, dh),
+                          v.reshape(B * H, Sk, dh),
+                          causal, float(scale), bq, bk)
+    return (o.reshape(B, H, Sq, dh),
+            lse[..., 0].reshape(B, H, Sq))
 
 
 def _auto_block(S: int) -> Optional[int]:

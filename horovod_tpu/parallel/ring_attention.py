@@ -45,14 +45,25 @@ def _block_attn(q, k, v, m, l, o, q_off, kv_off, causal, scale):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None) -> jax.Array:
     """Exact attention with K/V rotating around the `axis_name` ring.
 
     Call inside shard_map with the sequence dimension sharded over
     `axis_name`. Shapes per shard: q, k, v = (B, H, S_local, dh).
     Block layout is contiguous: ring rank r holds tokens
     [r*S_local, (r+1)*S_local).
+
+    When the shard tiles (default-auto), each hop's block attention runs
+    on the Pallas flash kernel with the (o, lse) chunks merged in log
+    space (ring_flash_attention); otherwise the streaming jnp path below.
     """
+    if use_flash is None:
+        from horovod_tpu.ops.flash_attention import can_tile
+        use_flash = can_tile(q.shape[2], k.shape[2], causal=causal)
+    if use_flash:
+        return ring_flash_attention(q, k, v, axis_name, causal=causal,
+                                    scale=scale)
     B, H, S, dh = q.shape
     P = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
@@ -89,6 +100,71 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # but keep the guard for masked variants) divide by max(l, tiny).
     out = o / jnp.maximum(l, jnp.asarray(1e-30, l.dtype))
     return out.astype(in_dtype)
+
+
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str, causal: bool = True,
+                         scale: Optional[float] = None) -> jax.Array:
+    """Ring attention with the Pallas flash kernel inside each hop.
+
+    Each hop computes (o_chunk, lse_chunk) for the local queries against
+    the circulating K/V block (ops/flash_attention.py
+    flash_attention_chunk — differentiable through BOTH outputs), and the
+    chunks merge in log space:
+
+        L' = logaddexp(L, lse);  o' = e^{L−L'}·o + e^{lse−L'}·o_chunk
+
+    The merge is plain JAX, so jax.grad flows through the scan (reverse
+    ring via ppermute) and the per-chunk custom VJP — no streaming state
+    ever enters the kernel. Per-hop causality is block-level: a hop's K/V
+    block is entirely before (full attention), at (causal chunk), or
+    after (skipped) the query block, selected with lax.switch.
+    """
+    from horovod_tpu.ops.flash_attention import flash_attention_chunk
+
+    B, H, S, dh = q.shape
+    P = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    if scale is None:
+        scale = dh ** -0.5
+    in_dtype = q.dtype
+    # f32 end to end like the streaming path: chunk outputs in bf16 would
+    # quantize ONCE PER HOP before the merge instead of once at the end.
+    q, k, v = (t.astype(jnp.float32) for t in (q, k, v))
+
+    def chunk(kt, vt, causal_flag):
+        return flash_attention_chunk(q, kt, vt, causal=causal_flag,
+                                     scale=scale)
+
+    def hop(carry, t):
+        kt, vt, o, L = carry
+        src = (r - t) % P          # origin rank of the block we now hold
+        if causal:
+            # 0: src block after ours → skip; 1: diagonal → causal chunk;
+            # 2: before ours → full chunk.
+            case = jnp.where(src == r, 1, jnp.where(src < r, 2, 0))
+            o_b, lse_b = lax.switch(
+                case,
+                [lambda kv: (jnp.zeros((B, H, S, dh), jnp.float32),
+                             jnp.full((B, H, S), _NEG_INF, jnp.float32)),
+                 lambda kv: chunk(kv[0], kv[1], True),
+                 lambda kv: chunk(kv[0], kv[1], False)],
+                (kt, vt))
+        else:
+            o_b, lse_b = chunk(kt, vt, False)
+        L_new = jnp.logaddexp(L, lse_b)
+        w_old = jnp.exp(L - L_new)[..., None]
+        w_new = jnp.exp(lse_b - L_new)[..., None]
+        o = o * w_old + o_b * w_new
+        perm = [(i, (i + 1) % P) for i in range(P)]
+        kt = lax.ppermute(kt, axis_name, perm)
+        vt = lax.ppermute(vt, axis_name, perm)
+        return (kt, vt, o, L_new), None
+
+    o0 = jnp.zeros((B, H, S, dh), jnp.float32)
+    L0 = jnp.full((B, H, S), _NEG_INF, jnp.float32)
+    (_, _, o, _), _ = lax.scan(hop, (k, v, o0, L0), jnp.arange(P))
+    return o.astype(in_dtype)
 
 
 def blockwise_attention_reference(q, k, v, causal: bool = True,
