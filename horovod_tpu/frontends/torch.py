@@ -355,14 +355,23 @@ def broadcast_object(obj, root_rank: int = 0, name=None):
 
 class DistributedOptimizer:
     """Reference: torch/optimizer.py:36 `_DistributedOptimizer` — allreduce
-    gradients before each step. Hook-free variant: gradients are averaged
-    in `step()` (grouped/fused), matching the semantics of the reference's
-    synchronize()+step path. `compression` wraps each gradient (reference
-    :174 _allreduce_grad_async applies compress/decompress around the
-    collective); `gradient_predivide_factor` splits the averaging into
-    pre/post scales to tame fp16 overflow (reference :84-97 — Average
-    only); sparse gradients take the allgather path (or densify with
-    `sparse_as_dense`, reference :52)."""
+    gradients before each step. `compression` wraps each gradient
+    (reference :174 _allreduce_grad_async applies compress/decompress
+    around the collective); `gradient_predivide_factor` splits the
+    averaging into pre/post scales to tame fp16 overflow (reference
+    :84-97 — Average only); sparse gradients take the allgather path (or
+    densify with `sparse_as_dense`, reference :52).
+
+    Two reduction modes, as in the reference:
+    - with `named_parameters`, per-parameter backward hooks fire an ASYNC
+      allreduce as each gradient materializes (reference :131-173
+      _register_hooks/_make_hook), overlapping communication with the
+      rest of backward; `step()`/`synchronize()` waits on the handles.
+      Hook firing follows the autograd graph, which is identical across
+      ranks for identical models — the ordering the SPMD contract needs.
+    - without, gradients are reduced at `step()` in one fused grouped
+      allreduce (the synchronize()+step semantics).
+    """
 
     def __init__(self, optimizer, named_parameters=None,
                  compression=None, backward_passes_per_step: int = 1,
@@ -381,15 +390,60 @@ class DistributedOptimizer:
         self.sparse_as_dense = sparse_as_dense
         self._bpps = backward_passes_per_step
         self._count = 0
+        self._handles: dict = {}   # param -> (_Handle, compression ctx)
+        self._hooked: set = set()
+        if named_parameters is not None and backward_passes_per_step == 1:
+            self._register_hooks(named_parameters)
 
     def __getattr__(self, name):
         return getattr(self.opt, name)
 
-    def _reduce_grads(self) -> None:
+    # -- hook (overlap) mode ------------------------------------------------
+    def _register_hooks(self, named_parameters) -> None:
+        named = (list(named_parameters.items())
+                 if hasattr(named_parameters, "items")
+                 else list(named_parameters))
+        for _name, p in named:
+            if not getattr(p, "requires_grad", False):
+                continue
+            if not hasattr(p, "register_post_accumulate_grad_hook"):
+                return  # torch < 2.1: step-time reduction only
+            p.register_post_accumulate_grad_hook(self._make_hook())
+            self._hooked.add(p)
+
+    def _make_hook(self):
+        def hook(p):
+            if p.grad is None or p.grad.is_sparse:
+                return  # sparse rides the step-time path
+            pre, post = self._scales()
+            comp, ctx = self.compression.compress(p.grad.data)
+            h = allreduce_async(comp, op=self.op, prescale_factor=pre,
+                                postscale_factor=post,
+                                process_set=self.process_set)
+            self._handles[p] = (h, ctx)
+        return hook
+
+    def _scales(self):
+        if self.gradient_predivide_factor != 1.0:
+            # mean = (Σ g/f) · f / k — numerically gentler in fp16.
+            return (1.0 / self.gradient_predivide_factor,
+                    self.gradient_predivide_factor)
+        return 1.0, 1.0
+
+    def synchronize(self) -> None:
+        """Wait for in-flight hook allreduces and install the results
+        (reference: _DistributedOptimizer.synchronize)."""
+        for p, (h, ctx) in self._handles.items():
+            out = synchronize(h)
+            p.grad.data.copy_(self.compression.decompress(out, ctx))
+        self._handles.clear()
+
+    # -- step-time (fused) mode ---------------------------------------------
+    def _reduce_grads(self, exclude=()) -> None:
         dense, sparse = [], []
         for group in self.opt.param_groups:
             for p in group["params"]:
-                if p.grad is None:
+                if p.grad is None or p in exclude:
                     continue
                 if p.grad.is_sparse:
                     if self.sparse_as_dense:
@@ -400,11 +454,7 @@ class DistributedOptimizer:
                 else:
                     dense.append(p)
         if dense:
-            pre = post = 1.0
-            if self.gradient_predivide_factor != 1.0:
-                # mean = (Σ g/f) · f / k — numerically gentler in fp16.
-                pre = 1.0 / self.gradient_predivide_factor
-                post = self.gradient_predivide_factor
+            pre, post = self._scales()
             pairs = [self.compression.compress(p.grad.data) for p in dense]
             reduced = grouped_allreduce(
                 [t for t, _ in pairs], op=self.op,
@@ -420,14 +470,15 @@ class DistributedOptimizer:
     def step(self, closure=None):
         self._count += 1
         if self._count % self._bpps == 0:
-            self._reduce_grads()
+            handled = frozenset(self._handles)
+            self.synchronize()
+            # Anything the hooks did not cover (sparse grads, params
+            # without hooks, hook-free mode) reduces fused here.
+            self._reduce_grads(exclude=handled)
         return self.opt.step(closure)
 
     def zero_grad(self, *a, **kw):
         return self.opt.zero_grad(*a, **kw)
-
-    def synchronize(self):
-        pass
 
     def state_dict(self):
         return self.opt.state_dict()

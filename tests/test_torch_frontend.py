@@ -171,3 +171,55 @@ def test_torch_duplicate_name_error(hvd):
     for _ in range(5):
         h = thvd.allreduce_async(torch.ones(4), name="grad0")
         thvd.synchronize(h)
+
+
+def test_torch_optimizer_hook_overlap(hvd):
+    """named_parameters enables per-parameter backward hooks firing async
+    allreduces as gradients materialize (reference: torch/optimizer.py
+    _register_hooks :131-173); step() waits and applies. Results must
+    match the step-time fused path exactly."""
+    import horovod_tpu.frontends.torch as thvd
+
+    torch.manual_seed(0)
+    model_a = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                                  torch.nn.Linear(8, 2))
+    model_b = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                                  torch.nn.Linear(8, 2))
+    model_b.load_state_dict(model_a.state_dict())
+
+    opt_hook = thvd.DistributedOptimizer(
+        torch.optim.SGD(model_a.parameters(), lr=0.1),
+        named_parameters=model_a.named_parameters())
+    opt_fused = thvd.DistributedOptimizer(
+        torch.optim.SGD(model_b.parameters(), lr=0.1))
+
+    assert opt_hook._hooked, "hooks were not registered"
+    x = torch.randn(16, 4)
+    y = torch.randn(16, 2)
+    for _ in range(3):
+        for model, opt in ((model_a, opt_hook), (model_b, opt_fused)):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(model(x), y).backward()
+            opt.step()
+        assert not opt_hook._handles  # all drained by step()
+    for pa, pb in zip(model_a.parameters(), model_b.parameters()):
+        np.testing.assert_allclose(pa.detach().numpy(),
+                                   pb.detach().numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_torch_optimizer_hook_with_compression(hvd):
+    import horovod_tpu.frontends.torch as thvd
+    model = torch.nn.Linear(4, 2)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.0),
+        named_parameters=model.named_parameters(),
+        compression=thvd.Compression.fp16,
+        gradient_predivide_factor=2.0)
+    model(torch.ones(2, 4)).sum().backward()
+    before = [p.grad.detach().clone() for p in model.parameters()]
+    opt.step()
+    for p, b in zip(model.parameters(), before):
+        assert p.grad.dtype == torch.float32
+        np.testing.assert_allclose(p.grad.numpy(), b.numpy(),
+                                   rtol=1e-2, atol=1e-2)
