@@ -67,8 +67,9 @@ HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
 HOROVOD_DYNAMIC_PROCESS_SETS = "HOROVOD_DYNAMIC_PROCESS_SETS"
 HOROVOD_DISABLE_GROUP_FUSION = "HOROVOD_DISABLE_GROUP_FUSION"
-HOROVOD_BATCH_D2D_MEMCOPIES = "HOROVOD_BATCH_D2D_MEMCOPIES"
-HOROVOD_ENABLE_ASYNC_COMPLETION = "HOROVOD_ENABLE_ASYNC_COMPLETION"
+# (HOROVOD_BATCH_D2D_MEMCOPIES and HOROVOD_ENABLE_ASYNC_COMPLETION have no
+# TPU analog — XLA fuses the copies and JAX dispatch is always async — so
+# those knobs are intentionally absent rather than parsed-and-dead.)
 HOROVOD_ADASUM_HALVING = "HOROVOD_ADASUM_HALVING"
 HOROVOD_CONSISTENCY_CHECK = "HOROVOD_CONSISTENCY_CHECK"
 HOROVOD_CONSISTENCY_TIMEOUT = "HOROVOD_CONSISTENCY_TIMEOUT"
