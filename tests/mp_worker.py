@@ -138,11 +138,11 @@ def scenario_torch_frontend(hvd, rank, size):
         opt = thvd.DistributedOptimizer(
             torch.optim.SGD([p], lr=1.0),
             named_parameters=[("p", p)] if hooks else None)
-        p.grad = torch.full((3,), float(rank + 1))
         if hooks:
             # Hooks fire from autograd; drive the grad through backward.
-            p.grad = None
             (p * torch.full((3,), float(rank + 1))).sum().backward()
+        else:
+            p.grad = torch.full((3,), float(rank + 1))
         opt.step()
         np.testing.assert_allclose(p.detach().numpy(), -(size + 1) / 2.0,
                                    rtol=1e-6)
@@ -160,10 +160,12 @@ def scenario_tf_frontend(hvd, rank, size):
 
     w = tf.Variable([[float(rank + 1)]])
     with tf.GradientTape() as tape:
-        loss = tf.reduce_sum(w * 2.0)
+        # Rank-dependent loss: the local gradient is rank+1, so only a
+        # REAL cross-rank allreduce yields the mean (size+1)/2.
+        loss = tf.reduce_sum(w * float(rank + 1))
     dtape = tfvd.DistributedGradientTape(tape)
     (g,) = dtape.gradient(loss, [w])
-    np.testing.assert_allclose(g.numpy(), [[2.0]])  # identical d/dw
+    np.testing.assert_allclose(g.numpy(), [[(size + 1) / 2.0]])
     tfvd.broadcast_variables([w], root_rank=0)
     np.testing.assert_allclose(w.numpy(), [[1.0]])
 

@@ -146,10 +146,17 @@ def test_consistency_missing_rank_named(tmp_path):
         assert f"MP_WORKER_OK consistency_missing rank={rank}" in text, text
 
 
-def test_frontends_multiprocess(tmp_path):
-    """Torch + TF frontends over REAL processes (the frontends' own
-    analog of running test/parallel/test_torch.py under mpirun)."""
-    text = run_scenarios(2, "torch_frontend,tf_frontend", tmp_path)
-    for name in ("torch_frontend", "tf_frontend"):
-        for rank in range(2):
-            assert f"MP_WORKER_OK {name} rank={rank}" in text, text
+def test_torch_frontend_multiprocess(tmp_path):
+    """Torch frontend over REAL processes (the frontend's analog of
+    running test/parallel/test_torch.py under mpirun)."""
+    pytest.importorskip("torch")
+    text = run_scenarios(2, "torch_frontend", tmp_path)
+    for rank in range(2):
+        assert f"MP_WORKER_OK torch_frontend rank={rank}" in text, text
+
+
+def test_tf_frontend_multiprocess(tmp_path):
+    pytest.importorskip("tensorflow")
+    text = run_scenarios(2, "tf_frontend", tmp_path)
+    for rank in range(2):
+        assert f"MP_WORKER_OK tf_frontend rank={rank}" in text, text
