@@ -1,0 +1,120 @@
+"""Disk checkpointing (orbax-backed), rank-0 semantics + elastic bridge.
+
+The reference has no checkpoint subsystem of its own — examples save on
+rank 0 only (e.g. examples/pytorch/pytorch_mnist.py) and elastic State is
+an in-memory checkpoint (SURVEY §5). A TPU-native framework should ship
+the disk half: rank-0 writes through orbax (the JAX-ecosystem
+checkpointer), a barrier makes saves visible before anyone proceeds, and
+the elastic State objects round-trip through it so in-memory commits can
+be anchored to disk at user-chosen intervals.
+
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint as ckpt
+
+    ckpt.save("/tmp/run/step_1000", {"params": params, "opt": opt_state})
+    restored = ckpt.restore("/tmp/run/step_1000", like={"params": params,
+                                                       "opt": opt_state})
+
+    # Elastic anchor: state.commit() keeps the in-memory copy; every N
+    # commits also hit disk.
+    cb = ckpt.CheckpointCallback("/tmp/run", state, every_n=100)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from horovod_tpu.core import topology
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save(path: str, tree: Any, *, all_ranks_barrier: bool = True) -> None:
+    """Write a pytree checkpoint from rank 0 (reference convention:
+    rank-0-only saves); other ranks wait at a barrier so the checkpoint
+    is durable before anyone races ahead."""
+    if topology.rank() == 0:
+        cp = _checkpointer()
+        cp.save(os.path.abspath(path), tree, force=True)
+        cp.wait_until_finished()
+    if all_ranks_barrier and topology.size() > 1:
+        from horovod_tpu.ops import collectives
+        collectives.barrier()
+
+
+def restore(path: str, like: Optional[Any] = None) -> Any:
+    """Read a checkpoint on every rank. `like` (a pytree of arrays or
+    ShapeDtypeStructs) restores with matching structure/dtypes."""
+    import jax
+
+    cp = _checkpointer()
+    target = None
+    if like is not None:
+        target = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") and hasattr(x, "dtype") else x, like)
+    return cp.restore(os.path.abspath(path), target)
+
+
+def latest_step(root: str) -> Optional[int]:
+    """Highest step_N subdirectory under `root`, or None."""
+    try:
+        steps = [int(d.rsplit("_", 1)[1]) for d in os.listdir(root)
+                 if d.startswith("step_") and d.rsplit("_", 1)[1].isdigit()]
+    except FileNotFoundError:
+        return None
+    return max(steps) if steps else None
+
+
+def save_state(root: str, state, step: int) -> None:
+    """Anchor an elastic State's committed values to disk
+    (elastic/state.py ObjectState/JaxState): the saved snapshot is
+    exactly what restore() would roll back to."""
+    state.save()
+    payload = {"step": step}
+    saved_trees = getattr(state, "_saved_trees", None)
+    if saved_trees:
+        payload["trees"] = {k: v for k, v in saved_trees.items()
+                            if v is not None}
+    saved = getattr(state, "_saved", None)
+    if saved:
+        payload["objects"] = dict(saved)
+    save(os.path.join(root, f"step_{step}"), payload)
+
+
+def restore_state(root: str, state, step: Optional[int] = None) -> int:
+    """Load a disk anchor back into an elastic State; returns the step.
+    Missing root/steps raise FileNotFoundError."""
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no step_N checkpoints under {root}")
+    payload = restore(os.path.join(root, f"step_{step}"))
+    for k, v in payload.get("trees", {}).items():
+        state._saved_trees[k] = v
+    for k, v in payload.get("objects", {}).items():
+        state._saved[k] = v
+        state._known_attrs.add(k)
+    state.restore()
+    return int(payload["step"])
+
+
+class CheckpointCallback:
+    """Commit-to-disk every N in-memory commits (plugs into the callback
+    list like the Keras CommitStateCallback, _keras/elastic.py)."""
+
+    def __init__(self, root: str, state, every_n: int = 100):
+        self.root = root
+        self.state = state
+        self.every_n = max(1, every_n)
+        self._count = 0
+
+    def on_commit(self, step: Optional[int] = None) -> None:
+        self._count += 1
+        if self._count % self.every_n == 0:
+            save_state(self.root, self.state,
+                       step if step is not None else self._count)
