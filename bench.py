@@ -164,9 +164,33 @@ def bench_flash_attention(S=8192, iters=10):
     t_flash = timed(lambda q, k, v: flash_attention(q, k, v, causal=True))
     t_naive = timed(lambda q, k, v: blockwise_attention_reference(
         q, k, v, causal=True))
+
+    # Capability unlock: S=32768 on ONE chip — the naive path's score
+    # matrix alone (B·H·S² bf16 = 32 GiB) cannot fit 16 GB HBM; flash
+    # streams it in O(S) blocks.
+    S32 = 32768
+    q2, k2, v2 = (jax.random.normal(kk, (1, 16, S32, 128), jnp.bfloat16)
+                  for kk in ks)
+    g32 = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32)),
+        argnums=(0, 1, 2)))
+    for _ in range(3):
+        out = g32(q2, k2, v2)
+    jax.block_until_ready(out)
+    np.asarray(out[0][0, 0, 0])
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = g32(q2, k2, v2)
+    jax.block_until_ready(out)
+    np.asarray(out[0][0, 0, 0])
+    t_32k = (time.perf_counter() - t0) / 5 * 1e3
+
     return {"flash_fwd_bwd_ms": round(t_flash, 2),
             "naive_fwd_bwd_ms": round(t_naive, 2),
-            "speedup": round(t_naive / t_flash, 2)}
+            "speedup": round(t_naive / t_flash, 2),
+            "s32768_flash_fwd_bwd_ms": round(t_32k, 2),
+            "s32768_naive": "OOM (score matrix alone 32 GiB bf16)"}
 
 
 def bench_transformer(on_cpu, steps, warmup):
