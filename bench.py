@@ -143,48 +143,35 @@ def bench_flash_attention(S=8192, iters=10):
     q, k, v = (jax.random.normal(kk, (2, 16, S, 128), jnp.bfloat16)
                for kk in ks)
 
-    def timed(fn):
+    def timed(fn, qkv, n_iters, warmup=5):
         g = jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
             argnums=(0, 1, 2)))
         # Generous warmup: the first post-compile executions through the
         # tunnel are 5-6x slower (deferred transfers/allocation) and would
         # dominate a short timed loop.
-        for _ in range(5):
-            out = g(q, k, v)
+        for _ in range(warmup):
+            out = g(*qkv)
         jax.block_until_ready(out)
         np.asarray(out[0][0, 0, 0])
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = g(q, k, v)
+        for _ in range(n_iters):
+            out = g(*qkv)
         jax.block_until_ready(out)
         np.asarray(out[0][0, 0, 0])  # force readback through the tunnel
-        return (time.perf_counter() - t0) / iters * 1e3
+        return (time.perf_counter() - t0) / n_iters * 1e3
 
-    t_flash = timed(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    flash_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)  # noqa: E731
+    t_flash = timed(flash_fn, (q, k, v), iters)
     t_naive = timed(lambda q, k, v: blockwise_attention_reference(
-        q, k, v, causal=True))
+        q, k, v, causal=True), (q, k, v), iters)
 
     # Capability unlock: S=32768 on ONE chip — the naive path's score
     # matrix alone (B·H·S² bf16 = 32 GiB) cannot fit 16 GB HBM; flash
     # streams it in O(S) blocks.
-    S32 = 32768
-    q2, k2, v2 = (jax.random.normal(kk, (1, 16, S32, 128), jnp.bfloat16)
+    qkv32 = tuple(jax.random.normal(kk, (1, 16, 32768, 128), jnp.bfloat16)
                   for kk in ks)
-    g32 = jax.jit(jax.grad(
-        lambda q, k, v: jnp.sum(
-            flash_attention(q, k, v, causal=True).astype(jnp.float32)),
-        argnums=(0, 1, 2)))
-    for _ in range(3):
-        out = g32(q2, k2, v2)
-    jax.block_until_ready(out)
-    np.asarray(out[0][0, 0, 0])
-    t0 = time.perf_counter()
-    for _ in range(5):
-        out = g32(q2, k2, v2)
-    jax.block_until_ready(out)
-    np.asarray(out[0][0, 0, 0])
-    t_32k = (time.perf_counter() - t0) / 5 * 1e3
+    t_32k = timed(flash_fn, qkv32, 5, warmup=3)
 
     return {"flash_fwd_bwd_ms": round(t_flash, 2),
             "naive_fwd_bwd_ms": round(t_naive, 2),

@@ -15,8 +15,8 @@ be anchored to disk at user-chosen intervals.
     restored = ckpt.restore("/tmp/run/step_1000", like={"params": params,
                                                        "opt": opt_state})
 
-    # Elastic anchor: state.commit() keeps the in-memory copy; every N
-    # commits also hit disk.
+    # Elastic anchor: a real optim/callbacks Callback that commits and
+    # hits disk every N batches.
     cb = ckpt.CheckpointCallback("/tmp/run", state, every_n=100)
 """
 
@@ -71,10 +71,10 @@ def latest_step(root: str) -> Optional[int]:
 
 
 def save_state(root: str, state, step: int) -> None:
-    """Anchor an elastic State's committed values to disk
-    (elastic/state.py ObjectState/JaxState): the saved snapshot is
-    exactly what restore() would roll back to."""
-    state.save()
+    """Anchor an elastic State's COMMITTED values to disk
+    (elastic/state.py ObjectState/JaxState): reads the last commit()'s
+    snapshot as-is — it must NOT re-snapshot, or a mid-step anchor would
+    both write uncommitted values and move the in-memory rollback point."""
     payload = {"step": step}
     saved_trees = getattr(state, "_saved_trees", None)
     if saved_trees:
@@ -103,9 +103,15 @@ def restore_state(root: str, state, step: Optional[int] = None) -> int:
     return int(payload["step"])
 
 
-class CheckpointCallback:
-    """Commit-to-disk every N in-memory commits (plugs into the callback
-    list like the Keras CommitStateCallback, _keras/elastic.py)."""
+def _callback_base():
+    from horovod_tpu.optim.callbacks import Callback
+    return Callback
+
+
+class CheckpointCallback(_callback_base()):
+    """Commit + anchor to disk every N batches, as a real optim/callbacks
+    Callback (the disk-backed sibling of CommitStateCallback,
+    reference: _keras/elastic.py commits per N batches)."""
 
     def __init__(self, root: str, state, every_n: int = 100):
         self.root = root
@@ -113,8 +119,8 @@ class CheckpointCallback:
         self.every_n = max(1, every_n)
         self._count = 0
 
-    def on_commit(self, step: Optional[int] = None) -> None:
+    def on_batch_end(self, batch, state=None) -> None:
         self._count += 1
         if self._count % self.every_n == 0:
-            save_state(self.root, self.state,
-                       step if step is not None else self._count)
+            self.state.commit()
+            save_state(self.root, self.state, step=self._count)

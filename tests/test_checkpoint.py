@@ -45,11 +45,36 @@ def test_elastic_state_disk_anchor(hvd, tmp_path):
 
 
 def test_checkpoint_callback_every_n(hvd, tmp_path):
+    """CheckpointCallback is a REAL optim/callbacks Callback: it rides a
+    CallbackList's on_batch_end and commits+anchors every N batches."""
     from horovod_tpu import checkpoint as ckpt
+    from horovod_tpu.optim.callbacks import CallbackList
     root = str(tmp_path / "cb")
-    state = hvd.elastic.JaxState(params={"w": jnp.ones((2,))}, step=0)
-    cb = ckpt.CheckpointCallback(root, state, every_n=3)
+    state = hvd.elastic.JaxState(params={"w": jnp.ones((2,))}, count=0)
+    cbs = CallbackList([ckpt.CheckpointCallback(root, state, every_n=3)])
+    cbs.on_train_begin({})  # protocol hooks it does not override are fine
     for i in range(1, 8):
-        cb.on_commit(step=i)
-    # Commits 3 and 6 hit disk.
+        state.count = i
+        cbs.on_batch_end(i, {})
+    # Batches 3 and 6 hit disk, carrying the values committed THEN.
     assert ckpt.latest_step(root) == 6
+    fresh = hvd.elastic.JaxState(params={"w": jnp.zeros((2,))}, count=0)
+    ckpt.restore_state(root, fresh, step=6)
+    assert fresh.count == 6
+
+
+def test_save_state_anchors_committed_not_current(hvd, tmp_path):
+    """save_state must write the last COMMITTED snapshot, not re-snapshot
+    live (possibly mid-step) values."""
+    from horovod_tpu import checkpoint as ckpt
+    root = str(tmp_path / "anchor")
+    state = hvd.elastic.JaxState(params={"w": jnp.ones((2,))}, epoch=1)
+    state.commit()
+    state.epoch = 99           # uncommitted mutation after the commit
+    ckpt.save_state(root, state, step=10)
+    assert state.epoch == 99   # anchoring must not move live values...
+    state.restore()
+    assert state.epoch == 1    # ...nor the in-memory rollback point
+    fresh = hvd.elastic.JaxState(params={"w": jnp.zeros((2,))}, epoch=0)
+    ckpt.restore_state(root, fresh)
+    assert fresh.epoch == 1    # disk carries the committed value
