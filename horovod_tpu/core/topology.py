@@ -411,6 +411,12 @@ def _start_stall_watch(si, cfg: Config) -> None:
                     "(HOROVOD_STALL_CHECK_TIME_SECONDS)",
                     cfg.stall_warning_seconds, ", ".join(stalled), who)
             if shut:
+                # Teardown race: a concurrent shutdown() means the "stall"
+                # is just the process exiting — re-check before the hard
+                # abort (reference: stall shutdown only fires while the
+                # background loop is live, operations.cc).
+                if not (_state.initialized and _state.stall_inspector is si):
+                    return
                 get_logger().error(
                     "Stall exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; "
                     "aborting")
