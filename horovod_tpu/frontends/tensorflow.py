@@ -26,8 +26,12 @@ import numpy as np
 
 from horovod_tpu.common import types as T
 from horovod_tpu.core.topology import (  # noqa: F401
-    init, is_initialized, local_rank, local_size, rank, shutdown, size,
+    cross_rank, cross_size, gloo_built, init, is_homogeneous,
+    is_initialized, local_rank, local_size, mpi_built, mpi_enabled,
+    mpi_threads_supported, nccl_built, rank, shutdown, size, tpu_built,
 )
+from horovod_tpu.core.join import join  # noqa: F401
+from horovod_tpu.optim.functions import allgather_object  # noqa: F401
 from horovod_tpu.core.process_sets import (  # noqa: F401
     ProcessSet, add_process_set, global_process_set, remove_process_set,
 )
