@@ -87,9 +87,15 @@ def main():
         if len(jax.devices()) < args.sp:
             raise SystemExit(f"--sp {args.sp} needs {args.sp} devices "
                              f"(have {len(jax.devices())})")
+        if args.seq % args.sp:
+            raise SystemExit(f"--seq {args.seq} must be divisible by "
+                             f"--sp {args.sp} (sequence is sharded)")
         sharded(args.seq, args.heads, args.dh, args.sp, "ring")
         if args.heads % args.sp == 0:
             sharded(args.seq, args.heads, args.dh, args.sp, "ulysses")
+        else:
+            print(f"(skipping ulysses: heads={args.heads} not divisible "
+                  f"by sp={args.sp})")
 
 
 if __name__ == "__main__":

@@ -103,15 +103,18 @@ def restore_state(root: str, state, step: Optional[int] = None) -> int:
     return int(payload["step"])
 
 
-def _callback_base():
-    from horovod_tpu.optim.callbacks import Callback
-    return Callback
+from horovod_tpu.optim.callbacks import Callback as _Callback
 
 
-class CheckpointCallback(_callback_base()):
+class CheckpointCallback(_Callback):
     """Commit + anchor to disk every N batches, as a real optim/callbacks
     Callback (the disk-backed sibling of CommitStateCallback,
-    reference: _keras/elastic.py commits per N batches)."""
+    reference: _keras/elastic.py commits per N batches).
+
+    Pass the GLOBAL step as the `batch` argument: the anchor is labeled
+    step_<batch>, so after an elastic restart (fresh callback object) the
+    anchors continue from the restored step instead of regressing to a
+    local counter and being shadowed by stale pre-crash checkpoints."""
 
     def __init__(self, root: str, state, every_n: int = 100):
         self.root = root
@@ -122,5 +125,6 @@ class CheckpointCallback(_callback_base()):
     def on_batch_end(self, batch, state=None) -> None:
         self._count += 1
         if self._count % self.every_n == 0:
+            step = batch if isinstance(batch, int) else self._count
             self.state.commit()
-            save_state(self.root, self.state, step=self._count)
+            save_state(self.root, self.state, step=step)
