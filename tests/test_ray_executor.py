@@ -1,0 +1,164 @@
+"""RayExecutor / ElasticRayExecutor logic against a stub `ray` module.
+
+Real ray is not installed here; the executor's driver-side logic
+(collect via ray.wait, per-rank error surfacing, actor-death detection
+while survivors block, ring restart within per-rank limits) is what these
+tests pin down — the reference tests its Ray layer on a local ray
+cluster (test/single/test_ray*.py); this is the dependency-free analog.
+"""
+
+import sys
+import types
+
+import pytest
+
+
+class _Future:
+    def __init__(self, value=None, dead=False):
+        self.value = value
+        self.dead = dead
+
+
+class _ActorHandle:
+    """Stub of Worker.remote(...) — execute.remote returns a _Future."""
+
+    def __init__(self, pool, rank):
+        self._pool = pool
+        self._rank = rank
+
+        class _Execute:
+            @staticmethod
+            def remote(fn, *a, **kw):
+                if pool.dead_ranks_this_round.get(self._rank, 0) > 0:
+                    pool.dead_ranks_this_round[self._rank] -= 1
+                    return _Future(dead=True)
+                from horovod_tpu.runner.results import capture
+                return _Future(capture(fn, self._rank, *a, **kw))
+
+        self.execute = _Execute()
+
+
+class _StubRayPool:
+    """Installable fake `ray` module. Actor death is scripted per rank as
+    a count of rounds it dies in."""
+
+    def __init__(self):
+        self.dead_ranks_this_round = {}
+        self.killed = []
+        self.mod = types.ModuleType("ray")
+        self.mod.remote = self._remote
+        self.mod.get = self._get
+        self.mod.wait = self._wait
+        self.mod.kill = self._kill
+
+    def _remote(self, **kw):
+        def deco(cls):
+            pool = self
+
+            class _Remote:
+                @staticmethod
+                def remote(rank, size, env):
+                    return _ActorHandle(pool, rank)
+
+            return _Remote
+
+        return deco
+
+    def _get(self, fut):
+        if fut.dead:
+            raise RuntimeError("RayActorError: actor died")
+        return fut.value
+
+    def _wait(self, pending, num_returns=1):
+        # Dead futures surface first (like ray observing actor death while
+        # healthy survivors are still blocked in a collective).
+        order = sorted(pending, key=lambda f: not f.dead)
+        return order[:num_returns], order[num_returns:]
+
+    def _kill(self, actor):
+        self.killed.append(actor)
+
+
+@pytest.fixture()
+def stub_ray(monkeypatch):
+    pool = _StubRayPool()
+    monkeypatch.setitem(sys.modules, "ray", pool.mod)
+    yield pool
+    # monkeypatch restores sys.modules
+
+
+def test_run_collects_per_rank_results(stub_ray):
+    from horovod_tpu.ray import RayExecutor
+    ex = RayExecutor(num_workers=4)
+    ex.start()
+    try:
+        out = ex.run(lambda rank: rank * 10)
+        assert out == [0, 10, 20, 30]
+    finally:
+        ex.shutdown()
+
+
+def test_run_surfaces_worker_exception_with_rank(stub_ray):
+    from horovod_tpu.ray import RayExecutor
+    from horovod_tpu.runner.results import RemoteJobError
+
+    def fn(rank):
+        if rank == 2:
+            raise ValueError("boom on two")
+        return rank
+
+    ex = RayExecutor(num_workers=3)
+    ex.start()
+    try:
+        with pytest.raises(RemoteJobError) as ei:
+            ex.run(fn)
+        assert "rank 2 failed" in str(ei.value)
+        assert "boom on two" in str(ei.value)
+    finally:
+        ex.shutdown()
+
+
+def test_run_actor_death_fails_and_restarts_ring(stub_ray):
+    from horovod_tpu.ray import RayExecutor
+    from horovod_tpu.runner.results import RemoteJobError
+    ex = RayExecutor(num_workers=3)
+    ex.start()
+    try:
+        stub_ray.dead_ranks_this_round[1] = 1
+        with pytest.raises(RemoteJobError) as ei:
+            ex.run(lambda rank: rank)
+        assert "[1]" in str(ei.value)
+        # Survivors were killed/recreated (they may be blocked against the
+        # dead peer) — and the executor still works afterwards.
+        assert len(stub_ray.killed) >= 3
+        assert ex.run(lambda rank: rank) == [0, 1, 2]
+    finally:
+        ex.shutdown()
+
+
+def test_elastic_restarts_within_limits(stub_ray):
+    from horovod_tpu.ray import ElasticRayExecutor
+    ex = ElasticRayExecutor(num_workers=3, max_restarts=2)
+    ex.start()
+    try:
+        stub_ray.dead_ranks_this_round[2] = 2  # dies twice, then recovers
+        out = ex.run(lambda rank: rank + 1)
+        assert out == [1, 2, 3]
+        assert ex.policy.restarts(2) == 2
+        assert ex.policy.restarts(0) == 0
+    finally:
+        ex.shutdown()
+
+
+def test_elastic_gives_up_past_restart_limit(stub_ray):
+    from horovod_tpu.ray import ElasticRayExecutor
+    from horovod_tpu.runner.results import RemoteJobError
+    ex = ElasticRayExecutor(num_workers=2, max_restarts=1)
+    ex.start()
+    try:
+        stub_ray.dead_ranks_this_round[0] = 5  # keeps dying
+        with pytest.raises(RemoteJobError) as ei:
+            ex.run(lambda rank: rank)
+        assert "exceeded 1 restarts" in str(ei.value)
+    finally:
+        ex.shutdown()
