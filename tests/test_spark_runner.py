@@ -1,0 +1,89 @@
+"""spark.run() driver logic against a stub pyspark module.
+
+Real pyspark is not installed here; what these tests pin down is the
+run() plumbing — per-task env injection, worker exception capture, and
+driver-side per-rank error surfacing (the reference tests its Spark layer
+on a local pyspark session, test/utils/spark_common.py; this is the
+dependency-free analog)."""
+
+import os
+import sys
+import types
+
+import pytest
+
+
+class _StubRDD:
+    def __init__(self, n):
+        self.n = n
+        self._fn = None
+
+    def mapPartitionsWithIndex(self, fn):
+        self._fn = fn
+        return self
+
+    def collect(self):
+        out = []
+        for i in range(self.n):
+            out.extend(self._fn(i, iter(())))
+        return out
+
+
+class _StubSparkContext:
+    defaultParallelism = 3
+    _active_spark_context = None
+
+    def parallelize(self, rng, n):
+        return _StubRDD(n)
+
+
+@pytest.fixture()
+def stub_pyspark(monkeypatch):
+    sc = _StubSparkContext()
+    _StubSparkContext._active_spark_context = sc
+    mod = types.ModuleType("pyspark")
+    mod.SparkContext = _StubSparkContext
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+    # The stub runs task_fn IN-PROCESS, so its worker-env injection
+    # (HOROVOD_RANK etc.) mutates this process's os.environ — restore it
+    # or later tests' hvd.init() would read a phantom rank 2 of 3.
+    saved = dict(os.environ)
+    yield sc
+    os.environ.clear()
+    os.environ.update(saved)
+    _StubSparkContext._active_spark_context = None
+
+
+def test_spark_run_per_rank_results(stub_pyspark):
+    import horovod_tpu.spark as hvd_spark
+
+    def fn(tag):
+        # Worker-side env injected by the task wrapper.
+        return (tag, os.environ["HOROVOD_RANK"], os.environ["HOROVOD_SIZE"],
+                "HOROVOD_SECRET_KEY" in os.environ)
+
+    out = hvd_spark.run(fn, args=("x",))
+    assert [r[1] for r in out] == ["0", "1", "2"]  # rank order
+    assert all(r[0] == "x" and r[2] == "3" and r[3] for r in out)
+
+
+def test_spark_run_surfaces_task_error(stub_pyspark):
+    import horovod_tpu.spark as hvd_spark
+    from horovod_tpu.runner.results import RemoteJobError
+
+    def fn():
+        if os.environ["HOROVOD_RANK"] == "1":
+            raise RuntimeError("task one exploded")
+        return "ok"
+
+    with pytest.raises(RemoteJobError) as ei:
+        hvd_spark.run(fn)
+    assert "rank 1 failed" in str(ei.value)
+    assert "task one exploded" in str(ei.value)
+
+
+def test_spark_run_requires_active_context(stub_pyspark):
+    import horovod_tpu.spark as hvd_spark
+    _StubSparkContext._active_spark_context = None
+    with pytest.raises(RuntimeError):
+        hvd_spark.run(lambda: 1)
