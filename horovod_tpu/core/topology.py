@@ -321,7 +321,12 @@ def init(process_sets: Optional[Sequence] = None,
                 "(reference: operations.cc RunLoopOnce)", cfg.cycle_time_ms)
         if cfg.consistency_check:
             from horovod_tpu.core import consistency
-            consistency.maybe_init(cfg, _state.rank, _state.size)
+            # Agreement is between PROCESSES: in single-controller mode
+            # one process owns all N device-ranks but contributes once, so
+            # sizing the check by rank count would make every collective
+            # wait for contributions that can never arrive.
+            consistency.maybe_init(cfg, jax.process_index(),
+                                   jax.process_count())
         if cfg.autotune:
             from horovod_tpu.core.autotune import ParameterManager
             _state.parameter_manager = ParameterManager(cfg)

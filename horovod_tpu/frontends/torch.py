@@ -222,7 +222,11 @@ def alltoall(tensor, splits=None, name=None,
              process_set: Optional[ProcessSet] = None):
     out, recv = _run_serialized(C.alltoall, _to_np(tensor), splits=splits,
                                 name=name, process_set=process_set)
-    return _like(out, tensor), _like(recv, tensor).long()
+    # recv counts stay integral end-to-end — routing them through the input
+    # dtype (e.g. bf16) would corrupt counts above the mantissa range.
+    torch = _torch()
+    return _like(out, tensor), torch.from_numpy(
+        np.ascontiguousarray(np.asarray(recv)).astype(np.int64))
 
 
 def barrier(process_set: Optional[ProcessSet] = None):
@@ -473,12 +477,18 @@ class DistributedOptimizer:
 
     def step(self, closure=None):
         self._count += 1
-        if self._count % self._bpps == 0:
-            handled = frozenset(self._handles)
-            self.synchronize()
-            # Anything the hooks did not cover (sparse grads, params
-            # without hooks, hook-free mode) reduces fused here.
-            self._reduce_grads(exclude=handled)
+        if self._count % self._bpps != 0:
+            # Accumulation pass: gradients pile up in p.grad (do not
+            # zero_grad between passes) and NOTHING is applied — applying
+            # the raw local gradient here would diverge the ranks
+            # (reference: local gradient aggregation defers the update
+            # until the reduced Nth pass).
+            return None
+        handled = frozenset(self._handles)
+        self.synchronize()
+        # Anything the hooks did not cover (sparse grads, params
+        # without hooks, hook-free mode) reduces fused here.
+        self._reduce_grads(exclude=handled)
         return self.opt.step(closure)
 
     def zero_grad(self, *a, **kw):

@@ -19,6 +19,7 @@
 // negotiation collapses to: every rank AND/ORs, then GETCs).
 
 #include <arpa/inet.h>
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
@@ -215,6 +216,15 @@ class KVServer {
       if (status > 0 && !payload.empty() &&
           !WriteAll(fd, payload.data(), payload.size()))
         break;
+    }
+    // Deregister BEFORE close: once closed the fd number can be reused by
+    // an unrelated descriptor, and a stale entry would make Stop()'s
+    // shutdown() tear down that stranger's socket. (Also keeps conn_fds_
+    // from growing for the lifetime of a long launcher.)
+    {
+      std::lock_guard<std::mutex> g(conn_mu_);
+      conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                      conn_fds_.end());
     }
     ::close(fd);
   }

@@ -92,7 +92,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:, :1]
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = _rep(m_ref[:, :1] + jnp.log(safe_l))
+        lse_ref[0] = m_ref[:, :1] + jnp.log(safe_l)   # (bq, 1)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
@@ -112,11 +112,14 @@ def _fwd(q, k, v, causal, scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            # lse rides a (bh, S, 1) array: the (block_q, 1) block is legal
+            # tiling (minor dim equals the array dim) and 128x smaller than
+            # lane-replicating a VJP residual that lives fwd->bwd.
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, dh), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, dh), jnp.float32),
@@ -262,7 +265,7 @@ def _bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k):
 
     q_by_j = pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, j, 0))
     kv_by_i = pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, i, 0))
-    lse_by_j = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, j, 0))
+    lse_by_j = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
     in_specs = [q_by_j, kv_by_i, kv_by_i, q_by_j, q_by_j, lse_by_j]
     operands = [q, k, v, o, do, lse]
     if has_dlse:
@@ -291,7 +294,7 @@ def _bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k):
 
     q_by_i = pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0))
     kv_by_j = pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0))
-    lse_by_i = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    lse_by_i = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     in_specs = [q_by_i, kv_by_j, kv_by_j, q_by_i, q_by_i, lse_by_i]
     operands = [q, k, v, o, do, lse]
     if has_dlse:
@@ -399,7 +402,7 @@ def flash_attention_chunk(q, k, v, causal: bool = False,
                           v.reshape(B * H, Sk, dh),
                           causal, float(scale), bq, bk)
     return (o.reshape(B, H, Sq, dh),
-            lse[..., 0].reshape(B, H, Sq))
+            lse[..., 0].reshape(B, H, Sq))  # drop the unit minor dim
 
 
 def _auto_block(S: int) -> Optional[int]:

@@ -46,8 +46,22 @@ def test_flash_gradients_match_reference():
                                    err_msg=f"d{name} mismatch")
 
 
-def test_flash_uneven_seq_falls_back():
-    q, k, v = _qkv(jax.random.PRNGKey(2), S=100)  # not tileable by 128
+def test_flash_small_seq_full_block():
+    """S <= 1024 takes the kernel with block == S (always-legal tiling)."""
+    q, k, v = _qkv(jax.random.PRNGKey(2), S=100)
+    got = flash_attention(q, k, v, causal=True)
+    want = blockwise_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_untileable_seq_falls_back():
+    """S > 1024 with no 128-multiple divisor actually exercises the
+    reference fallback branch (S=1100: _auto_block returns None)."""
+    from horovod_tpu.ops.flash_attention import _auto_block, can_tile
+    assert _auto_block(1100) is None
+    assert not can_tile(1100)
+    q, k, v = _qkv(jax.random.PRNGKey(4), S=1100, B=1, H=2)
     got = flash_attention(q, k, v, causal=True)
     want = blockwise_attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),

@@ -223,3 +223,21 @@ def test_torch_optimizer_hook_with_compression(hvd):
         assert p.grad.dtype == torch.float32
         np.testing.assert_allclose(p.grad.numpy(), b.numpy(),
                                    rtol=1e-2, atol=1e-2)
+
+
+def test_torch_backward_passes_per_step_defers_apply(hvd):
+    """Accumulation passes must NOT apply raw local gradients (they would
+    diverge the ranks); the update lands only on the Nth step with the
+    reduced accumulated gradient."""
+    import horovod_tpu.frontends.torch as thvd
+    p = torch.nn.Parameter(torch.zeros(2))
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD([p], lr=1.0), backward_passes_per_step=2)
+
+    (p * 1.0).sum().backward()
+    assert opt.step() is None                 # accumulation pass: no apply
+    np.testing.assert_allclose(p.detach().numpy(), 0.0)
+
+    (p * 2.0).sum().backward()                # grads accumulate: 1 + 2
+    opt.step()
+    np.testing.assert_allclose(p.detach().numpy(), -3.0, rtol=1e-6)
