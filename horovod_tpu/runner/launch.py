@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--check-build", action="store_true",
+                   help="show available frameworks/backends and exit "
+                        "(reference: horovodrun --check-build)")
     # Elastic (reference: launch.py:689 _run_elastic)
     p.add_argument("--host-discovery-script", default=None,
                    help="elastic mode: script printing 'host:slots' lines")
@@ -272,8 +275,42 @@ def launch_static(np: int, host_spec: str, command: List[str],
     return 0
 
 
+def check_build() -> int:
+    """Reference: horovodrun --check-build (runner/launch.py:238) —
+    report what this installation can do."""
+    import importlib.util as ilu
+
+    import horovod_tpu
+    from horovod_tpu import native as native_mod
+
+    def mark(ok: bool) -> str:
+        return "[X]" if ok else "[ ]"
+
+    print(f"horovod-tpu v{horovod_tpu.__version__}:\n")
+    print("Available Frontends:")
+    print(f"    {mark(True)} JAX (native)")
+    print(f"    {mark(ilu.find_spec('torch') is not None)} PyTorch")
+    print(f"    {mark(ilu.find_spec('tensorflow') is not None)} TensorFlow")
+    print("\nAvailable Controllers:")
+    print(f"    {mark(True)} TPU coordinator (jax.distributed + "
+          "rendezvous KV)")
+    print(f"    {mark(native_mod.available())} native control plane "
+          "(TCP KV, timeline, stall inspector)")
+    print("\nAvailable Tensor Operations:")
+    print(f"    {mark(True)} XLA collectives (ICI/DCN)")
+    try:
+        import jax
+        kinds = {d.device_kind for d in jax.devices()}
+        print(f"\nDevices: {len(jax.devices())} x {', '.join(kinds)}")
+    except Exception as e:
+        print(f"\nDevices: unavailable ({e})")
+    return 0
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.check_build:
+        return check_build()
     command = list(args.command)
     if command and command[0] == "--":
         command = command[1:]

@@ -1,0 +1,50 @@
+"""Example scripts run end to end (subprocess, CPU mesh) — user-facing
+entry points must not rot (the reference smoke-runs its examples in CI,
+.buildkite/gen-pipeline.sh)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name, *args, timeout=420):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    # Only the repo on PYTHONPATH: this image's inherited path registers a
+    # remote-TPU plugin whose sitecustomize overrides JAX_PLATFORMS, which
+    # would pin the subprocess to the single real chip.
+    env["PYTHONPATH"] = REPO
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name), *args],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    assert out.returncode == 0, \
+        f"{name} failed:\nstdout:\n{out.stdout}\nstderr:\n{out.stderr}"
+    return out.stdout
+
+
+def test_mnist_example():
+    out = _run_example("mnist.py")
+    assert "loss" in out or "epoch" in out, out
+
+
+def test_torch_mnist_example():
+    pytest.importorskip("torch")
+    out = _run_example("torch_mnist.py")
+    assert "epoch 2" in out, out
+
+
+def test_tf_keras_mnist_example():
+    pytest.importorskip("tensorflow")
+    out = _run_example("tf_keras_mnist.py")
+    assert "epoch 2" in out, out
+
+
+def test_long_context_example_sharded():
+    out = _run_example("long_context.py", "--seq", "512", "--sp", "4")
+    assert "ring over sp=4" in out, out
+    assert "ulysses over sp=4" in out, out

@@ -152,3 +152,13 @@ def test_detect_tpu_pod_hosts(monkeypatch):
     assert detect_tpu_pod_hosts() == "t1k-w-0:4,t1k-w-1:4"
     monkeypatch.setenv("HOROVOD_TPU_SLOTS_PER_HOST", "8")
     assert detect_tpu_pod_hosts() == "t1k-w-0:8,t1k-w-1:8"
+
+
+def test_check_build_reports_capabilities(capsys):
+    """horovodrun --check-build parity (reference: launch.py:238)."""
+    from horovod_tpu.runner.launch import run_commandline
+    assert run_commandline(["--check-build"]) == 0
+    out = capsys.readouterr().out
+    assert "horovod-tpu v" in out
+    assert "[X] JAX (native)" in out
+    assert "XLA collectives" in out
