@@ -185,6 +185,16 @@ class ParameterManager:
         s = self._current
         if s.steps < self.steps_per_sample:
             return False
+        # Sample boundary = this design's "cycle": mark it in the timeline
+        # (reference: HOROVOD_TIMELINE_MARK_CYCLES draws background-loop
+        # cycle markers, timeline.cc; here tuning samples are the cadence).
+        try:
+            from horovod_tpu.core import topology as _topo
+            tl = _topo.raw_state().timeline
+            if tl is not None:
+                tl.mark_cycle()
+        except Exception:
+            pass
         score = s.bytes / max(s.seconds, 1e-12)  # bytes/sec (reference metric)
         if self.warmup_remaining > 0:
             self.warmup_remaining -= 1

@@ -50,3 +50,33 @@ def test_timeline_records_duration_spans(tmp_path, monkeypatch):
                    if e.get("name", "").endswith(":ar")
                    or e.get("args", {}).get("tensor") == "ar"]
     assert len(ar_compiles) <= 1
+
+
+def test_mark_cycles_at_autotune_sample_boundaries(tmp_path, monkeypatch):
+    """HOROVOD_TIMELINE_MARK_CYCLES marks the autotuner's sample
+    boundaries — this design's cycle cadence (reference: background-loop
+    cycle markers, timeline.cc)."""
+    path = str(tmp_path / "tl.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", path)
+    monkeypatch.setenv("HOROVOD_TIMELINE_MARK_CYCLES", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "2")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    try:
+        from horovod_tpu.core.topology import raw_state
+        pm = raw_state().parameter_manager
+        assert pm is not None
+        for _ in range(6):  # 3 sample boundaries at 2 steps/sample
+            pm.record(1 << 20, 0.01)
+            pm.update()
+    finally:
+        hvd.shutdown()
+
+    events = _load_events(path)
+    cycles = [e for e in events if "CYCLE_START" in str(e.get("name", ""))
+              or "CYCLE_START" in str(e.get("cat", ""))]
+    assert len(cycles) >= 2, events[:8]
