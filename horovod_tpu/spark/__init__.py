@@ -1,11 +1,15 @@
-"""Spark orchestration (thin).
+"""Spark orchestration.
 
 Reference: horovod/spark/__init__.py + spark/runner.py (448 LoC) —
 `horovod.spark.run(fn, ...)` spawns a Spark job whose tasks each run one
-worker (`_task_fn`, runner.py:49), with the driver doing rendezvous. The
-Estimator stack (spark/common/estimator.py, store.py) is out of scope for
-the thin integration — DataFrame-to-training hand-off on TPU pods goes
-through the standard array path instead of Petastorm.
+worker (`_task_fn`, runner.py:49), with the driver doing rendezvous.
+
+The Estimator stack (reference: spark/common/estimator.py, store.py,
+util.py, params.py + spark/{keras,torch,lightning}/) lives in the sibling
+modules: `store` (fsspec-backed Store), `params`, `util` (DataFrame →
+parquet + shard readers), `backend` (pluggable Spark/Local execution),
+`estimator` (JaxEstimator / TorchEstimator / models). See estimator.py's
+docstring for the TPU-first redesign notes.
 
 This module is import-gated: it only needs pyspark when actually used.
 """
@@ -84,3 +88,13 @@ def run(fn: Callable[[], Any], args=(), kwargs=None, num_proc: Optional[int] = N
     finally:
         rdv.stop()
     return collected.values()
+
+
+# Estimator stack re-exports (reference: horovod.spark.keras.KerasEstimator
+# etc. are imported from the subpackages; here one namespace).
+from horovod_tpu.spark.backend import Backend, LocalBackend, SparkBackend  # noqa: E402,F401
+from horovod_tpu.spark.estimator import (  # noqa: E402,F401
+    HorovodEstimator, HorovodModel, JaxEstimator, JaxModel,
+    TorchEstimator, TorchModel)
+from horovod_tpu.spark.store import (  # noqa: E402,F401
+    FilesystemStore, HDFSStore, LocalStore, Store)

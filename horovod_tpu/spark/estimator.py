@@ -1,0 +1,610 @@
+"""Estimator / Model (Transformer) stack.
+
+Reference: horovod/spark/common/estimator.py (HorovodEstimator /
+HorovodModel), spark/keras/estimator.py, spark/torch/estimator.py — the
+Spark-ML `est.fit(df) -> model; model.transform(df)` workflow: DataFrame
+→ parquet in a Store → distributed training job → trained transformer.
+
+TPU-first redesign:
+  * The training backend is pluggable (backend.py): Spark tasks are one
+    placement provider, `LocalBackend` (our launcher) is another — the
+    estimator works, and is tested end-to-end, with no Spark installed.
+  * The flagship estimator is `JaxEstimator` (the reference has none —
+    its frontends are keras/torch/lightning); `TorchEstimator` mirrors
+    the reference's torch estimator over our torch frontend.
+  * Petastorm readers are replaced by pyarrow shard reads (util.py).
+
+Data contract (documented in lieu of the reference's metadata-driven
+reshaping, spark/common/util.py:200+): feature columns are concatenated
+column-wise into a float32 matrix `X[batch, D]`; label columns likewise
+into `y`. Columns holding fixed-length vectors (numpy arrays / lists)
+are flattened into their slot.
+"""
+
+from __future__ import annotations
+
+import posixpath
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.spark.backend import Backend, LocalBackend, SparkBackend
+from horovod_tpu.spark.params import EstimatorParams, ModelParams
+from horovod_tpu.spark import util as sutil
+
+_CKPT_FILE = "model.pkl"
+
+
+def _stack_columns(data: Dict[str, np.ndarray],
+                   cols: List[str]) -> np.ndarray:
+    """Concat columns into a 2-D float32 matrix (vector cells flatten)."""
+    mats = []
+    for c in cols:
+        a = np.asarray(data[c])
+        if len(a) == 0:
+            # Empty shard/frame: element width of object columns is
+            # unknowable; scalar columns keep width 1, which is all the
+            # zero-row paths (init probes, empty transform) need.
+            a = np.zeros((0, 1), np.float32)
+        elif a.dtype == object:
+            a = np.stack([np.asarray(v) for v in a])
+        a = a.reshape(len(a), -1)
+        mats.append(a.astype(np.float32, copy=False))
+    return np.concatenate(mats, axis=1) if len(mats) > 1 else mats[0]
+
+
+def _labels(data: Dict[str, np.ndarray], cols: List[str]) -> np.ndarray:
+    y = _stack_columns(data, cols)
+    return y[:, 0] if y.shape[1] == 1 else y
+
+
+class HorovodEstimator(EstimatorParams):
+    """Backend-agnostic base (reference: estimator.py:25 HorovodEstimator).
+
+    Subclasses supply `_make_trainer_payload` (what ships to workers) and
+    `_make_model` (wrap the trained state as a transformer).
+    """
+
+    def fit(self, df, params: Optional[dict] = None) -> "HorovodModel":
+        if params:
+            return self.copy(params).fit(df)
+        backend = self._get_or_create_backend()
+        store = self.getStore()
+        if store is None:
+            raise ValueError("estimator requires store=Store.create(...)")
+        with sutil.prepare_data(
+                backend.num_processes(), store, df,
+                label_columns=self.getLabelCols(),
+                feature_columns=self.getFeatureCols(),
+                validation=self.getValidation(),
+                sample_weight_col=self.getSampleWeightCol(),
+                verbose=self.getVerbose()) as dataset_idx:
+            return self._fit_on_prepared_data(backend, dataset_idx)
+
+    def fit_on_parquet(self, params: Optional[dict] = None,
+                       dataset_idx: Optional[int] = None) -> "HorovodModel":
+        """Train on already-prepared parquet at the store's train path
+        (reference: estimator.py:37 fit_on_parquet)."""
+        if params:
+            return self.copy(params).fit_on_parquet(dataset_idx=dataset_idx)
+        backend = self._get_or_create_backend()
+        return self._fit_on_prepared_data(backend, dataset_idx or 0)
+
+    # -- internals --------------------------------------------------------
+    def _get_or_create_backend(self) -> Backend:
+        backend = self.getBackend()
+        if backend is not None:
+            if self.getNumProc() is not None:
+                raise ValueError(
+                    'at most one of "backend" and "num_proc" may be set')
+            return backend
+        np_ = self.getNumProc()
+        try:
+            import pyspark  # noqa: F401
+            has_spark = (pyspark.SparkContext._active_spark_context
+                         is not None)
+        except ImportError:
+            has_spark = False
+        if has_spark:
+            return SparkBackend(np_, verbose=self.getVerbose())
+        return LocalBackend(np_ or 1)
+
+    def _fit_on_prepared_data(self, backend: Backend,
+                              dataset_idx: int) -> "HorovodModel":
+        import cloudpickle
+
+        store = self.getStore()
+        run_id = self.getRunId() or f"run_{uuid.uuid4().hex[:12]}"
+        train_rows, val_rows, metadata, _ = \
+            sutil.get_simple_meta_from_parquet(store,
+                                               dataset_idx=dataset_idx)
+        payload = cloudpickle.dumps(dict(
+            kind=self._kind,
+            store=store,
+            dataset_idx=dataset_idx,
+            run_id=run_id,
+            train_rows=train_rows,
+            val_rows=val_rows,
+            trainer=self._make_trainer_payload(),
+            feature_cols=self.getFeatureCols(),
+            label_cols=self.getLabelCols(),
+            sample_weight_col=self.getSampleWeightCol(),
+            batch_size=self.getBatchSize(),
+            val_batch_size=self.getValBatchSize() or self.getBatchSize(),
+            epochs=self.getEpochs(),
+            train_steps_per_epoch=self.getTrainStepsPerEpoch(),
+            val_steps_per_epoch=self.getValidationStepsPerEpoch(),
+            shuffle=self.getShuffle(),
+            seed=self.getRandomSeed(),
+            shuffle_seed=(self.getShufflingSeed()
+                          if self.getShufflingSeed() is not None
+                          else self.getRandomSeed()),
+            callbacks=self.getCallbacks(),
+            compression=self.getCompression(),
+            predivide=self.getGradientPredivideFactor(),
+            bpps=self.getBackwardPassesPerStep(),
+            use_adasum=self.getUseAdasum(),
+            verbose=self.getVerbose(),
+        ))
+        results = backend.run(_remote_train, args=(payload,))
+        history = results[0]
+        blob = store.read(posixpath.join(
+            store.get_checkpoint_path(run_id), _CKPT_FILE))
+        state = cloudpickle.loads(blob)
+        return self._make_model(state, metadata, run_id, history)
+
+    _kind = "base"
+
+    def _make_trainer_payload(self) -> dict:
+        raise NotImplementedError()
+
+    def _make_model(self, state, metadata, run_id, history):
+        raise NotImplementedError()
+
+
+class HorovodModel(ModelParams):
+    """Trained transformer (reference: estimator.py:100 HorovodModel).
+
+    `transform(df)` appends prediction columns. pandas DataFrames are
+    handled directly; pyspark DataFrames go through mapInPandas so
+    inference runs on the executors (reference: torch/estimator.py
+    transform via udf).
+    """
+
+    def __init__(self, history: Optional[list] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.history = history or []
+
+    def _predict_batch(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError()
+
+    def _output_cols(self) -> List[str]:
+        out = self.getOutputCols()
+        if out:
+            return out
+        return [f"{c}__output" for c in self.getLabelCols()]
+
+    def _transform_pandas(self, pdf):
+        if not len(pdf):
+            out = pdf.copy()
+            for c in self._output_cols():
+                out[c] = np.zeros((0,), np.float32)
+            return out
+        bs = self.getBatchSize()
+        data = {c: pdf[c].values for c in self.getFeatureCols()}
+        X = _stack_columns(data, self.getFeatureCols())
+        preds = np.concatenate(
+            [np.asarray(self._predict_batch(X[i:i + bs]))
+             for i in range(0, len(X), bs)])
+        out = pdf.copy()
+        ocols = self._output_cols()
+        if preds.ndim == 1 or len(ocols) == 1:
+            out[ocols[0]] = list(preds) if preds.ndim > 1 else preds
+        else:
+            if preds.shape[-1] % len(ocols):
+                raise ValueError(
+                    f"model output width {preds.shape[-1]} is not "
+                    f"divisible across {len(ocols)} output columns")
+            per = preds.shape[-1] // len(ocols)
+            for j, c in enumerate(ocols):
+                cut = preds[..., j * per:(j + 1) * per]
+                out[c] = list(cut) if per > 1 else cut[..., 0]
+        return out
+
+    def _spark_output_schema(self, df, probe_pdf):
+        """Input schema + prediction columns, typed by probing a small
+        local predict (the reference derives this from stored metadata;
+        probing needs no metadata contract)."""
+        from pyspark.sql.types import (ArrayType, DoubleType, StructField,
+                                       StructType)
+
+        fields = list(df.schema.fields)
+        present = {f.name for f in fields}
+        for c in self._output_cols():
+            if c in present:
+                continue
+            cell = probe_pdf[c].iloc[0] if len(probe_pdf) else 0.0
+            dt = (ArrayType(DoubleType())
+                  if isinstance(cell, (list, np.ndarray)) else DoubleType())
+            fields.append(StructField(c, dt, True))
+        return StructType(fields)
+
+    def transform(self, df, params: Optional[dict] = None):
+        if params:
+            return self.copy(params).transform(df)
+        if sutil._is_pyspark_df(df):
+            import cloudpickle
+
+            blob = cloudpickle.dumps(self)
+            probe = self._transform_pandas(df.limit(4).toPandas())
+            schema = self._spark_output_schema(df, probe)
+
+            def mapper(it):
+                model = cloudpickle.loads(blob)
+                for pdf in it:
+                    out = model._transform_pandas(pdf)
+                    for c in model._output_cols():
+                        if out[c].dtype != object:
+                            out[c] = out[c].astype(float)
+                    yield out
+            return df.mapInPandas(mapper, schema)
+        return self._transform_pandas(df)
+
+
+# ======================================================================
+# JAX estimator (flagship)
+# ======================================================================
+
+class JaxEstimator(HorovodEstimator):
+    """Estimator over a JAX/flax model.
+
+    model: either a flax `nn.Module` (init/apply derived) or a pair
+    `(init_fn, apply_fn)` with `init_fn(rng, X_sample) -> params` and
+    `apply_fn(params, X) -> preds`.
+    optimizer: an optax GradientTransformation.
+    loss: `loss(preds, y[, sample_weight]) -> scalar` (jax-traceable).
+    """
+
+    _kind = "jax"
+
+    def _make_trainer_payload(self) -> dict:
+        model = self.getModel()
+        if model is None or self.getOptimizer() is None \
+                or self.getLoss() is None:
+            raise ValueError("JaxEstimator requires model=, optimizer=, "
+                             "loss=")
+        return dict(model=model, optimizer=self.getOptimizer(),
+                    loss=self.getLoss(), metrics=self.getMetrics())
+
+    def _make_model(self, state, metadata, run_id, history) -> "JaxModel":
+        return JaxModel(history=history, model=state,
+                        featureCols=self.getFeatureCols(),
+                        labelCols=self.getLabelCols(),
+                        runId=run_id, metadata=metadata)
+
+
+class JaxModel(HorovodModel):
+    def _predict_batch(self, X: np.ndarray) -> np.ndarray:
+        import jax
+
+        state = self.getModel()
+        if getattr(self, "_jitted", None) is None:
+            self._jitted = jax.jit(state["apply_fn"])
+        return np.asarray(self._jitted(state["params"], X))
+
+    def __getstate__(self):
+        # Compiled executables don't pickle (and shouldn't ship to
+        # executors); each process re-jits lazily.
+        d = dict(self.__dict__)
+        d.pop("_jitted", None)
+        return d
+
+
+# ======================================================================
+# Torch estimator
+# ======================================================================
+
+class TorchEstimator(HorovodEstimator):
+    """Estimator over a torch.nn.Module via the torch frontend
+    (reference: spark/torch/estimator.py TorchEstimator).
+
+    optimizer: factory `(params_iter) -> torch.optim.Optimizer`.
+    loss: `loss(preds, y) -> scalar` (torch ops).
+    """
+
+    _kind = "torch"
+
+    def _make_trainer_payload(self) -> dict:
+        if self.getModel() is None or self.getOptimizer() is None \
+                or self.getLoss() is None:
+            raise ValueError("TorchEstimator requires model=, optimizer=, "
+                             "loss=")
+        return dict(model=self.getModel(), optimizer=self.getOptimizer(),
+                    loss=self.getLoss(), metrics=self.getMetrics())
+
+    def _make_model(self, state, metadata, run_id, history) -> "TorchModel":
+        return TorchModel(history=history, model=state,
+                          featureCols=self.getFeatureCols(),
+                          labelCols=self.getLabelCols(),
+                          runId=run_id, metadata=metadata)
+
+
+class TorchModel(HorovodModel):
+    def _predict_batch(self, X: np.ndarray) -> np.ndarray:
+        import torch
+
+        model = self.getModel()
+        model.eval()
+        with torch.no_grad():
+            return model(torch.from_numpy(np.asarray(X))).numpy()
+
+
+# ======================================================================
+# Remote trainer (runs on every worker under the backend)
+# ======================================================================
+
+def _remote_train(payload: bytes):
+    import cloudpickle
+
+    spec = cloudpickle.loads(payload)
+    if spec["kind"] == "jax":
+        return _remote_train_jax(spec)
+    if spec["kind"] == "torch":
+        return _remote_train_torch(spec)
+    raise ValueError(f"unknown estimator kind {spec['kind']}")
+
+
+def _load_shards(spec, rank: int, size: int):
+    store = spec["store"]
+    cols = list(spec["feature_cols"]) + list(spec["label_cols"])
+    if spec["sample_weight_col"]:
+        cols.append(spec["sample_weight_col"])
+    train = sutil.read_shard(store, store.get_train_data_path(
+        spec["dataset_idx"]), rank, size, cols)
+    val = None
+    if spec["val_rows"]:
+        val = sutil.read_shard(store, store.get_val_data_path(
+            spec["dataset_idx"]), rank, size, cols)
+    return train, val
+
+
+def _local_batch_count(data, batch_size: int) -> int:
+    n = len(next(iter(data.values())))
+    full = n // batch_size
+    return full if full else (1 if n else 0)
+
+
+def _agree_steps(hvd_allreduce, data, batch_size: int,
+                 limit, allow_zero: bool = False) -> int:
+    """Global per-epoch step count = MIN over ranks of local batches.
+
+    Parquet shards are near-equal, not exactly equal, so ranks can hold
+    different batch counts; every step runs one collective, so all ranks
+    MUST agree on the count or the job deadlocks (the reference never hits
+    this: its Petastorm readers cycle infinitely and steps_per_epoch is
+    explicit, spark/keras/remote.py). One MIN consensus up front pins it.
+    Every rank must call this unconditionally — it is itself a collective.
+    """
+    local = _local_batch_count(data, batch_size)
+    agreed = int(np.asarray(hvd_allreduce(
+        np.asarray(local, np.int32), op="min")))
+    if limit is not None:
+        agreed = min(agreed, int(limit))
+    if agreed == 0 and not allow_zero:
+        raise ValueError(
+            "a worker received zero rows — dataset too small for "
+            "num_proc; reduce processes or grow the dataset")
+    return agreed
+
+
+def _metric_dict(metrics) -> dict:
+    if isinstance(metrics, dict):
+        return dict(metrics)
+    return {getattr(m, "__name__", f"metric_{i}"): m
+            for i, m in enumerate(metrics or [])}
+
+
+def _epoch_batches(spec, data, epoch: int, batch_size: int, steps: int):
+    it = sutil.batch_iter(data, batch_size, spec["shuffle"],
+                          spec["shuffle_seed"], epoch)
+    for i, b in enumerate(it):
+        if i >= steps:
+            break
+        yield b
+
+
+def _run_training(spec, train, val, rank, *, allreduce, train_step,
+                  eval_batch, metric_fns, on_train_epoch=None,
+                  on_eval=None) -> list:
+    """Shared epoch driver for all frontends.
+
+    Framework-specific pieces come in as hooks: `allreduce(np_arr, op)`,
+    `train_step(batch) -> loss float`, `eval_batch(batch) -> (loss,
+    {metric: value})`. Collective counts per epoch are identical on every
+    rank by construction: `steps` train collectives + 1 loss mean +
+    (if val) 1 val mean + one per metric.
+    """
+    steps = _agree_steps(allreduce, train, spec["batch_size"],
+                         spec["train_steps_per_epoch"])
+    val_steps = 0
+    if val is not None:
+        val_steps = _agree_steps(allreduce, val, spec["val_batch_size"],
+                                 spec["val_steps_per_epoch"],
+                                 allow_zero=True)
+
+    def mean_all(vals) -> float:
+        return float(np.asarray(allreduce(
+            np.float32(np.mean(vals)), op="average")))
+
+    history = []
+    for epoch in range(spec["epochs"]):
+        if on_train_epoch:
+            on_train_epoch()
+        losses = [train_step(b) for b in _epoch_batches(
+            spec, train, epoch, spec["batch_size"], steps)]
+        row = {"epoch": epoch, "loss": mean_all(losses)}
+        if val_steps:
+            if on_eval:
+                on_eval()
+            vlosses, msums = [], {k: [] for k in metric_fns}
+            for i, b in enumerate(sutil.batch_iter(
+                    val, spec["val_batch_size"], False, 0, 0)):
+                if i >= val_steps:
+                    break
+                vl, mvals = eval_batch(b)
+                vlosses.append(vl)
+                for k, v in mvals.items():
+                    msums[k].append(v)
+            row["val_loss"] = mean_all(vlosses)
+            for k in metric_fns:
+                row[f"val_{k}"] = mean_all(msums[k])
+        history.append(row)
+        if rank == 0:
+            for cb in spec.get("callbacks") or []:
+                cb(epoch, dict(row))
+            if spec["verbose"]:
+                print(f"[estimator] {row}")
+    return history
+
+
+def _save_model(spec, state: dict, history: list) -> None:
+    import cloudpickle
+
+    store = spec["store"]
+    ckpt_dir = store.get_checkpoint_path(spec["run_id"])
+    store.write(posixpath.join(ckpt_dir, _CKPT_FILE),
+                cloudpickle.dumps(state))
+    store.write_text(posixpath.join(
+        store.get_logs_path(spec["run_id"]), "history.json"),
+        __import__("json").dumps(history))
+
+
+def _remote_train_jax(spec):
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import types as T
+    from horovod_tpu.optim.optimizer import DistributedOptimizer
+    from horovod_tpu.optim.functions import broadcast_parameters
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    train, val = _load_shards(spec, rank, size)
+    fcols, lcols = spec["feature_cols"], spec["label_cols"]
+
+    t = spec["trainer"]
+    model = t["model"]
+    if isinstance(model, tuple):
+        init_fn, apply_fn = model
+    else:  # flax module
+        init_fn = lambda rng, xs: model.init(rng, xs)  # noqa: E731
+        apply_fn = model.apply
+    loss_fn = t["loss"]
+
+    # Init from a 2-row probe, not the whole shard — train/eval restack
+    # per batch, so full-shard matrices would be dead weight.
+    sample = _stack_columns({c: train[c][:2] for c in fcols}, fcols)
+    params = init_fn(jax.random.PRNGKey(spec["seed"]), sample)
+    params = broadcast_parameters(params, root_rank=0)
+
+    from horovod_tpu.ops.compression import Compression
+    comp = spec["compression"] or Compression.none
+    dist_opt = DistributedOptimizer(
+        t["optimizer"], compression=comp,
+        backward_passes_per_step=spec["bpps"],
+        op=T.ReduceOp.ADASUM if spec["use_adasum"] else T.ReduceOp.AVERAGE,
+        gradient_predivide_factor=spec["predivide"])
+    opt_state = dist_opt.init(params)
+
+    def batch_loss(p, xb, yb):
+        return loss_fn(apply_fn(p, xb), yb)
+
+    value_grad = jax.jit(jax.value_and_grad(batch_loss))
+    metric_fns = _metric_dict(t.get("metrics"))
+
+    # params/opt_state live in this mutable box so train_step can update
+    # them while keeping the hook signature uniform across frontends.
+    box = {"params": params, "opt_state": opt_state}
+
+    def train_step(b) -> float:
+        xb, yb = _stack_columns(b, fcols), _labels(b, lcols)
+        l, g = value_grad(box["params"], xb, yb)
+        box["params"], box["opt_state"] = dist_opt.step(
+            g, box["params"], box["opt_state"])
+        return float(l)
+
+    def eval_batch(b):
+        xv, yv = _stack_columns(b, fcols), _labels(b, lcols)
+        preds = apply_fn(box["params"], xv)
+        return float(loss_fn(preds, yv)), {
+            k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
+
+    history = _run_training(spec, train, val, rank,
+                            allreduce=hvd.allreduce,
+                            train_step=train_step, eval_batch=eval_batch,
+                            metric_fns=metric_fns)
+    if rank == 0:
+        _save_model(spec, {"params": jax.device_get(box["params"]),
+                           "apply_fn": apply_fn}, history)
+    hvd.barrier()
+    hvd.shutdown()
+    return history
+
+
+def _remote_train_torch(spec):
+    import torch
+
+    import horovod_tpu.frontends.torch as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    train, val = _load_shards(spec, rank, size)
+    fcols, lcols = spec["feature_cols"], spec["label_cols"]
+
+    t = spec["trainer"]
+    model = t["model"]
+    loss_fn = t["loss"]
+    metric_fns = _metric_dict(t.get("metrics"))
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = t["optimizer"](model.parameters())
+    comp = spec["compression"] or hvd.Compression.none
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters(),
+        compression=comp,
+        backward_passes_per_step=spec["bpps"],
+        op=hvd.Adasum if spec["use_adasum"] else hvd.Average,
+        gradient_predivide_factor=spec["predivide"])
+
+    def np_allreduce(arr, op):
+        return hvd.allreduce(torch.from_numpy(np.asarray(arr)),
+                             op=op).numpy()
+
+    def train_step(b) -> float:
+        xb = torch.from_numpy(_stack_columns(b, fcols))
+        yb = torch.from_numpy(np.asarray(_labels(b, lcols)))
+        opt.zero_grad()
+        loss = loss_fn(model(xb), yb)
+        loss.backward()
+        opt.step()
+        return float(loss.detach())
+
+    def eval_batch(b):
+        with torch.no_grad():
+            xv = torch.from_numpy(_stack_columns(b, fcols))
+            yv = torch.from_numpy(np.asarray(_labels(b, lcols)))
+            preds = model(xv)
+            return float(loss_fn(preds, yv)), {
+                k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
+
+    history = _run_training(spec, train, val, rank,
+                            allreduce=np_allreduce,
+                            train_step=train_step, eval_batch=eval_batch,
+                            metric_fns=metric_fns,
+                            on_train_epoch=model.train,
+                            on_eval=model.eval)
+    if rank == 0:
+        _save_model(spec, model, history)
+    hvd.barrier()
+    hvd.shutdown()
+    return history
