@@ -161,6 +161,17 @@ class Config:
             v = os.environ.get(name)
             return int(v) if v not in (None, "") else None
 
+        def _env_or_mpi(primary: str, indirect: str) -> Optional[int]:
+            # mpirun/jsrun-placed workers: when the HOROVOD_* var is
+            # absent, the MPI flavor's own rank var (named by the
+            # HOROVOD_MPI_*_ENV indirection runner/mpi_run.py exports)
+            # stands in.
+            r = opt_int(primary)
+            if r is not None:
+                return r
+            alt = os.environ.get(indirect, "")
+            return opt_int(alt) if alt else None
+
         return Config(
             fusion_threshold_bytes=_env_int(
                 HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES),
@@ -188,9 +199,10 @@ class Config:
             elastic=_env_bool(HOROVOD_ELASTIC),
             consistency_check=_env_bool(HOROVOD_CONSISTENCY_CHECK),
             dynamic_process_sets=_env_bool(HOROVOD_DYNAMIC_PROCESS_SETS),
-            rank=opt_int(HOROVOD_RANK),
+            rank=_env_or_mpi(HOROVOD_RANK, "HOROVOD_MPI_RANK_ENV"),
             size=opt_int(HOROVOD_SIZE),
-            local_rank=opt_int(HOROVOD_LOCAL_RANK),
+            local_rank=_env_or_mpi(HOROVOD_LOCAL_RANK,
+                                   "HOROVOD_MPI_LOCAL_RANK_ENV"),
             local_size=opt_int(HOROVOD_LOCAL_SIZE),
             cross_rank=opt_int(HOROVOD_CROSS_RANK),
             cross_size=opt_int(HOROVOD_CROSS_SIZE),

@@ -30,12 +30,20 @@ class RayExecutor:
     def __init__(self, num_workers: int,
                  cpus_per_worker: int = 1,
                  use_current_placement_group: bool = True,
+                 placement_group_strategy: Optional[str] = None,
                  env_vars: Optional[dict] = None):
         self.num_workers = num_workers
         self.cpus_per_worker = cpus_per_worker
+        # "PACK"/"SPREAD"/"STRICT_PACK"/"STRICT_SPREAD" creates a fresh
+        # placement group for the actors (reference: ray/runner.py
+        # colocated placement groups); None schedules loose (or inside
+        # the caller's current pg, which Ray applies by default).
+        self.placement_group_strategy = placement_group_strategy
+        self.use_current_placement_group = use_current_placement_group
         self.env_vars = dict(env_vars or {})
         self._actors: List[Any] = []
         self._rdv = None
+        self._pg = None
 
     def start(self) -> None:
         ray = _require_ray()
@@ -65,8 +73,23 @@ class RayExecutor:
                 return capture(fn, *args, **kwargs)
 
         self._worker_cls = Worker
-        self._actors = [Worker.remote(i, self.num_workers, self.env_vars)
-                        for i in range(self.num_workers)]
+        if self.placement_group_strategy:
+            self._pg = _maybe_placement_group(
+                ray, self.num_workers, self.cpus_per_worker,
+                self.placement_group_strategy)
+        self._actors = [self._make_actor(i) for i in range(self.num_workers)]
+
+    def _make_actor(self, rank: int):
+        cls = self._worker_cls
+        if self._pg is not None:
+            from ray.util.scheduling_strategies import \
+                PlacementGroupSchedulingStrategy
+
+            cls = cls.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self._pg,
+                    placement_group_bundle_index=rank))
+        return cls.remote(rank, self.num_workers, self.env_vars)
 
     def _collect(self, fn, args, kwargs):
         """Submit `fn` to every actor; gather (results, dead_ranks).
@@ -106,9 +129,26 @@ class RayExecutor:
                 ray.kill(a)
             except Exception:
                 pass
-        self._actors = [self._worker_cls.remote(i, self.num_workers,
-                                                self.env_vars)
+        old_n = self.num_workers
+        self._resize_for_restart()
+        if self._pg is not None and self.num_workers != old_n:
+            # Bundle count must match the ring: recreate the placement
+            # group at the new size (stale bundles would either reject
+            # out-of-range bundle_index on grow or strand reservations
+            # on shrink).
+            try:
+                from ray.util.placement_group import remove_placement_group
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = _maybe_placement_group(
+                ray, self.num_workers, self.cpus_per_worker,
+                self.placement_group_strategy)
+        self._actors = [self._make_actor(i)
                         for i in range(self.num_workers)]
+
+    def _resize_for_restart(self) -> None:
+        """Hook: elastic subclass recomputes num_workers from discovery."""
 
     def run(self, fn: Callable, args=(), kwargs=None) -> List[Any]:
         """Execute `fn` on every worker; per-rank results in rank order.
@@ -140,6 +180,13 @@ class RayExecutor:
         for a in self._actors:
             ray.kill(a)
         self._actors = []
+        if self._pg is not None:
+            try:
+                from ray.util.placement_group import remove_placement_group
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
         if self._rdv is not None:
             self._rdv.stop()
             self._rdv = None
@@ -151,10 +198,33 @@ class ElasticRayExecutor(RayExecutor):
     restarted from the autoscaler pool within retry limits). State recovery
     rides the same hvd.elastic.run/State machinery as the launcher path."""
 
-    def __init__(self, *args, max_restarts: int = 3, **kwargs):
+    def __init__(self, *args, max_restarts: int = 3,
+                 discovery: Optional["RayHostDiscovery"] = None,
+                 min_workers: int = 1,
+                 max_workers: Optional[int] = None, **kwargs):
         super().__init__(*args, **kwargs)
         from horovod_tpu.runner.results import RestartPolicy
         self.policy = RestartPolicy(max_restarts=max_restarts)
+        # With a discovery object the ring RESIZES on restart to what the
+        # cluster currently offers (reference: elastic_v2's autoscaler-
+        # driven host set), instead of insisting on the original size.
+        self.discovery = discovery
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = max_workers
+
+    def _resize_for_restart(self) -> None:
+        if self.discovery is None:
+            return
+        slots = sum(self.discovery.find_available_hosts_and_slots()
+                    .values())
+        if self.max_workers is not None:
+            slots = min(slots, self.max_workers)
+        if slots < self.min_workers:
+            from horovod_tpu.runner.results import RemoteJobError
+            raise RemoteJobError(
+                f"cluster offers {slots} worker slots, below "
+                f"min_workers={self.min_workers}")
+        self.num_workers = slots
 
     def run(self, fn: Callable, args=(), kwargs=None) -> List[Any]:
         from horovod_tpu.runner.results import RemoteJobError
@@ -173,3 +243,53 @@ class ElasticRayExecutor(RayExecutor):
             # dead peer); in-actor state recovers through the user's
             # hvd.elastic.State commit/restore like the launcher path.
             self._restart_ring()
+
+
+class RayHostDiscovery:
+    """Host/slot discovery from Ray's cluster state (reference:
+    ray/elastic_v2.py:40 RayHostDiscovery over ray.nodes()).
+
+    Slots per host = available CPUs // cpus_per_worker, optionally clamped
+    by GPUs or TPUs per worker. The TPU resource key is the TPU-first
+    addition: on Ray-on-GKE TPU pods each host advertises a "TPU"
+    resource, so `tpus_per_worker=4` maps one worker per chip-group.
+    Duck-typed to elastic.discovery.HostDiscovery so it drops into
+    HostManager unchanged.
+    """
+
+    def __init__(self, use_gpu: bool = False, cpus_per_worker: int = 1,
+                 gpus_per_worker: int = 1, tpus_per_worker: int = 0):
+        self.use_gpu = use_gpu
+        self.cpus_per_worker = max(1, int(cpus_per_worker))
+        self.gpus_per_worker = max(1, int(gpus_per_worker))
+        self.tpus_per_worker = int(tpus_per_worker)
+
+    def find_available_hosts_and_slots(self) -> dict:
+        ray = _require_ray()
+        mapping: dict = {}
+        for node in ray.nodes():
+            if not node.get("alive"):
+                continue
+            res = node.get("Resources", {}) or {}
+            slots = int(res.get("CPU", 0)) // self.cpus_per_worker
+            if self.use_gpu:
+                slots = min(slots,
+                            int(res.get("GPU", 0)) // self.gpus_per_worker)
+            if self.tpus_per_worker:
+                slots = min(slots,
+                            int(res.get("TPU", 0)) // self.tpus_per_worker)
+            if slots > 0:
+                mapping[node["NodeManagerAddress"]] = int(slots)
+        return mapping
+
+
+def _maybe_placement_group(ray, num_workers: int, cpus_per_worker: int,
+                           strategy: str):
+    """Create (pg, ready) for colocated scheduling (reference:
+    ray/runner.py create_placement_group usage in RayExecutor.start)."""
+    from ray.util.placement_group import placement_group
+
+    bundles = [{"CPU": cpus_per_worker} for _ in range(num_workers)]
+    pg = placement_group(bundles, strategy=strategy)
+    ray.get(pg.ready())
+    return pg

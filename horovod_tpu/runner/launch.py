@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--check-build", action="store_true",
                    help="show available frameworks/backends and exit "
                         "(reference: horovodrun --check-build)")
+    p.add_argument("--launcher", default="auto",
+                   choices=["auto", "default", "mpi", "jsrun"],
+                   help="process placer (reference: run_controller "
+                        "gloo/mpi/jsrun selection, launch.py:747). "
+                        "'auto' = built-in SSH launcher, jsrun inside an "
+                        "LSF allocation; 'mpi' forces mpirun")
     # Elastic (reference: launch.py:689 _run_elastic)
     p.add_argument("--host-discovery-script", default=None,
                    help="elastic mode: script printing 'host:slots' lines")
@@ -324,6 +330,15 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
     np = args.num_proc
     hosts = args.hosts
+    if hosts is None and np is None and _prefer_jsrun():
+        # Inside an LSF allocation with no explicit sizing: the ring is
+        # the allocation (reference: run_controller sizes jsrun jobs from
+        # LSFUtils compute hosts).
+        from horovod_tpu.runner.js_run import lsf_hosts
+        alloc = lsf_hosts()
+        if alloc:
+            np = sum(alloc.values())
+            hosts = ",".join(f"{h}:{s}" for h, s in sorted(alloc.items()))
     if hosts is None:
         detected = detect_tpu_pod_hosts()
         if detected is not None and (np is None or np <= sum(
@@ -335,8 +350,25 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             hosts = f"localhost:{np or 1}"
     if np is None:
         np = sum(h.slots for h in hosts_mod.parse_hosts(hosts))
+
+    # Placer selection (reference: run_controller, launch.py:747 — gloo
+    # vs mpi vs jsrun). The built-in SSH launcher is our gloo analog and
+    # the default; mpi/jsrun cover clusters where those are the only
+    # sanctioned placers. The data plane is XLA regardless.
+    launcher = getattr(args, "launcher", "auto")
+    if launcher == "mpi":
+        from horovod_tpu.runner.mpi_run import mpi_run
+        return mpi_run(np, hosts, command, args_to_env(args))
+    if launcher == "jsrun" or (launcher == "auto" and _prefer_jsrun()):
+        from horovod_tpu.runner.js_run import js_run
+        return js_run(np, command, args_to_env(args))
     return launch_static(np, hosts, command, args_to_env(args),
                          coordinator_ip=None)
+
+
+def _prefer_jsrun() -> bool:
+    from horovod_tpu.runner.js_run import is_lsf_env, js_available
+    return is_lsf_env() and js_available()
 
 
 def main() -> None:

@@ -1,0 +1,197 @@
+"""MPI launch backend: build and exec an `mpirun` command line.
+
+Reference: horovod/runner/mpi_run.py — flavor detection via
+`mpirun --version` (:82), flavor-specific flag sets and env passthrough
+(`-x`/`-genv`), host list and slot mapping, NIC include lists (:133-240).
+
+Role on TPU: the DATA plane never touches MPI (collectives are XLA over
+ICI/DCN); `mpirun` is purely a process PLACER — some clusters (HPC
+sites, on-prem SLURM+OpenMPI) only offer MPI as the sanctioned way to
+start one process per host slot. The launched workers bootstrap with the
+same env contract as launch_static (HOROVOD_RANK injected here via the
+MPI rank env var each flavor exports).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+OMPI = "OpenMPI"
+SMPI = "SpectrumMPI"
+MPICH = "MPICH"
+IMPI = "IntelMPI"
+UNKNOWN = "Unknown"
+MISSING = "Missing"
+
+# Per-flavor: (base flags, binding args). TCP/oob tuning flags from the
+# reference are dropped — the MPI wireup only carries the process launch,
+# not tensor traffic.
+_FLAVOR_FLAGS: Dict[str, Tuple[List[str], List[str]]] = {
+    OMPI: (["--allow-run-as-root", "--tag-output"],
+           ["--bind-to", "none", "--map-by", "slot"]),
+    SMPI: (["--tag-output"], []),
+    MPICH: ([], ["-bind-to", "none", "-map-by", "slot"]),
+    IMPI: ([], []),
+}
+
+# Env var each flavor sets with the process's global/local rank; workers
+# read them when HOROVOD_RANK/HOROVOD_LOCAL_RANK are absent (config
+# bootstrap, common/config.py _rank_from_env).
+RANK_ENV = {
+    OMPI: "OMPI_COMM_WORLD_RANK",
+    SMPI: "OMPI_COMM_WORLD_RANK",
+    MPICH: "PMI_RANK",
+    IMPI: "PMI_RANK",
+}
+LOCAL_RANK_ENV = {
+    OMPI: "OMPI_COMM_WORLD_LOCAL_RANK",
+    SMPI: "OMPI_COMM_WORLD_LOCAL_RANK",
+    MPICH: "MPI_LOCALRANKID",
+    IMPI: "MPI_LOCALRANKID",
+}
+
+
+def _exec_version(env: Optional[dict]) -> Optional[Tuple[str, int]]:
+    try:
+        res = subprocess.run(["mpirun", "--version"],
+                             capture_output=True, text=True, timeout=30,
+                             env=env)
+        return res.stdout + res.stderr, res.returncode
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+def detect_mpi_implementation(env: Optional[dict] = None,
+                              _exec=_exec_version) -> str:
+    """Reference: _get_mpi_implementation (mpi_run.py:82)."""
+    res = _exec(env)
+    if res is None:
+        return MISSING
+    output, code = res
+    if code != 0:
+        return MISSING
+    if "Open MPI" in output or "OpenRTE" in output:
+        return OMPI
+    if "IBM Spectrum MPI" in output:
+        return SMPI
+    if "Intel(R) MPI" in output:
+        return IMPI
+    if "MPICH" in output or "HYDRA" in output:
+        return MPICH
+    return UNKNOWN
+
+
+def mpi_available(env: Optional[dict] = None) -> bool:
+    return shutil.which("mpirun", path=(env or os.environ).get(
+        "PATH")) is not None
+
+
+def build_mpirun_command(num_proc: int, hosts: str, command: List[str],
+                         env: Dict[str, str],
+                         implementation: str,
+                         nics: Optional[List[str]] = None,
+                         extra_flags: Optional[List[str]] = None
+                         ) -> List[str]:
+    """Flavor-specific mpirun invocation (reference: mpi_run settings →
+    mpirun_command assembly, mpi_run.py:133-240).
+
+    `env` entries travel with `-x NAME` (OpenMPI/Spectrum: values come
+    from the launcher's exported environment) or `-genv NAME value`
+    (MPICH/Intel).
+    """
+    if implementation in (MISSING, UNKNOWN):
+        raise RuntimeError(
+            f"cannot build mpirun command: implementation is "
+            f"{implementation}")
+    base, binding = _FLAVOR_FLAGS[implementation]
+    cmd = ["mpirun"] + list(base)
+    cmd += ["-np", str(num_proc)]
+    if implementation in (OMPI, SMPI):
+        cmd += ["-H", hosts]
+        if nics:  # OpenMPI takes ONE comma-joined value per MCA key
+            cmd += ["-mca", "btl_tcp_if_include", ",".join(nics)]
+        for k in sorted(env):
+            cmd += ["-x", k]
+    else:
+        cmd += ["-hosts", ",".join(h.split(":")[0]
+                                   for h in hosts.split(","))]
+        if nics:
+            cmd += ["-iface", nics[0]]
+        for k in sorted(env):
+            cmd += ["-genv", k, env[k]]
+    cmd += binding
+    cmd += list(extra_flags or [])
+    cmd += list(command)
+    return cmd
+
+
+def mpi_run(num_proc: int, hosts: str, command: List[str],
+            env: Dict[str, str],
+            nics: Optional[List[str]] = None,
+            extra_flags: Optional[List[str]] = None,
+            _detect=None) -> int:
+    """Launch `command` on num_proc slots via mpirun; returns exit code.
+
+    The coordinator env (HOROVOD_RENDEZVOUS_*, secret, SIZE) is injected
+    exactly as launch_static does, so workers bootstrap identically
+    regardless of which placer started them.
+    """
+    impl = (_detect or detect_mpi_implementation)(None)
+    if impl in (MISSING, UNKNOWN):
+        raise RuntimeError(
+            "mpirun is not available or unrecognized; install OpenMPI/"
+            "MPICH/IntelMPI or use the default launcher")
+    worker_env = coordinator_env(num_proc, env)
+    worker_env.setdefault("HOROVOD_MPI_RANK_ENV", RANK_ENV[impl])
+    worker_env.setdefault("HOROVOD_MPI_LOCAL_RANK_ENV",
+                          LOCAL_RANK_ENV[impl])
+    rdv = worker_env.pop(_RDV_HANDLE)
+    full_env = dict(os.environ)
+    full_env.update(worker_env)
+    cmd = build_mpirun_command(num_proc, hosts, command, env=worker_env,
+                               implementation=impl, nics=nics,
+                               extra_flags=extra_flags)
+    print("mpi_run:", " ".join(shlex.quote(c) for c in cmd),
+          file=sys.stderr)
+    try:
+        return subprocess.run(cmd, env=full_env).returncode
+    finally:
+        rdv.stop()
+
+
+_RDV_HANDLE = "__rdv__"
+
+
+def coordinator_env(num_proc: int, env: Dict[str, str]) -> Dict[str, str]:
+    """Start the rendezvous KV on this (launch) host and build the worker
+    env — the same bootstrap contract launch_static injects
+    (launch.py:236-243): rendezvous address/port, controller tag, HMAC
+    secret, and HOROVOD_SIZE. Without this, workers on each host would
+    silently form isolated per-host rings.
+
+    Returns the env dict with the live RendezvousServer under the
+    _RDV_HANDLE key; the caller must pop it and stop() it after the run.
+    """
+    from horovod_tpu.common import config as C
+    from horovod_tpu.runner import secret as secret_mod
+    from horovod_tpu.runner.launch import _local_ip
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    job_secret = secret_mod.make_secret_key()
+    rdv = RendezvousServer(secret=job_secret.encode())
+    port = rdv.start()
+    out = dict(env)
+    out.update({
+        C.HOROVOD_RENDEZVOUS_ADDR: _local_ip(),
+        C.HOROVOD_RENDEZVOUS_PORT: str(port),
+        C.HOROVOD_CONTROLLER: "tpu",
+        secret_mod.SECRET_ENV: job_secret,
+        "HOROVOD_SIZE": str(num_proc),
+    })
+    out[_RDV_HANDLE] = rdv
+    return out

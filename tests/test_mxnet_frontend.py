@@ -1,0 +1,181 @@
+"""MXNet frontend tests against a stub mxnet module.
+
+Real mxnet is not installed in this image; the stub provides just the
+NDArray surface the shim touches (asnumpy/context/dtype/setitem), so the
+tests pin the numpy round-trip, dtype/context restoration, and the
+optimizer/trainer allreduce placement — the collectives underneath are
+the REAL eager engine on the 8-device mesh (reference analog:
+test/parallel/test_mxnet1/2.py run real collectives under mpirun).
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _ND:
+    """Minimal mx.nd.NDArray: numpy-backed, context + dtype aware."""
+
+    def __init__(self, arr, ctx="cpu(0)", dtype=None):
+        self._a = np.asarray(arr, dtype=dtype)
+        self.context = ctx
+
+    def asnumpy(self):
+        return self._a.copy()
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    def __setitem__(self, key, value):
+        v = value.asnumpy() if isinstance(value, _ND) else np.asarray(value)
+        if key == slice(None):
+            self._a[...] = v.reshape(self._a.shape)
+        else:
+            self._a[key] = v
+
+
+@pytest.fixture()
+def stub_mxnet(monkeypatch):
+    mod = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.nd")
+    nd.array = lambda a, ctx=None, dtype=None: _ND(a, ctx or "cpu(0)",
+                                                   dtype)
+    nd.NDArray = _ND
+    mod.nd = nd
+
+    class _Optimizer:
+        def __init__(self):
+            self.updates = []
+            self.lr = 0.1
+
+        def update(self, index, weight, grad, state):
+            self.updates.append(("update", index))
+            weight[:] = _ND(weight.asnumpy() - self.lr * grad.asnumpy())
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self.updates.append(("ump", index))
+
+        def set_learning_rate(self, lr):
+            self.lr = lr
+
+    mod.optimizer = types.ModuleType("mxnet.optimizer")
+    mod.optimizer.Optimizer = _Optimizer
+    monkeypatch.setitem(sys.modules, "mxnet", mod)
+    monkeypatch.setitem(sys.modules, "mxnet.nd", nd)
+    yield mod
+
+
+def test_mx_allreduce_roundtrip(hvd, stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    x = _ND(np.arange(6, dtype=np.float32).reshape(2, 3), ctx="gpu(2)")
+    y = mhvd.allreduce(x)  # average of identical copies == identity
+    assert isinstance(y, _ND)
+    assert y.context == "gpu(2)"
+    assert y.dtype == np.float32
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+
+
+def test_mx_allreduce_sum_scales_by_size(hvd, stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    x = _ND(np.ones((3,), np.float32))
+    y = mhvd.allreduce(x, op=mhvd.Sum)
+    np.testing.assert_allclose(y.asnumpy(), mhvd.size())
+
+
+def test_mx_broadcast_inplace_and_scalar_shape(hvd, stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    x = _ND(np.full((4,), mhvd.rank() + 3.0, np.float32))
+    mhvd.broadcast_(x, root_rank=0)
+    np.testing.assert_allclose(x.asnumpy(), 3.0)
+    s = _ND(np.float32(7.0))  # 0-d round trip keeps shape
+    out = mhvd.allreduce(s)
+    assert out.shape == ()
+
+
+def test_mx_allgather_and_barrier(hvd, stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    x = _ND(np.ones((2, 3), np.float32))
+    g = mhvd.allgather(x)
+    assert g.shape == (2 * mhvd.size(), 3)
+    mhvd.barrier()  # completes without error
+
+
+def test_mx_grouped_allreduce(hvd, stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    xs = [_ND(np.ones((2,), np.float32)),
+          _ND(np.full((3,), 2.0, np.float32))]
+    outs = mhvd.grouped_allreduce(xs, op=mhvd.Sum)
+    np.testing.assert_allclose(outs[0].asnumpy(), mhvd.size())
+    np.testing.assert_allclose(outs[1].asnumpy(), 2.0 * mhvd.size())
+
+
+def test_mx_broadcast_parameters(hvd, stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    params = {"w": _ND(np.full((2, 2), 5.0, np.float32)),
+              "b": _ND(np.zeros((2,), np.float32))}
+    mhvd.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(params["w"].asnumpy(), 5.0)
+
+
+def test_mx_distributed_optimizer_allreduces_before_update(hvd,
+                                                           stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    base = stub_mxnet.optimizer.Optimizer()
+    opt = mhvd.DistributedOptimizer(base)
+    w = _ND(np.ones((4,), np.float32))
+    g = _ND(np.full((4,), 2.0, np.float32))
+    opt.update(0, w, g, None)
+    assert base.updates == [("update", 0)]
+    # gradient was averaged in place (identical copies -> unchanged), and
+    # the base update applied: w = 1 - 0.1*2
+    np.testing.assert_allclose(w.asnumpy(), 0.8, rtol=1e-6)
+    # attribute passthrough
+    opt.set_learning_rate(0.5)
+    assert base.lr == 0.5
+
+
+def test_mx_distributed_optimizer_predivide_validation(hvd, stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    base = stub_mxnet.optimizer.Optimizer()
+    with pytest.raises(ValueError, match="predivide"):
+        mhvd.DistributedOptimizer(base, gradient_predivide_factor=2.0,
+                                  op=mhvd.Sum)
+    opt = mhvd.DistributedOptimizer(base, gradient_predivide_factor=2.0)
+    w = _ND(np.ones((2,), np.float32))
+    g = _ND(np.full((2,), 4.0, np.float32))
+    opt.update(1, w, g, None)
+    # pre/post scaling must still produce the exact mean
+    np.testing.assert_allclose(g.asnumpy(), 4.0, rtol=1e-6)
+
+
+def test_mx_grouped_update_index_list(hvd, stub_mxnet):
+    import horovod_tpu.frontends.mxnet as mhvd
+
+    class _Multi(stub_mxnet.optimizer.Optimizer):
+        def update(self, index, weight, grad, state):
+            self.updates.append(("update", tuple(index)))
+
+    base = _Multi()
+    opt = mhvd.DistributedOptimizer(base)
+    ws = [_ND(np.ones((2,), np.float32)), _ND(np.ones((3,), np.float32))]
+    gs = [_ND(np.full((2,), 2.0, np.float32)),
+          _ND(np.full((3,), 6.0, np.float32))]
+    opt.update([0, 1], ws, gs, [None, None])
+    assert base.updates == [("update", (0, 1))]
+    np.testing.assert_allclose(gs[0].asnumpy(), 2.0, rtol=1e-6)
+    np.testing.assert_allclose(gs[1].asnumpy(), 6.0, rtol=1e-6)

@@ -45,6 +45,7 @@ class _StubRayPool:
     def __init__(self):
         self.dead_ranks_this_round = {}
         self.killed = []
+        self.actor_options = []
         self.mod = types.ModuleType("ray")
         self.mod.remote = self._remote
         self.mod.get = self._get
@@ -59,6 +60,11 @@ class _StubRayPool:
                 @staticmethod
                 def remote(rank, size, env):
                     return _ActorHandle(pool, rank)
+
+                @classmethod
+                def options(cls2, **opts):
+                    pool.actor_options.append(opts)
+                    return cls2
 
             return _Remote
 
@@ -162,3 +168,123 @@ def test_elastic_gives_up_past_restart_limit(stub_ray):
         assert "exceeded 1 restarts" in str(ei.value)
     finally:
         ex.shutdown()
+
+
+# ----------------------------------------------------------------------
+# RayHostDiscovery + elastic resize + placement groups
+# ----------------------------------------------------------------------
+
+def _nodes_fixture():
+    return [
+        {"alive": True, "NodeManagerAddress": "10.0.0.1",
+         "Resources": {"CPU": 8.0, "TPU": 4.0}},
+        {"alive": True, "NodeManagerAddress": "10.0.0.2",
+         "Resources": {"CPU": 4.0, "GPU": 2.0}},
+        {"alive": False, "NodeManagerAddress": "10.0.0.3",
+         "Resources": {"CPU": 16.0}},
+    ]
+
+
+def test_ray_host_discovery_cpu_slots(stub_ray):
+    stub_ray.mod.nodes = _nodes_fixture
+    from horovod_tpu.ray import RayHostDiscovery
+
+    d = RayHostDiscovery(cpus_per_worker=2)
+    assert d.find_available_hosts_and_slots() == \
+        {"10.0.0.1": 4, "10.0.0.2": 2}
+
+
+def test_ray_host_discovery_gpu_and_tpu_clamp(stub_ray):
+    stub_ray.mod.nodes = _nodes_fixture
+    from horovod_tpu.ray import RayHostDiscovery
+
+    g = RayHostDiscovery(use_gpu=True, cpus_per_worker=1,
+                         gpus_per_worker=1)
+    # host1 has no GPU resource -> dropped; host2 clamps to 2
+    assert g.find_available_hosts_and_slots() == {"10.0.0.2": 2}
+    t = RayHostDiscovery(cpus_per_worker=1, tpus_per_worker=4)
+    assert t.find_available_hosts_and_slots() == {"10.0.0.1": 1}
+
+
+def test_elastic_resizes_ring_from_discovery(stub_ray):
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    class _ShrinkingDiscovery:
+        def find_available_hosts_and_slots(self):
+            return {"h1": 2}  # cluster shrank to 2 slots
+
+    ex = ElasticRayExecutor(3, max_restarts=2,
+                            discovery=_ShrinkingDiscovery())
+    ex.start()
+    assert len(ex._actors) == 3
+    stub_ray.dead_ranks_this_round = {2: 1}  # rank 2 dies once
+    out = ex.run(lambda rank: "ok")
+    # after the restart the ring matches discovery (2 workers)
+    assert ex.num_workers == 2
+    assert out == ["ok", "ok"]
+
+
+def test_elastic_resize_below_min_fails(stub_ray):
+    from horovod_tpu.ray import ElasticRayExecutor
+    from horovod_tpu.runner.results import RemoteJobError
+
+    class _EmptyDiscovery:
+        def find_available_hosts_and_slots(self):
+            return {}
+
+    ex = ElasticRayExecutor(2, max_restarts=5, discovery=_EmptyDiscovery(),
+                            min_workers=2)
+    ex.start()
+    stub_ray.dead_ranks_this_round = {0: 1}
+    with pytest.raises(RemoteJobError, match="below"):
+        ex.run(lambda rank: "ok")
+
+
+def test_placement_group_scheduling(stub_ray, monkeypatch):
+    """With placement_group_strategy set, actors are created through
+    .options(scheduling_strategy=...) bound to per-rank bundles."""
+    import types as _t
+
+    created = {}
+
+    class _PG:
+        def ready(self):
+            class _Ready:
+                dead = False
+                value = "pg-ready"
+            return _Ready()
+
+    def placement_group(bundles, strategy):
+        created["bundles"] = bundles
+        created["strategy"] = strategy
+        return _PG()
+
+    pg_mod = _t.ModuleType("ray.util.placement_group")
+    pg_mod.placement_group = placement_group
+    pg_mod.remove_placement_group = lambda pg: created.setdefault(
+        "removed", True)
+    sched_mod = _t.ModuleType("ray.util.scheduling_strategies")
+
+    class PlacementGroupSchedulingStrategy:
+        def __init__(self, placement_group, placement_group_bundle_index):
+            created.setdefault("bundle_indices", []).append(
+                placement_group_bundle_index)
+
+    sched_mod.PlacementGroupSchedulingStrategy = \
+        PlacementGroupSchedulingStrategy
+    util_mod = _t.ModuleType("ray.util")
+    monkeypatch.setitem(sys.modules, "ray.util", util_mod)
+    monkeypatch.setitem(sys.modules, "ray.util.placement_group", pg_mod)
+    monkeypatch.setitem(sys.modules, "ray.util.scheduling_strategies",
+                        sched_mod)
+
+    from horovod_tpu.ray import RayExecutor
+
+    ex = RayExecutor(2, cpus_per_worker=3,
+                     placement_group_strategy="STRICT_SPREAD")
+    ex.start()
+    assert created["bundles"] == [{"CPU": 3}, {"CPU": 3}]
+    assert created["strategy"] == "STRICT_SPREAD"
+    assert created["bundle_indices"] == [0, 1]
+    ex.shutdown()
+    assert created.get("removed")
