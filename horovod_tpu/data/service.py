@@ -65,8 +65,13 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+_MAX_DIGEST = 256  # hex sha256 is 64 bytes; anything bigger is hostile
+
+
 def _recv_frame(sock: socket.socket, secret: Optional[bytes]) -> Any:
     dlen = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
+    if dlen > _MAX_DIGEST:
+        raise DataServiceError(f"digest length {dlen} exceeds bound")
     digest = _recv_exact(sock, dlen) if dlen else b""
     plen = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
     if plen > _MAX_FRAME:
@@ -95,15 +100,32 @@ def _serve(handler: Callable[[Any], Any], secret: Optional[bytes],
            port: int = 0) -> Tuple[_FrameServer, int]:
     class H(socketserver.BaseRequestHandler):
         def handle(self):
-            try:
-                req = _recv_frame(self.request, secret)
-                resp = handler(req)
-            except (ConnectionError, DataServiceError, Exception) as e:
-                resp = ("error", f"{type(e).__name__}: {e}")
-            try:
-                _send_frame(self.request, resp, secret)
-            except ConnectionError:
-                pass
+            # Persistent connections: serve request/response pairs until
+            # the peer hangs up — the client's stream iterator holds one
+            # socket per worker instead of reconnecting per batch.
+            while True:
+                try:
+                    req = _recv_frame(self.request, secret)
+                except (ConnectionError, OSError):
+                    return
+                except Exception as e:
+                    # bad HMAC, unpicklable payload, oversized digest …:
+                    # reply with a diagnosable error, then drop the peer
+                    try:
+                        _send_frame(self.request,
+                                    ("error",
+                                     f"{type(e).__name__}: {e}"), secret)
+                    except (ConnectionError, OSError):
+                        pass
+                    return
+                try:
+                    resp = handler(req)
+                except Exception as e:
+                    resp = ("error", f"{type(e).__name__}: {e}")
+                try:
+                    _send_frame(self.request, resp, secret)
+                except (ConnectionError, OSError):
+                    return
 
     srv = _FrameServer(("0.0.0.0", port), H)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -193,11 +215,13 @@ class DataWorker:
 
     def __init__(self, dispatcher: Tuple[str, int],
                  secret: Optional[bytes] = None, prefetch: int = 4,
-                 poll_interval: float = 0.1):
+                 poll_interval: float = 0.1,
+                 dispatcher_timeout: float = 300.0):
         self.dispatcher = dispatcher
         self._secret = secret
         self.prefetch = prefetch
         self.poll_interval = poll_interval
+        self.dispatcher_timeout = dispatcher_timeout
         self._streams: Dict[str, "_Stream"] = {}
         self._lock = threading.Lock()
         self._srv = None
@@ -274,8 +298,25 @@ class _Stream:
         import cloudpickle
 
         w = self._worker
+        deadline = None  # armed at the FIRST failed poll, reset on success
         while not self._stop.is_set():  # wait for the dataset definition
-            st = _rpc(w.dispatcher, ("get_dataset", self.name), w._secret)
+            try:
+                st = _rpc(w.dispatcher, ("get_dataset", self.name),
+                          w._secret)
+                deadline = None
+            except (OSError, ConnectionError, DataServiceError) as e:
+                # transient dispatcher outage: keep polling, but bounded —
+                # dying silently here would leave clients blocked in
+                # next_batch with no 'end'/'error' sentinel ever queued
+                if deadline is None:
+                    deadline = time.monotonic() + w.dispatcher_timeout
+                if time.monotonic() > deadline:
+                    self.q.put(("error",
+                                f"dispatcher unreachable: {e}"))
+                    self.q.put(("end", None))
+                    return
+                time.sleep(w.poll_interval)
+                continue
             if st[0] == "ok":
                 blob, shard, num_shards = st[1]
                 break
@@ -346,20 +387,41 @@ class DataServiceClient:
             time.sleep(0.1)
 
     def stream(self, name: str, timeout: float = 60.0) -> Iterator[Any]:
-        """Yield batches from every worker's shard, round-robin fan-in."""
+        """Yield batches from every worker's shard, round-robin fan-in.
+
+        One persistent connection per worker for the whole stream — this
+        is the training hot path, so per-batch connect/teardown churn
+        (latency + ephemeral ports) is not acceptable.
+        """
         workers = self.wait_for_workers(timeout)
-        live = list(workers)
-        while live:
-            for addr in list(live):
-                st = _rpc(addr, ("next_batch", name), self._secret,
-                          timeout=timeout)
-                if st[0] == "batch":
-                    yield st[1]
-                elif st[0] == "end":
-                    live.remove(addr)
-                elif st[0] == "error":
+        conns: Dict[Tuple[str, int], socket.socket] = {}
+        try:
+            for addr in workers:
+                try:
+                    conns[addr] = socket.create_connection(
+                        addr, timeout=timeout)
+                except OSError as e:
                     raise DataServiceError(
-                        f"data worker {addr} failed: {st[1]}")
+                        f"cannot connect to data worker {addr}: {e}")
+            live = list(workers)
+            while live:
+                for addr in list(live):
+                    s = conns[addr]
+                    _send_frame(s, ("next_batch", name), self._secret)
+                    st = _recv_frame(s, self._secret)
+                    if st[0] == "batch":
+                        yield st[1]
+                    elif st[0] == "end":
+                        live.remove(addr)
+                    elif st[0] == "error":
+                        raise DataServiceError(
+                            f"data worker {addr} failed: {st[1]}")
+        finally:
+            for s in conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
 
 
 def run_worker(dispatcher_addr: str, secret: Optional[bytes] = None

@@ -44,6 +44,8 @@ class RayExecutor:
         self._actors: List[Any] = []
         self._rdv = None
         self._pg = None
+        self._pg_ours = False  # created by us (per-rank bundles) vs
+        # the caller's current placement group (no bundle pinning)
 
     def start(self) -> None:
         ray = _require_ray()
@@ -77,6 +79,15 @@ class RayExecutor:
             self._pg = _maybe_placement_group(
                 ray, self.num_workers, self.cpus_per_worker,
                 self.placement_group_strategy)
+            self._pg_ours = True
+        elif self.use_current_placement_group:
+            # Schedule inside the caller's placement group when one is
+            # active (reference: RayExecutor use_current_placement_group).
+            try:
+                from ray.util import get_current_placement_group
+                self._pg = get_current_placement_group()
+            except (ImportError, AttributeError):
+                self._pg = None
         self._actors = [self._make_actor(i) for i in range(self.num_workers)]
 
     def _make_actor(self, rank: int):
@@ -85,10 +96,12 @@ class RayExecutor:
             from ray.util.scheduling_strategies import \
                 PlacementGroupSchedulingStrategy
 
+            opts = {"placement_group": self._pg}
+            if self._pg_ours:  # our pg has one bundle per rank
+                opts["placement_group_bundle_index"] = rank
             cls = cls.options(
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
-                    placement_group=self._pg,
-                    placement_group_bundle_index=rank))
+                    **opts))
         return cls.remote(rank, self.num_workers, self.env_vars)
 
     def _collect(self, fn, args, kwargs):
@@ -131,7 +144,7 @@ class RayExecutor:
                 pass
         old_n = self.num_workers
         self._resize_for_restart()
-        if self._pg is not None and self.num_workers != old_n:
+        if self._pg_ours and self.num_workers != old_n:
             # Bundle count must match the ring: recreate the placement
             # group at the new size (stale bundles would either reject
             # out-of-range bundle_index on grow or strand reservations
@@ -180,13 +193,14 @@ class RayExecutor:
         for a in self._actors:
             ray.kill(a)
         self._actors = []
-        if self._pg is not None:
+        if self._pg_ours and self._pg is not None:
             try:
                 from ray.util.placement_group import remove_placement_group
                 remove_placement_group(self._pg)
             except Exception:
                 pass
-            self._pg = None
+        self._pg = None
+        self._pg_ours = False
         if self._rdv is not None:
             self._rdv.stop()
             self._rdv = None

@@ -41,7 +41,10 @@ def lsf_hosts(env: Optional[dict] = None) -> Dict[str, int]:
         for host, n in pairs:
             out[host] = out.get(host, 0) + int(n)
         return out
-    for host in e.get("LSB_HOSTS", "").split():
+    toks = e.get("LSB_HOSTS", "").split()
+    if len(set(toks)) > 1:
+        toks = toks[1:]  # same batch-node exclusion as the MCPU path
+    for host in toks:
         out[host] = out.get(host, 0) + 1
     return out
 

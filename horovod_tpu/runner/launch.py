@@ -359,7 +359,10 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     if launcher == "mpi":
         from horovod_tpu.runner.mpi_run import mpi_run
         return mpi_run(np, hosts, command, args_to_env(args))
-    if launcher == "jsrun" or (launcher == "auto" and _prefer_jsrun()):
+    # auto only picks jsrun when the user did NOT pin placement with -H
+    # (jsrun places by allocation and would silently ignore a host list).
+    if launcher == "jsrun" or (launcher == "auto" and args.hosts is None
+                               and _prefer_jsrun()):
         from horovod_tpu.runner.js_run import js_run
         return js_run(np, command, args_to_env(args))
     return launch_static(np, hosts, command, args_to_env(args),

@@ -16,6 +16,19 @@ def main() -> None:
     fn_path = os.environ["HOROVOD_RUN_FUNC_FILE"]
     out_dir = os.environ["HOROVOD_RUN_RESULT_DIR"]
     rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    if os.environ.get("HOROVOD_WORKER_PLATFORM") == "cpu":
+        # One CPU device per worker process (process == rank). The env
+        # var JAX_PLATFORMS alone is not enough on images whose
+        # sitecustomize pins the platform through jax.config, and a
+        # parent pytest session may leak xla_force_host_platform_device_
+        # count — scrub both BEFORE the first backend touch.
+        os.environ["XLA_FLAGS"] = " ".join(
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        os.environ.pop("HOROVOD_TPU_EMULATE_RANKS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     with open(fn_path, "rb") as f:
         fn = pickle.load(f)
     result = fn()

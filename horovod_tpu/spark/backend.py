@@ -33,8 +33,13 @@ class LocalBackend(Backend):
         self._np = num_proc
         self._env = dict(extra_env or {})
         if use_cpu:
-            # Workers share one host; pin them to distinct CPU devices
+            # Workers share one host; pin each to its own CPU device
             # rather than fighting over a single attached accelerator.
+            # HOROVOD_WORKER_PLATFORM makes task_runner switch through
+            # jax.config BEFORE backend init (env vars alone don't win
+            # against a sitecustomize-pinned platform) and scrub a parent
+            # pytest's virtual-device XLA flags.
+            self._env.setdefault("HOROVOD_WORKER_PLATFORM", "cpu")
             self._env.setdefault("JAX_PLATFORMS", "cpu")
 
     def num_processes(self) -> int:

@@ -46,8 +46,12 @@ def _stack_columns(data: Dict[str, np.ndarray],
             # Empty shard/frame: element width of object columns is
             # unknowable; scalar columns keep width 1, which is all the
             # zero-row paths (init probes, empty transform) need.
-            a = np.zeros((0, 1), np.float32)
-        elif a.dtype == object:
+            # (reshape(0, -1) cannot infer a width from zero elements,
+            # so build the 2-D form directly.)
+            mats.append(np.zeros((0, max(1, int(np.prod(a.shape[1:])))),
+                                 np.float32))
+            continue
+        if a.dtype == object:
             a = np.stack([np.asarray(v) for v in a])
         a = a.reshape(len(a), -1)
         mats.append(a.astype(np.float32, copy=False))
@@ -199,6 +203,12 @@ class HorovodModel(ModelParams):
              for i in range(0, len(X), bs)])
         out = pdf.copy()
         ocols = self._output_cols()
+        if preds.ndim > 1 and preds.shape[-1] == 1:
+            preds = preds[..., 0]  # (B,1) -> scalar column
+        if preds.ndim == 1 and len(ocols) > 1:
+            raise ValueError(
+                f"model produced 1 output per row but {len(ocols)} "
+                f"output columns were requested: {ocols}")
         if preds.ndim == 1 or len(ocols) == 1:
             out[ocols[0]] = list(preds) if preds.ndim > 1 else preds
         else:
@@ -352,6 +362,8 @@ def _remote_train(payload: bytes):
         return _remote_train_jax(spec)
     if spec["kind"] == "torch":
         return _remote_train_torch(spec)
+    if spec["kind"] == "keras":
+        return _remote_train_keras(spec)
     raise ValueError(f"unknown estimator kind {spec['kind']}")
 
 
@@ -432,6 +444,13 @@ def _run_training(spec, train, val, rank, *, allreduce, train_step,
         val_steps = _agree_steps(allreduce, val, spec["val_batch_size"],
                                  spec["val_steps_per_epoch"],
                                  allow_zero=True)
+        if val_steps == 0 and rank == 0:
+            import sys
+            print("[estimator] WARNING: validation was requested but at "
+                  "least one rank's validation shard is empty — "
+                  "val_loss/val metrics are DISABLED for this run "
+                  "(grow the validation split or reduce num_proc)",
+                  file=sys.stderr)
 
     def mean_all(vals) -> float:
         return float(np.asarray(allreduce(
@@ -605,6 +624,153 @@ def _remote_train_torch(spec):
                             on_eval=model.eval)
     if rank == 0:
         _save_model(spec, model, history)
+    hvd.barrier()
+    hvd.shutdown()
+    return history
+
+
+# ======================================================================
+# Keras (TF) estimator
+# ======================================================================
+
+class KerasEstimator(HorovodEstimator):
+    """Estimator over a compiled tf.keras model (reference:
+    spark/keras/estimator.py KerasEstimator).
+
+    model: a built (not necessarily compiled) tf.keras.Model.
+    optimizer: a tf.keras optimizer instance (serialized by config).
+    loss: a tf.keras loss instance, name string, or callable.
+
+    The model travels as architecture JSON + weights (keras' own
+    serialization — cloudpickling live TF objects is fragile), is rebuilt
+    on every worker, and trains with gradients reduced through the TF
+    frontend's allreduce — the same collective path as
+    DistributedGradientTape.
+    """
+
+    _kind = "keras"
+
+    def _make_trainer_payload(self) -> dict:
+        model = self.getModel()
+        if model is None or self.getOptimizer() is None \
+                or self.getLoss() is None:
+            raise ValueError("KerasEstimator requires model=, optimizer=, "
+                             "loss=")
+        import tensorflow as tf
+
+        return dict(model_json=model.to_json(),
+                    weights=[np.asarray(w) for w in model.get_weights()],
+                    optimizer_cfg=tf.keras.optimizers.serialize(
+                        self.getOptimizer()),
+                    loss=self.getLoss(), metrics=self.getMetrics())
+
+    def _make_model(self, state, metadata, run_id, history) -> "KerasModel":
+        return KerasModel(history=history, model=state,
+                          featureCols=self.getFeatureCols(),
+                          labelCols=self.getLabelCols(),
+                          runId=run_id, metadata=metadata)
+
+
+class KerasModel(HorovodModel):
+    """state = {"model_json": ..., "weights": [...]} — rebuilt lazily per
+    process, so the transformer itself stays picklable for mapInPandas."""
+
+    def _keras(self):
+        import tensorflow as tf
+
+        if getattr(self, "_built", None) is None:
+            st = self.getModel()
+            m = tf.keras.models.model_from_json(st["model_json"])
+            m.set_weights(st["weights"])
+            self._built = m
+        return self._built
+
+    def _predict_batch(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(self._keras()(X))
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_built", None)
+        return d
+
+
+def _remote_train_keras(spec):
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    train, val = _load_shards(spec, rank, hvd.size())
+    fcols, lcols = spec["feature_cols"], spec["label_cols"]
+
+    t = spec["trainer"]
+    model = tf.keras.models.model_from_json(t["model_json"])
+    model.set_weights(t["weights"])  # driver weights == rank-0 broadcast
+    opt = tf.keras.optimizers.deserialize(t["optimizer_cfg"])
+    loss_obj = t["loss"]
+    if isinstance(loss_obj, str):
+        loss_obj = tf.keras.losses.get(loss_obj)
+    metric_fns = _metric_dict(t.get("metrics"))
+
+    # The frontend's gradient fn handles None grads (variables off the
+    # loss path), compression, Adasum, and the predivide split — the
+    # same path DistributedGradientTape uses (tensorflow.py:166).
+    from horovod_tpu.common import types as T
+    comp = spec["compression"] or hvd.Compression.none
+    reduce_grads = hvd._make_allreduce_grads_fn(
+        T.ReduceOp.ADASUM if spec["use_adasum"] else T.ReduceOp.AVERAGE,
+        spec["predivide"], comp, None)
+    bpps = max(1, int(spec["bpps"]))
+    accum = {"grads": None, "count": 0}
+
+    def np_allreduce(arr, op):
+        return np.asarray(hvd.allreduce(
+            tf.constant(np.asarray(arr)), op=op))
+
+    def train_step(b) -> float:
+        xb = tf.constant(_stack_columns(b, fcols))
+        yb = tf.constant(np.asarray(_labels(b, lcols)))
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(loss_obj(yb, model(xb, training=True)))
+        grads = tape.gradient(loss, model.trainable_variables)
+        if bpps > 1:  # local aggregation (reference:
+            # gradient_aggregation.py LocalGradientAggregationHelper)
+            if accum["grads"] is None:
+                accum["grads"] = [None if g is None else tf.identity(g)
+                                  for g in grads]
+            else:
+                accum["grads"] = [
+                    a if g is None else (g if a is None else a + g)
+                    for a, g in zip(accum["grads"], grads)]
+            accum["count"] += 1
+            if accum["count"] < bpps:
+                return float(loss)
+            grads = [None if a is None else a / bpps
+                     for a in accum["grads"]]
+            accum["grads"], accum["count"] = None, 0
+        grads = reduce_grads(grads)
+        opt.apply_gradients(
+            (g, v) for g, v in zip(grads, model.trainable_variables)
+            if g is not None)
+        return float(loss)
+
+    def eval_batch(b):
+        xv = tf.constant(_stack_columns(b, fcols))
+        yv = tf.constant(np.asarray(_labels(b, lcols)))
+        preds = model(xv, training=False)
+        return float(tf.reduce_mean(loss_obj(yv, preds))), {
+            k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
+
+    history = _run_training(spec, train, val, rank,
+                            allreduce=np_allreduce,
+                            train_step=train_step, eval_batch=eval_batch,
+                            metric_fns=metric_fns)
+    if rank == 0:
+        _save_model(spec, {"model_json": model.to_json(),
+                           "weights": [np.asarray(w)
+                                       for w in model.get_weights()]},
+                    history)
     hvd.barrier()
     hvd.shutdown()
     return history

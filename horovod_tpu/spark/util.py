@@ -230,11 +230,12 @@ def get_simple_meta_from_parquet(store, label_columns=None,
 
 
 def _shard_files(files: List[str], rank: int, size: int) -> List[str]:
-    """Round-robin file sharding; every rank gets ≥1 file when possible."""
-    mine = [f for i, f in enumerate(files) if i % size == rank]
-    if not mine and files:
-        mine = [files[rank % len(files)]]
-    return mine
+    """Round-robin file sharding. A rank beyond the file count gets NO
+    files (an empty shard) — wrapping around would hand the same file to
+    two ranks and silently double-weight its rows in every averaged
+    gradient. The trainers' MIN-consensus step count turns the empty
+    shard into a clear 'dataset too small for num_proc' error instead."""
+    return [f for i, f in enumerate(files) if i % size == rank]
 
 
 def read_shard(store, path: str, rank: int, size: int,
@@ -252,8 +253,19 @@ def read_shard(store, path: str, rank: int, size: int,
     if not files:
         raise FileNotFoundError(f"no parquet files under {path}")
     fs = store.fs()
+    mine = _shard_files(files, rank, size)
     parts = []
-    for fname in _shard_files(files, rank, size):
+    if not mine:
+        # empty shard: zero-row table with the right schema so the
+        # trainer's step consensus can diagnose it (footer-only read —
+        # the shard file itself may be huge)
+        import pyarrow as pa
+
+        with fs.open(files[0], "rb") as f:
+            schema = pq.ParquetFile(f).schema_arrow
+        schema = pa.schema([schema.field(c) for c in columns])
+        parts.append(schema.empty_table())
+    for fname in mine:
         with fs.open(fname, "rb") as f:
             parts.append(pq.read_table(f, columns=columns))
     import pyarrow as pa

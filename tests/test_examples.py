@@ -48,3 +48,9 @@ def test_long_context_example_sharded():
     out = _run_example("long_context.py", "--seq", "512", "--sp", "4")
     assert "ring over sp=4" in out, out
     assert "ulysses over sp=4" in out, out
+
+
+def test_estimator_example():
+    out = _run_example("estimator_linreg.py", "--np", "2", "--epochs", "6")
+    assert "learned w" in out, out
+    assert "epoch 5" in out, out

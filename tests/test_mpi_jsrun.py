@@ -87,8 +87,10 @@ def test_lsf_detection_and_hosts():
     assert hosts == {"c1": 16, "c2": 16}
     # single-node allocation keeps its only host
     assert jsr.lsf_hosts(env={"LSB_MCPU_HOSTS": "c1 8"}) == {"c1": 8}
+    # LSB_HOSTS lists the batch node first: its slot is excluded even
+    # when the same host also carries compute slots
     hosts2 = jsr.lsf_hosts(env={"LSB_HOSTS": "c1 c1 c2"})
-    assert hosts2 == {"c1": 2, "c2": 1}
+    assert hosts2 == {"c1": 1, "c2": 1}
 
 
 def test_jsrun_command_shape():
@@ -186,3 +188,8 @@ def test_mpi_run_injects_rendezvous_bootstrap(monkeypatch):
     s = " ".join(seen["cmd"])
     assert f"-x {C.HOROVOD_RENDEZVOUS_ADDR}" in s
     assert f"-x {secret_mod.SECRET_ENV}" in s
+
+
+def test_lsb_hosts_fallback_excludes_batch_node():
+    hosts = jsr.lsf_hosts(env={"LSB_HOSTS": "batch1 c1 c1 c2"})
+    assert hosts == {"c1": 2, "c2": 1}
