@@ -203,6 +203,29 @@ def make_worker_cmd(slot: hosts_mod.SlotInfo, command: List[str],
         dict(os.environ)
 
 
+def _discover_coordinator_ip(remote_hosts: List[str],
+                             job_secret: str) -> str:
+    """SSH a NIC probe onto each remote host; return the launcher address
+    all of them can reach (runner/network.py)."""
+    import shlex
+    import subprocess
+
+    from horovod_tpu.runner import network as net_mod
+    from horovod_tpu.runner import secret as secret_mod
+
+    def ssh_probe(host: str, addrs: List[str], port: int):
+        inner = (f"env {secret_mod.SECRET_ENV}={shlex.quote(job_secret)} "
+                 f"{shlex.quote(sys.executable)} -m "
+                 f"horovod_tpu.runner.network "
+                 f"{shlex.quote(','.join(addrs))} {port} "
+                 f"{shlex.quote(host)}")
+        return subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no",
+                                 host, inner])
+
+    return net_mod.discover_common_address(
+        remote_hosts, ssh_probe, secret=job_secret.encode(), timeout=60)
+
+
 def launch_static(np: int, host_spec: str, command: List[str],
                   extra_env: Dict[str, str],
                   coordinator_ip: Optional[str] = None,
@@ -220,6 +243,20 @@ def launch_static(np: int, host_spec: str, command: List[str],
     rdv = RendezvousServer(secret=job_secret.encode())
     rdv_port = rdv.start()
     ip = coordinator_ip or _local_ip()
+    remote_hosts = sorted({s.hostname for s in slots
+                           if not _is_local(s.hostname)})
+    if remote_hosts and coordinator_ip is None and \
+            os.environ.get("HOROVOD_NIC_DISCOVERY", "1") == "1":
+        # Multi-NIC launch hosts publish the wrong address silently;
+        # probe which of our addresses every remote host can actually
+        # reach (reference: driver/task service NIC discovery,
+        # runner/driver/driver_service.py). Failure falls back to the
+        # default-route address with a warning rather than aborting.
+        try:
+            ip = _discover_coordinator_ip(remote_hosts, job_secret)
+        except Exception as e:
+            print(f"horovodrun-tpu: NIC discovery failed ({e}); "
+                  f"using {ip}", file=sys.stderr)
 
     # Native TCP KV server (native/src/kv_store.cc): the coordination
     # substrate for consistency checking's bitvector AND/OR agreement

@@ -1,0 +1,233 @@
+"""Host/NIC mutual discovery.
+
+Reference: horovod/runner/driver/driver_service.py +
+runner/common/service/{driver,task}_service.py + util/network.py — the
+launcher runs a driver service, every host runs a task service, and the
+two sides probe which network interfaces are mutually routable so Gloo
+binds the right NIC.
+
+TPU-first shape: the data plane needs no NIC pinning (ICI/DCN is the
+fabric), but the CONTROL plane — rendezvous KV, jax.distributed
+coordinator — must publish an address every worker can reach, and
+multi-NIC hosts (corp + data networks) get this wrong silently. So the
+subsystem is smaller than the reference's: one probe service on the
+launcher, a `probe_main` each host runs once, and an intersection
+computed from the reports.
+
+Wire format: the data service's HMAC-signed length-prefixed frames
+(data/service.py) — one trust model for every control-plane socket.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, List, Optional, Set, Tuple
+
+from horovod_tpu.data.service import _recv_frame, _send_frame, _serve
+
+
+def local_interfaces(include_loopback: bool = False
+                     ) -> Dict[str, List[str]]:
+    """nic name -> IPv4 addresses on this host (reference:
+    driver_service.py via psutil.net_if_addrs). psutil is optional at
+    install time: without it, fall back to the default-route address —
+    one candidate is enough for single-NIC hosts, which is the common
+    case the fallback serves."""
+    try:
+        import psutil
+    except ImportError:
+        from horovod_tpu.runner.launch import _local_ip
+
+        addr = _local_ip()
+        if not include_loopback and addr.startswith("127."):
+            return {}
+        return {"default": [addr]}
+
+    out: Dict[str, List[str]] = {}
+    for nic, addrs in psutil.net_if_addrs().items():
+        v4 = [a.address for a in addrs if a.family == socket.AF_INET]
+        if not include_loopback:
+            v4 = [a for a in v4 if not a.startswith("127.")]
+        if v4:
+            out[nic] = v4
+    return out
+
+
+def _reachable(addr: str, port: int, timeout: float) -> bool:
+    try:
+        with socket.create_connection((addr, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+class NicProbeService:
+    """Launcher-side collector (reference: BasicDriverService).
+
+    Workers POST their report = (hostname, local NICs, which of the
+    launcher's advertised addresses they could reach); the launcher waits
+    for all of them, then computes the common routable launcher address
+    + per-host NIC map.
+    """
+
+    def __init__(self, expected_hosts: int,
+                 secret: Optional[bytes] = None):
+        self.expected = expected_hosts
+        self._secret = secret
+        self._reports: Dict[str, dict] = {}
+        import threading
+
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._srv = None
+        self.port: Optional[int] = None
+
+    def start(self) -> int:
+        self._srv, self.port = _serve(self._handle, self._secret)
+        return self.port
+
+    def stop(self) -> None:
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+    def _handle(self, req):
+        if req[0] == "report":
+            _, hostname, nics, reachable = req
+            with self._lock:
+                self._reports[hostname] = {
+                    "nics": nics, "reachable": list(reachable)}
+                if len(self._reports) >= self.expected:
+                    self._done.set()
+            return ("ok", None)
+        if req[0] == "ping":
+            return ("ok", None)
+        return ("error", f"unknown request {req[0]!r}")
+
+    def wait(self, timeout: float = 60.0) -> Dict[str, dict]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"only {len(self._reports)}/{self.expected} hosts "
+                f"reported NIC probes")
+        with self._lock:
+            return dict(self._reports)
+
+    def common_launcher_addresses(self,
+                                  candidates: List[str]) -> List[str]:
+        """Launcher addresses every reported host could reach, in the
+        candidate order (reference: _run_probe → common intf logic)."""
+        with self._lock:
+            sets: List[Set[str]] = [set(r["reachable"])
+                                    for r in self._reports.values()]
+        common = set(candidates).intersection(*sets) if sets else \
+            set(candidates)
+        return [c for c in candidates if c in common]
+
+
+def probe_main(service_addrs: List[str], port: int,
+               hostname: Optional[str] = None,
+               secret: Optional[bytes] = None,
+               timeout: float = 5.0) -> List[str]:
+    """Worker-side probe (reference: task_service registration): test
+    each launcher address, report local NICs + the reachable subset.
+    Returns the reachable subset."""
+    reachable = [a for a in service_addrs if _reachable(a, port, timeout)]
+    if not reachable:
+        raise ConnectionError(
+            f"none of the launcher addresses {service_addrs} are "
+            f"reachable from {hostname or socket.gethostname()}")
+    with socket.create_connection((reachable[0], port),
+                                  timeout=timeout) as s:
+        _send_frame(s, ("report", hostname or socket.gethostname(),
+                        local_interfaces(), reachable), secret)
+        st = _recv_frame(s, secret)
+    if st[0] != "ok":
+        raise ConnectionError(f"probe report rejected: {st}")
+    return reachable
+
+
+def discover_common_address(hosts: List[str], ssh_probe,
+                            expected_hosts: Optional[int] = None,
+                            secret: Optional[bytes] = None,
+                            timeout: float = 60.0) -> str:
+    """Full flow: start the service, run `ssh_probe(host, addrs, port)`
+    per host (injected — tests use threads, production SSHes
+    `python -m horovod_tpu.runner.network`), wait for reports, return
+    the first launcher address every host can reach.
+
+    Reports are keyed by the launcher's OWN name for each host (the ssh
+    target), not the remote's gethostname() — containers and minimal
+    images commonly share a default hostname, which would collapse
+    distinct hosts onto one report key and hang the wait.
+
+    `ssh_probe` may return a process handle (anything with .poll() →
+    None while running, exit code after); probe failures then fail fast
+    instead of burning the whole timeout.
+    """
+    import time as _time
+
+    candidates = [a for addrs in local_interfaces().values()
+                  for a in addrs]
+    if not candidates:
+        candidates = ["127.0.0.1"]
+    svc = NicProbeService(expected_hosts or len(hosts), secret=secret)
+    port = svc.start()
+    handles: Dict[str, object] = {}
+    try:
+        for h in hosts:
+            handles[h] = ssh_probe(h, candidates, port)
+        deadline = _time.monotonic() + timeout
+        while not svc._done.wait(0.2):
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {len(svc._reports)}/{svc.expected} hosts "
+                    f"reported NIC probes")
+            with svc._lock:
+                reported = set(svc._reports)
+            failed = [h for h, p in handles.items()
+                      if h not in reported and p is not None
+                      and getattr(p, "poll", lambda: None)()
+                      not in (None, 0)]
+            pending = [h for h in hosts if h not in reported
+                       and h not in failed]
+            if failed and not pending:
+                raise ConnectionError(
+                    f"NIC probe failed on host(s) {failed} "
+                    f"(ssh or probe-port failure)")
+        common = svc.common_launcher_addresses(candidates)
+        if not common:
+            raise ConnectionError(
+                "no launcher address is reachable from every host; "
+                "check firewalls or pass --network-interface")
+        return common[0]
+    finally:
+        for p in handles.values():  # reap exited ssh children
+            try:
+                if p is not None and hasattr(p, "wait"):
+                    p.wait(timeout=0.5)
+            except Exception:
+                pass
+        svc.stop()
+
+
+def _cli() -> None:
+    """`python -m horovod_tpu.runner.network <addr,...> <port> [name]` —
+    what the launcher SSHes onto each host (reference: the task-service
+    exec line _launch_task_servers builds). `name` is the launcher's ssh
+    target for this host, used as the report key (remote gethostname()
+    is not unique across containers)."""
+    import sys
+
+    from horovod_tpu.runner import secret as secret_mod
+
+    addrs = sys.argv[1].split(",")
+    port = int(sys.argv[2])
+    name = sys.argv[3] if len(sys.argv) > 3 else None
+    got = probe_main(addrs, port, hostname=name,
+                     secret=secret_mod.secret_from_env())
+    print("reachable:", ",".join(got))
+
+
+if __name__ == "__main__":
+    _cli()

@@ -130,6 +130,7 @@ class HorovodEstimator(EstimatorParams):
             run_id=run_id,
             train_rows=train_rows,
             val_rows=val_rows,
+            metadata=metadata,
             trainer=self._make_trainer_payload(),
             feature_cols=self.getFeatureCols(),
             label_cols=self.getLabelCols(),
@@ -521,9 +522,14 @@ def _remote_train_jax(spec):
         apply_fn = model.apply
     loss_fn = t["loss"]
 
-    # Init from a 2-row probe, not the whole shard — train/eval restack
-    # per batch, so full-shard matrices would be dead weight.
-    sample = _stack_columns({c: train[c][:2] for c in fcols}, fcols)
+    # Init from a zero 2-row probe with widths taken from the dataset
+    # METADATA, not from shard rows: an empty-shard rank cannot infer a
+    # vector column's width from its rows, and a width mismatch here
+    # would turn the params broadcast below into a cryptic collective
+    # shape error (reference: util.py metadata drives input shaping).
+    width = sum(max(1, int(np.prod(spec["metadata"][c]["shape"] or [1])))
+                for c in fcols)
+    sample = np.zeros((2, width), np.float32)
     params = init_fn(jax.random.PRNGKey(spec["seed"]), sample)
     params = broadcast_parameters(params, root_rank=0)
 

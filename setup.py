@@ -15,6 +15,10 @@ setup(
     extras_require={
         "spark": ["pyspark"],
         "ray": ["ray"],
+        # estimator stack (parquet shards + fsspec stores)
+        "estimator": ["pyarrow", "fsspec", "pandas"],
+        # multi-NIC discovery (falls back to the default route without it)
+        "net": ["psutil"],
     },
     entry_points={
         "console_scripts": [
