@@ -95,6 +95,7 @@ def run(fn: Callable[[], Any], args=(), kwargs=None, num_proc: Optional[int] = N
 from horovod_tpu.spark.backend import Backend, LocalBackend, SparkBackend  # noqa: E402,F401
 from horovod_tpu.spark.estimator import (  # noqa: E402,F401
     HorovodEstimator, HorovodModel, JaxEstimator, JaxModel,
-    KerasEstimator, KerasModel, TorchEstimator, TorchModel)
+    KerasEstimator, KerasModel, LightningEstimator, TorchEstimator,
+    TorchModel)
 from horovod_tpu.spark.store import (  # noqa: E402,F401
     FilesystemStore, HDFSStore, LocalStore, Store)

@@ -365,6 +365,8 @@ def _remote_train(payload: bytes):
         return _remote_train_torch(spec)
     if spec["kind"] == "keras":
         return _remote_train_keras(spec)
+    if spec["kind"] == "lightning":
+        return _remote_train_lightning(spec)
     raise ValueError(f"unknown estimator kind {spec['kind']}")
 
 
@@ -577,6 +579,27 @@ def _remote_train_jax(spec):
     return history
 
 
+def _wrap_torch_optimizer(spec, hvd, model, opt):
+    """Shared torch/lightning plumbing: wrap the base optimizer with the
+    frontend's DistributedOptimizer honoring the estimator knobs."""
+    comp = spec["compression"] or hvd.Compression.none
+    return hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters(),
+        compression=comp,
+        backward_passes_per_step=spec["bpps"],
+        op=hvd.Adasum if spec["use_adasum"] else hvd.Average,
+        gradient_predivide_factor=spec["predivide"])
+
+
+def _torch_np_allreduce(hvd):
+    import torch
+
+    def np_allreduce(arr, op):
+        return hvd.allreduce(torch.from_numpy(np.asarray(arr)),
+                             op=op).numpy()
+    return np_allreduce
+
+
 def _remote_train_torch(spec):
     import torch
 
@@ -592,18 +615,9 @@ def _remote_train_torch(spec):
     loss_fn = t["loss"]
     metric_fns = _metric_dict(t.get("metrics"))
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
-    opt = t["optimizer"](model.parameters())
-    comp = spec["compression"] or hvd.Compression.none
-    opt = hvd.DistributedOptimizer(
-        opt, named_parameters=model.named_parameters(),
-        compression=comp,
-        backward_passes_per_step=spec["bpps"],
-        op=hvd.Adasum if spec["use_adasum"] else hvd.Average,
-        gradient_predivide_factor=spec["predivide"])
-
-    def np_allreduce(arr, op):
-        return hvd.allreduce(torch.from_numpy(np.asarray(arr)),
-                             op=op).numpy()
+    opt = _wrap_torch_optimizer(spec, hvd, model,
+                                t["optimizer"](model.parameters()))
+    np_allreduce = _torch_np_allreduce(hvd)
 
     def train_step(b) -> float:
         xb = torch.from_numpy(_stack_columns(b, fcols))
@@ -777,6 +791,154 @@ def _remote_train_keras(spec):
                            "weights": [np.asarray(w)
                                        for w in model.get_weights()]},
                     history)
+    hvd.barrier()
+    hvd.shutdown()
+    return history
+
+
+# ======================================================================
+# Lightning estimator
+# ======================================================================
+
+class LightningEstimator(HorovodEstimator):
+    """Estimator over a LightningModule-style model (reference:
+    spark/lightning/estimator.py).
+
+    The model is DUCK-TYPED to the LightningModule training protocol —
+    `training_step(batch, batch_idx) -> loss`, `configure_optimizers()
+    -> torch optimizer` (optionally `validation_step(batch, idx) ->
+    loss-like`) — so pytorch_lightning itself is not required: any
+    torch.nn.Module implementing those two methods trains. Batches
+    arrive as `(features, labels)` tensor tuples per the estimator data
+    contract. `loss`/`optimizer` params are therefore unused here; the
+    module supplies both.
+    """
+
+    _kind = "lightning"
+
+    def _make_trainer_payload(self) -> dict:
+        model = self.getModel()
+        if model is None:
+            raise ValueError("LightningEstimator requires model=")
+        for attr in ("training_step", "configure_optimizers"):
+            if not callable(getattr(model, attr, None)):
+                raise ValueError(
+                    f"model must implement {attr}() (LightningModule "
+                    f"training protocol)")
+        return dict(model=model, metrics=self.getMetrics())
+
+    def _make_model(self, state, metadata, run_id, history) -> "TorchModel":
+        return TorchModel(history=history, model=state,
+                          featureCols=self.getFeatureCols(),
+                          labelCols=self.getLabelCols(),
+                          runId=run_id, metadata=metadata)
+
+
+def _configured_optimizer(configured):
+    """Normalize configure_optimizers() return shapes (reference:
+    Lightning accepts an optimizer, [optimizers], ([opts], [scheds]),
+    or {"optimizer": ..., "lr_scheduler": ...}). One optimizer is
+    supported; multi-optimizer (GAN-style) setups are rejected loudly
+    rather than silently training only the first."""
+    if isinstance(configured, dict):
+        if "optimizer" not in configured:
+            raise ValueError("configure_optimizers() dict must contain "
+                             "an 'optimizer' key")
+        return configured["optimizer"]
+    if isinstance(configured, (tuple, list)):
+        opts = configured[0] if isinstance(configured[0], (tuple, list)) \
+            else list(configured)
+        opts = [o for o in opts
+                if hasattr(o, "param_groups")] or list(opts)
+        if len(opts) != 1:
+            raise ValueError(
+                f"multi-optimizer configure_optimizers() "
+                f"({len(opts)} optimizers) is not supported — parameters "
+                f"owned by other optimizers would silently never update")
+        return opts[0]
+    return configured
+
+
+def _remote_train_lightning(spec):
+    import torch
+
+    import horovod_tpu.frontends.torch as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    train, val = _load_shards(spec, rank, hvd.size())
+    fcols, lcols = spec["feature_cols"], spec["label_cols"]
+
+    t = spec["trainer"]
+    model = t["model"]
+    metric_fns = _metric_dict(t.get("metrics"))
+    # Metrics need predictions, i.e. a real forward override (nn.Module's
+    # inherited forward raises NotImplementedError) — fail up front, not
+    # on the first validation batch of every rank.
+    fwd_overridden = type(model).forward is not torch.nn.Module.forward
+    if metric_fns and not fwd_overridden:
+        raise ValueError(
+            "metrics require the model to override forward() so "
+            "predictions can be computed")
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = _wrap_torch_optimizer(
+        spec, hvd, model, _configured_optimizer(
+            model.configure_optimizers()))
+    np_allreduce = _torch_np_allreduce(hvd)
+
+    # batch_idx is epoch-local per the Lightning contract; the epoch
+    # hook resets it.
+    step_counter = {"i": 0}
+
+    def on_train_epoch():
+        step_counter["i"] = 0
+        model.train()
+
+    def to_batch(b):
+        return (torch.from_numpy(_stack_columns(b, fcols)),
+                torch.from_numpy(np.asarray(_labels(b, lcols))))
+
+    def train_step(b) -> float:
+        opt.zero_grad()
+        loss = model.training_step(to_batch(b), step_counter["i"])
+        if isinstance(loss, dict):  # lightning allows {"loss": ...}
+            loss = loss["loss"]
+        loss.backward()
+        opt.step()
+        step_counter["i"] += 1
+        return float(loss.detach())
+
+    has_val_step = callable(getattr(model, "validation_step", None))
+    val_counter = {"i": 0}
+
+    def on_eval():
+        val_counter["i"] = 0
+        model.eval()
+
+    def eval_batch(b):
+        with torch.no_grad():
+            xb, yb = to_batch(b)
+            idx = val_counter["i"]
+            val_counter["i"] += 1
+            if has_val_step:
+                out = model.validation_step((xb, yb), idx)
+                vl = float(out["loss"] if isinstance(out, dict) else out)
+            else:
+                vl = float(model.training_step((xb, yb), idx))
+            if not metric_fns:  # loss already forwarded the batch once
+                return vl, {}
+            preds = model(xb)
+            return vl, {k: float(fn(preds, yb))
+                        for k, fn in metric_fns.items()}
+
+    history = _run_training(spec, train, val, rank,
+                            allreduce=np_allreduce,
+                            train_step=train_step, eval_batch=eval_batch,
+                            metric_fns=metric_fns,
+                            on_train_epoch=on_train_epoch,
+                            on_eval=on_eval)
+    if rank == 0:
+        _save_model(spec, model, history)
     hvd.barrier()
     hvd.shutdown()
     return history

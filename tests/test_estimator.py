@@ -627,3 +627,74 @@ def test_local_backend_workers_form_one_ring():
 
     results = LocalBackend(2).run(lambda: probe())
     assert results == [(0, 2, 3), (1, 2, 3)]
+
+
+def test_lightning_estimator_fit(tmp_path):
+    """LightningModule protocol duck-typed on a plain torch module —
+    training_step + configure_optimizers drive the fit (reference:
+    spark/lightning/estimator.py)."""
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.spark import LightningEstimator, LocalBackend
+
+    class LinReg(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(4, 1)
+
+        def forward(self, x):
+            return self.lin(x).squeeze(-1)
+
+        def training_step(self, batch, batch_idx):
+            x, y = batch
+            return ((self(x) - y) ** 2).mean()
+
+        def validation_step(self, batch, batch_idx):
+            x, y = batch
+            return {"loss": ((self(x) - y) ** 2).mean()}
+
+        def configure_optimizers(self):
+            return torch.optim.SGD(self.parameters(), lr=0.1)
+
+    df = _toy_df()
+    est = LightningEstimator(
+        model=LinReg(),
+        featureCols=["f0", "f1", "f2", "f3"], labelCols=["label"],
+        store=LocalStore(str(tmp_path)), batchSize=16, epochs=8,
+        validation=0.25, backend=LocalBackend(2), verbose=0)
+    fitted = est.fit(df)
+    assert fitted.history[-1]["loss"] < fitted.history[0]["loss"]
+    assert "val_loss" in fitted.history[-1]
+    out = fitted.transform(df.head(6))
+    assert len(out["label__output"]) == 6
+
+
+def test_lightning_estimator_validates_protocol(tmp_path):
+    from horovod_tpu.spark import LightningEstimator, LocalBackend
+
+    est = LightningEstimator(model=object(),
+                             featureCols=["f0"], labelCols=["label"],
+                             store=LocalStore(str(tmp_path)),
+                             backend=LocalBackend(1))
+    with pytest.raises(ValueError, match="training_step"):
+        est.fit(_toy_df())
+
+
+def test_configured_optimizer_shapes():
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.spark.estimator import _configured_optimizer
+
+    lin = torch.nn.Linear(2, 1)
+    opt = torch.optim.SGD(lin.parameters(), lr=0.1)
+    sched = object()
+    assert _configured_optimizer(opt) is opt
+    assert _configured_optimizer([opt]) is opt
+    assert _configured_optimizer(([opt], [sched])) is opt
+    assert _configured_optimizer(
+        {"optimizer": opt, "lr_scheduler": sched}) is opt
+    opt2 = torch.optim.SGD(lin.parameters(), lr=0.2)
+    with pytest.raises(ValueError, match="multi-optimizer"):
+        _configured_optimizer([opt, opt2])
+    with pytest.raises(ValueError, match="'optimizer' key"):
+        _configured_optimizer({"lr_scheduler": sched})
