@@ -157,6 +157,10 @@ class ElasticDriver:
         with self._lock:
             self._round += 1
             round_id = self._round
+            # survives worker-exit pops and stop(): the final round's
+            # assignments are what post-run result mapping needs
+            # (spark/elastic.py host-keyed results)
+            self.last_round_slots = list(slots)
             self.registry.reset(len(slots))
             keep = {(s.hostname, s.local_rank): s for s in slots}
             survivors: Dict[tuple, _Worker] = {}
@@ -232,8 +236,9 @@ class ElasticDriver:
         self._host_change.set()
 
     # ------------------------------------------------------------------ run
-    def start(self) -> None:
-        self.wait_for_available_slots(self.min_num_proc)
+    def start(self, start_timeout: float = 600.0) -> None:
+        self.wait_for_available_slots(self.min_num_proc,
+                                      timeout=start_timeout)
         self._start_round()
         self._thread = threading.Thread(target=self._discover_loop,
                                         daemon=True)
@@ -287,100 +292,90 @@ class ElasticDriver:
                                            key=lambda w: w.slot.rank)]
 
 
-def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
-    """CLI entry for elastic mode (reference: launch.py:689 _run_elastic +
-    gloo_run.py:303 launch_gloo_elastic)."""
-    import os
+class RoundPublisher:
+    """Per-round jax coordinator service + assignment publication.
 
-    from horovod_tpu.common import config as C
-    from horovod_tpu.elastic.discovery import HostDiscoveryScript
-    from horovod_tpu.runner import safe_exec
-    from horovod_tpu.runner.launch import _free_port, _local_ip, \
-        make_worker_cmd
-    from horovod_tpu.runner.rendezvous import RendezvousServer
+    Shared by the CLI elastic launcher and orchestrator integrations
+    (spark/elastic.py). The jax coordination service runs in the
+    LAUNCHER, one per round — never inside rank 0 — so a worker crash
+    cannot kill the coordinator, which is what makes peer failure
+    survivable for the remaining workers (see
+    topology._elastic_distributed_init). Old services are retired two
+    rounds later, after their clients are gone.
+    """
 
-    cooldown = getattr(args, "blacklist_cooldown_range", None)
-    hm = HostManager(
-        HostDiscoveryScript(args.host_discovery_script,
-                            default_slots=args.slots_per_host or 1),
-        cooldown_range=tuple(cooldown) if cooldown else None)
-    from horovod_tpu.runner import secret as secret_mod
-    job_secret = secret_mod.make_secret_key()
-    rdv = RendezvousServer(secret=job_secret.encode())
-    rdv_port = rdv.start()
-    ip = _local_ip()
+    def __init__(self, rdv, ip: str):
+        import os
 
-    # The jax coordination service runs HERE in the launcher, one per
-    # round — never inside rank 0. A worker crash therefore cannot kill
-    # the coordinator, which is what makes peer failure survivable for the
-    # remaining workers (see topology._elastic_distributed_init). Old
-    # services are retired two rounds later, after their clients are gone.
-    services: Dict[int, object] = {}
-    round_coords: Dict[int, str] = {}
+        self.rdv = rdv
+        self.ip = ip
+        self._services: Dict[int, object] = {}
+        self.round_coords: Dict[int, str] = {}
+        self._hb = int(os.environ.get(
+            "HOROVOD_ELASTIC_HEARTBEAT_SECONDS", "10"))
+        self._sd = int(os.environ.get(
+            "HOROVOD_ELASTIC_SHUTDOWN_SECONDS", "10"))
 
-    def make_service(round_id: int, n: int) -> str:
+    def _make_service(self, round_id: int, n: int) -> str:
         from jax._src.lib import _jax as _jaxlib
+
+        from horovod_tpu.runner.launch import _free_port
+
         port = _free_port()
-        services[round_id] = _jaxlib.get_distributed_runtime_service(
-            f"[::]:{port}", n,
-            heartbeat_timeout=int(os.environ.get(
-                "HOROVOD_ELASTIC_HEARTBEAT_SECONDS", "10")),
-            shutdown_timeout=int(os.environ.get(
-                "HOROVOD_ELASTIC_SHUTDOWN_SECONDS", "10")))
-        round_coords[round_id] = f"{ip}:{port}"
-        for rid in [r for r in services if r <= round_id - 2]:
+        self._services[round_id] = _jaxlib.get_distributed_runtime_service(
+            f"[::]:{port}", n, heartbeat_timeout=self._hb,
+            shutdown_timeout=self._sd)
+        self.round_coords[round_id] = f"{self.ip}:{port}"
+        for rid in [r for r in self._services if r <= round_id - 2]:
             try:
-                services.pop(rid).shutdown()
+                self._services.pop(rid).shutdown()
             except Exception:
                 pass
-            round_coords.pop(rid, None)
-        return round_coords[round_id]
+            self.round_coords.pop(rid, None)
+        return self.round_coords[round_id]
 
-    def spawn(slot: SlotInfo, round_id: int):
-        env = dict(extra_env)
-        env.update({
-            C.HOROVOD_RENDEZVOUS_ADDR: ip,
-            C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
-            secret_mod.SECRET_ENV: job_secret,
-            C.HOROVOD_ELASTIC: "1",
-            "HOROVOD_ELASTIC_ROUND": str(round_id),
-            "HOROVOD_ELASTIC_TIMEOUT": str(args.elastic_timeout),
-            "HOROVOD_COORDINATOR_ADDR": round_coords[round_id],
-        })
-        cmd, full_env = make_worker_cmd(slot, command, env)
-        return safe_exec.WorkerProcess(slot.rank, cmd, full_env)
-
-    def publish(slots: List[SlotInfo], round_id: int) -> None:
+    def publish(self, slots: List[SlotInfo], round_id: int) -> None:
         # Service first (workers connect to it), then assignments, round
         # bump LAST: a worker that observes the bump must already be able
         # to read its assignment — with the round's coordinator address —
         # or conclude it was removed. See elastic/worker.py.
         import dataclasses as _dc
         import json as _json
-        coord = make_service(round_id, len(slots))
+
+        coord = self._make_service(round_id, len(slots))
         for s in slots:
             record = _dc.asdict(s)
             record["coord"] = coord
-            rdv.put("elastic",
-                    f"assign/{round_id}/{s.hostname}/{s.local_rank}",
-                    _json.dumps(record).encode())
-        rdv.put("elastic", "round", str(round_id).encode())
+            self.rdv.put("elastic",
+                         f"assign/{round_id}/{s.hostname}/{s.local_rank}",
+                         _json.dumps(record).encode())
+        self.rdv.put("elastic", "round", str(round_id).encode())
 
-    driver = ElasticDriver(
-        hm, spawn, lambda h: h.terminate(),
-        min_num_proc=args.min_num_proc or 1,
-        max_num_proc=args.max_num_proc,
-        reset_limit=args.reset_limit,
-        publish_fn=publish)
-    driver.start()
+    def close(self) -> None:
+        for svc in self._services.values():
+            try:
+                svc.shutdown()
+            except Exception:
+                pass
+        self._services.clear()
+
+
+def drive_elastic_loop(driver: "ElasticDriver", elastic_timeout: float,
+                       failed_round_limit: Optional[int] = None) -> int:
+    """The elastic main loop: poll workers, reap exits, detect job
+    success/death. Shared by CLI and orchestrator entries; the driver's
+    spawn/stop fns carry all placement specifics."""
+    import os
+
+    if failed_round_limit is None:
+        # Stop once this many consecutive rounds ended with every worker
+        # failing — a deterministic user-code failure, not a host event
+        # (reference analog: registration.py:150-165 fails the job when
+        # the last worker exits and none succeeded; we allow a couple of
+        # retries to survive whole-pod preemptions).
+        failed_round_limit = int(
+            os.environ.get("HOROVOD_ELASTIC_FAILED_ROUND_LIMIT", "3"))
     idle_since = None
-    # Stop once this many consecutive rounds ended with every worker
-    # failing — a deterministic user-code failure, not a host event
-    # (reference analog: registration.py:150-165 fails the job when the
-    # last worker exits and none succeeded; we allow a couple of retries
-    # to survive whole-pod preemptions).
-    failed_round_limit = int(
-        os.environ.get("HOROVOD_ELASTIC_FAILED_ROUND_LIMIT", "3"))
     try:
         while True:
             driver.maybe_reset()
@@ -396,8 +391,8 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
                 driver.handle_worker_exit(r, c, host_failure=(c != 0))
             if driver.consecutive_failed_rounds >= failed_round_limit:
                 print(f"elastic: {driver.consecutive_failed_rounds} "
-                      "consecutive rounds failed on every worker; giving up",
-                      file=sys.stderr)
+                      "consecutive rounds failed on every worker; "
+                      "giving up", file=sys.stderr)
                 return 1
             if workers and all(c == 0 for c in done.values()
                                if c is not None) \
@@ -411,7 +406,7 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
                     return 1
                 if idle_since is None:
                     idle_since = time.monotonic()
-                elif time.monotonic() - idle_since > args.elastic_timeout:
+                elif time.monotonic() - idle_since > elastic_timeout:
                     print("elastic: timed out waiting for hosts",
                           file=sys.stderr)
                     return 1
@@ -420,4 +415,52 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
             time.sleep(0.5)
     finally:
         driver.stop()
+
+
+def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
+    """CLI entry for elastic mode (reference: launch.py:689 _run_elastic +
+    gloo_run.py:303 launch_gloo_elastic)."""
+    from horovod_tpu.common import config as C
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.runner import safe_exec
+    from horovod_tpu.runner.launch import _local_ip, make_worker_cmd
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    cooldown = getattr(args, "blacklist_cooldown_range", None)
+    hm = HostManager(
+        HostDiscoveryScript(args.host_discovery_script,
+                            default_slots=args.slots_per_host or 1),
+        cooldown_range=tuple(cooldown) if cooldown else None)
+    from horovod_tpu.runner import secret as secret_mod
+    job_secret = secret_mod.make_secret_key()
+    rdv = RendezvousServer(secret=job_secret.encode())
+    rdv_port = rdv.start()
+    ip = _local_ip()
+    publisher = RoundPublisher(rdv, ip)
+
+    def spawn(slot: SlotInfo, round_id: int):
+        env = dict(extra_env)
+        env.update({
+            C.HOROVOD_RENDEZVOUS_ADDR: ip,
+            C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
+            secret_mod.SECRET_ENV: job_secret,
+            C.HOROVOD_ELASTIC: "1",
+            "HOROVOD_ELASTIC_ROUND": str(round_id),
+            "HOROVOD_ELASTIC_TIMEOUT": str(args.elastic_timeout),
+            "HOROVOD_COORDINATOR_ADDR": publisher.round_coords[round_id],
+        })
+        cmd, full_env = make_worker_cmd(slot, command, env)
+        return safe_exec.WorkerProcess(slot.rank, cmd, full_env)
+
+    driver = ElasticDriver(
+        hm, spawn, lambda h: h.terminate(),
+        min_num_proc=args.min_num_proc or 1,
+        max_num_proc=args.max_num_proc,
+        reset_limit=args.reset_limit,
+        publish_fn=publisher.publish)
+    driver.start()
+    try:
+        return drive_elastic_loop(driver, args.elastic_timeout)
+    finally:
+        publisher.close()
         rdv.stop()

@@ -140,6 +140,14 @@ class KVClient:
     def put(self, scope: str, key: str, value: bytes) -> None:
         self._request("PUT", f"/{scope}/{key}", value).read()
 
+    def delete(self, scope: str, key: str) -> None:
+        import urllib.error
+        try:
+            self._request("DELETE", f"/{scope}/{key}", None)
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
     def get(self, scope: str, key: str,
             timeout: float = 30.0) -> Optional[bytes]:
         import time

@@ -99,3 +99,4 @@ from horovod_tpu.spark.estimator import (  # noqa: E402,F401
     TorchModel)
 from horovod_tpu.spark.store import (  # noqa: E402,F401
     FilesystemStore, HDFSStore, LocalStore, Store)
+from horovod_tpu.spark.elastic import run_elastic  # noqa: E402,F401
