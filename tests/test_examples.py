@@ -54,3 +54,16 @@ def test_estimator_example():
     out = _run_example("estimator_linreg.py", "--np", "2", "--epochs", "6")
     assert "learned w" in out, out
     assert "epoch 5" in out, out
+
+
+def test_data_service_example():
+    out = _run_example("data_service_train.py", "--workers", "2",
+                       "--steps", "60")
+    assert "service-fed batches" in out, out
+    # the demo must actually LEARN: w_true = [1, -2, 0.5, 3]
+    import re
+    m = re.search(r"learned w: \[([^\]]+)\]", out)
+    assert m, out
+    w = [float(v) for v in m.group(1).split(",")]
+    import numpy as _np
+    assert _np.allclose(w, [1.0, -2.0, 0.5, 3.0], atol=0.35), (w, out)
