@@ -237,6 +237,78 @@ def bench_transformer(on_cpu, steps, warmup):
 # --------------------------------------------------------------------------
 # Fusion-threshold sweep on the eager grouped-allreduce path
 # --------------------------------------------------------------------------
+# BERT-base fine-tune shape through the EAGER DistributedOptimizer with
+# Adasum + gradient predivide (BASELINE.md tracked config; reference:
+# examples/pytorch synthetic benchmark with --use-adasum +
+# gradient_predivide_factor). Unlike the SPMD LM bench, every step's
+# gradients leave the jit and ride the eager fused-collective engine —
+# this is the hvd.DistributedOptimizer migration path's cost.
+# --------------------------------------------------------------------------
+
+def bench_bert_adasum(on_cpu, steps=10, warmup=3):
+    from horovod_tpu.common import types as T
+    from horovod_tpu.optim.optimizer import DistributedOptimizer
+
+    if on_cpu:
+        cfg = tfm.TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                    d_ff=256, n_layers=2, max_seq=64,
+                                    attn="local")
+        batch, seq, steps, warmup = 2, 32, 2, 1
+    else:
+        # BERT-base shape: L12 D768 H12 F3072, fine-tune seq 128
+        cfg = tfm.TransformerConfig(vocab=30522, d_model=768, n_heads=12,
+                                    d_ff=3072, n_layers=12, max_seq=128,
+                                    attn="local", dtype=jnp.bfloat16)
+        batch, seq = 32, 128
+    mesh = build_mesh(MeshSpec(), devices=jax.devices()[:1])
+    params = tfm.shard_params(tfm.init(jax.random.PRNGKey(0), cfg), cfg,
+                              mesh)
+    dist_opt = DistributedOptimizer(
+        optax.adam(2e-5), op=T.ReduceOp.ADASUM)
+    # reference BERT runs also exercise predivide; Adasum forbids it
+    # (Average-only), so predivide is measured on a second optimizer
+    pre_opt = DistributedOptimizer(
+        optax.adam(2e-5), op=T.ReduceOp.AVERAGE,
+        gradient_predivide_factor=2.0)
+    fwd = tfm.build_forward(cfg, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
+                                0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def loss_fn(p):
+        logits = fwd(p, tokens)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(
+            logp, targets[..., None], axis=-1))
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    def one(opt, state):
+        l, g = grad_fn(params)
+        return opt.step(g, params, state)[1], l
+
+    out = {}
+    for name, opt in (("adasum", dist_opt), ("predivide", pre_opt)):
+        state = opt.init(params)
+        for _ in range(warmup):
+            state, l = one(opt, state)
+        # block on the optimizer STATE, not just the loss — the
+        # allreduce+update chain is what this bench measures and the
+        # loss does not depend on it
+        jax.block_until_ready(state)
+        float(np.asarray(jax.tree_util.tree_leaves(state)[0]).ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, l = one(opt, state)
+        jax.block_until_ready(state)
+        float(np.asarray(jax.tree_util.tree_leaves(state)[0]).ravel()[0])
+        dt = (time.perf_counter() - t0) / steps
+        out[f"{name}_samples_per_sec"] = round(batch / dt, 2)
+        out[f"{name}_step_ms"] = round(dt * 1e3, 2)
+    out["config"] = f"L{cfg.n_layers} D{cfg.d_model} H{cfg.n_heads} " \
+                    f"S{seq} B{batch} (BERT-base shape)"
+    return out
+
 
 def bench_fusion_sweep(on_cpu):
     """Grouped allreduce of a ResNet-50-like gradient set at several fusion
@@ -251,6 +323,11 @@ def bench_fusion_sweep(on_cpu):
     out = {}
     cfg = topology.raw_state().config
     orig = cfg.fusion_threshold_bytes
+    # measure the REAL fused-collective machinery, not the
+    # replicated-input closed form the engine would otherwise take in
+    # single-controller mode (restore any user-set value afterwards)
+    prior_fast_env = os.environ.get("HOROVOD_NO_REPLICATED_FAST")
+    os.environ["HOROVOD_NO_REPLICATED_FAST"] = "1"
     try:
         for mb in (1, 16, 64):
             cfg.fusion_threshold_bytes = mb * 1024 * 1024
@@ -266,6 +343,10 @@ def bench_fusion_sweep(on_cpu):
             out[f"{mb}MB_ms"] = round((time.perf_counter() - t0) / 5 * 1e3, 2)
     finally:
         cfg.fusion_threshold_bytes = orig
+        if prior_fast_env is None:
+            os.environ.pop("HOROVOD_NO_REPLICATED_FAST", None)
+        else:
+            os.environ["HOROVOD_NO_REPLICATED_FAST"] = prior_fast_env
     return out
 
 
@@ -359,6 +440,7 @@ def main():
             tr["tokens_per_sec_per_chip"] * tr["model_flops_per_token"]
             / peak, 4)
 
+    bert = bench_bert_adasum(on_cpu)
     fusion = bench_fusion_sweep(on_cpu)
     autotune = bench_autotune(on_cpu)
     flash = None if on_cpu else bench_flash_attention()
@@ -375,6 +457,7 @@ def main():
             "num_chips": k,
             "resnet50": best,
             "transformer_lm": tr,
+            "bert_base_finetune": bert,
             "fusion_sweep_grouped_allreduce": fusion,
             "autotune": autotune,
             "flash_attention_s8192": flash,

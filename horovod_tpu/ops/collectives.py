@@ -281,6 +281,58 @@ def _apply_reduce(block: jax.Array, op: T.ReduceOp, k: int,
     return y
 
 
+def _replicated_reduce_one(x: jax.Array, op: T.ReduceOp, k: int,
+                           prescale: float, postscale: float) -> jax.Array:
+    """_apply_reduce's algebra when all k contributions are IDENTICAL.
+
+    Single-controller mode with a non-stacked input means every emulated
+    rank contributes the same tensor, so the collective has a closed
+    form: sum = k·x, average/min/max = x, product = x^k. Computing it
+    directly skips the per-tensor lift (broadcast + device_put — two
+    dispatches EACH, which dominates eager-optimizer steps on
+    remote/tunneled devices) and the fused psum program entirely.
+    Semantics match _apply_reduce exactly, including integer-average
+    flooring and pre/post scaling order.
+    """
+    if prescale != 1.0:
+        x = x * jnp.asarray(prescale, x.dtype)
+    if op == T.ReduceOp.SUM:
+        y = x * jnp.asarray(k, x.dtype)
+    elif op == T.ReduceOp.AVERAGE:
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            y = (x * jnp.asarray(k, x.dtype)) // jnp.asarray(k, x.dtype)
+        else:
+            y = x
+    elif op in (T.ReduceOp.MIN, T.ReduceOp.MAX):
+        y = x
+    elif op == T.ReduceOp.PRODUCT:
+        y = x ** k
+    else:  # pragma: no cover - callers gate ADASUM out
+        raise HorovodTpuError(f"unsupported replicated reduce {op}")
+    if postscale != 1.0:
+        y = y * jnp.asarray(postscale, y.dtype)
+    return y
+
+
+def _replicated_fast_ok(ps: ProcessSet, rop: T.ReduceOp, hm,
+                        tensors) -> bool:
+    """Eligibility for the identical-contributions closed form: one
+    process (multi-process inputs genuinely differ per rank), no
+    hierarchical mesh, not Adasum, and no stacked per-slot inputs.
+    HOROVOD_NO_REPLICATED_FAST=1 forces the full collective machinery
+    (used by benchmarks that measure it)."""
+    from horovod_tpu.common.config import _env_bool
+
+    if _env_bool("HOROVOD_NO_REPLICATED_FAST"):
+        return False
+    if jax.process_count() != 1 or hm is not None:
+        return False
+    if rop == T.ReduceOp.ADASUM:
+        return False
+    L = _local_member_count(ps)
+    return not any(_is_stacked(t, ps, L) for t in tensors)
+
+
 def _builder_allreduce(mesh: Mesh, k: int, op: T.ReduceOp,
                        prescale: float, postscale: float,
                        num_tensors: int, donate: bool) -> Callable:
@@ -382,11 +434,31 @@ def allreduce(tensor: Any,
     cfg = topology.state().config
     rop = _normalize_op(average, op)
     donate = donate or cfg.donate_buffers
-    g, stacked = _to_global(tensor, ps)
     k = ps.size()
     hm = _hier_usable(ps) if (cfg.hierarchical_allreduce
                               and rop in (T.ReduceOp.SUM,
                                           T.ReduceOp.AVERAGE)) else None
+    if _replicated_fast_ok(ps, rop, hm, (tensor,)):
+        shape = tuple(np.shape(tensor))
+        dtype = np.result_type(tensor) if not hasattr(tensor, "dtype") \
+            else tensor.dtype
+        T.check_supported_dtype(np.dtype(dtype))
+        key = ("ar_rep", shape, str(dtype), int(rop), ps.cache_token,
+               float(prescale_factor), float(postscale_factor), k)
+        # Output committed to the set's first mesh device — the same
+        # placement _from_global's shard view gives on the full path
+        # (subset process sets may exclude the default device).
+        out_sh = jax.sharding.SingleDeviceSharding(
+            ps.mesh.devices.flat[0])
+        fn = _cache.get_or_build(key, lambda: jax.jit(
+            lambda x: _replicated_reduce_one(
+                x, rop, k, prescale_factor, postscale_factor),
+            out_shardings=out_sh))
+        _consistency(f"allreduce(shape={(k,) + shape},dtype={dtype},"
+                     f"op={int(rop)},ps={ps.process_set_id})", ps)
+        with _timeline_span(name or "allreduce", "ALLREDUCE"):
+            return _execute(fn, jnp.asarray(tensor))
+    g, stacked = _to_global(tensor, ps)
     key = ("ar", g.shape, str(g.dtype), int(rop), ps.cache_token,
            float(prescale_factor), float(postscale_factor), bool(donate),
            hm is not None,
@@ -420,12 +492,37 @@ def grouped_allreduce(tensors: Sequence[Any],
     rop = _normalize_op(average, op)
     if not tensors:
         return []
-    gs, stackeds = zip(*[_to_global(t, ps) for t in tensors])
     k = ps.size()
     cfg = topology.state().config
     hm = _hier_usable(ps) if (cfg.hierarchical_allreduce
                               and rop in (T.ReduceOp.SUM,
                                           T.ReduceOp.AVERAGE)) else None
+    if _replicated_fast_ok(ps, rop, hm, tensors):
+        shapes = tuple(tuple(np.shape(t)) for t in tensors)
+        dtypes = tuple(str(getattr(t, "dtype", np.result_type(t)))
+                       for t in tensors)
+        for d in dtypes:  # same gate _to_global applies on the full path
+            T.check_supported_dtype(np.dtype(d))
+        key = ("gar_rep", shapes, dtypes, int(rop), ps.cache_token,
+               float(prescale_factor), float(postscale_factor), k)
+        out_sh = jax.sharding.SingleDeviceSharding(
+            ps.mesh.devices.flat[0])
+
+        def build_fast() -> Callable:
+            def body(*xs):
+                return tuple(_replicated_reduce_one(
+                    x, rop, k, prescale_factor, postscale_factor)
+                    for x in xs)
+            return jax.jit(body, out_shardings=out_sh)
+
+        fn = _cache.get_or_build(key, build_fast)
+        _consistency(f"grouped_allreduce(n={len(tensors)},shapes="
+                     f"{[(k,) + s for s in shapes]},op={int(rop)},"
+                     f"ps={ps.process_set_id})", ps)
+        with _timeline_span(name or "grouped_allreduce", "ALLREDUCE"):
+            outs = _execute(fn, *[jnp.asarray(t) for t in tensors])
+        return list(outs)
+    gs, stackeds = zip(*[_to_global(t, ps) for t in tensors])
     key = ("gar", tuple((g.shape, str(g.dtype)) for g in gs), int(rop),
            ps.cache_token, float(prescale_factor), float(postscale_factor),
            cfg.fusion_threshold_bytes, cfg.disable_group_fusion,

@@ -275,3 +275,33 @@ def test_grouped_reducescatter_fused(hvd):
                                        rtol=1e-6)
     from horovod_tpu.ops.collectives import _cache
     assert any(key[0] == "grs" for key in _cache._cache)
+
+
+def test_replicated_fast_path_matches_full_machinery(hvd, monkeypatch):
+    """Single-controller non-stacked inputs take the closed-form fast
+    path; its numerics must match the full fused-psum machinery
+    (HOROVOD_NO_REPLICATED_FAST=1) bit-for-bit across ops and scaling."""
+    import os
+
+    import numpy as np
+
+    xs = [np.arange(6, dtype=np.float32).reshape(2, 3) + 1,
+          np.full((4,), 3, np.int32),
+          np.float32(2.5)]
+    cases = [dict(op="sum"), dict(op="average"),
+             dict(op="min"), dict(op="max"), dict(op="product"),
+             dict(op="average", prescale_factor=0.5,
+                  postscale_factor=2.0)]
+    for case in cases:
+        fast = [np.asarray(hvd.allreduce(x, **case)) for x in xs]
+        gfast = [np.asarray(o) for o in
+                 hvd.grouped_allreduce(xs, **case)]
+        monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "1")
+        full = [np.asarray(hvd.allreduce(x, **case)) for x in xs]
+        gfull = [np.asarray(o) for o in
+                 hvd.grouped_allreduce(xs, **case)]
+        monkeypatch.delenv("HOROVOD_NO_REPLICATED_FAST")
+        for f, g in zip(fast, full):
+            np.testing.assert_allclose(f, g, rtol=1e-6, err_msg=str(case))
+        for f, g in zip(gfast, gfull):
+            np.testing.assert_allclose(f, g, rtol=1e-6, err_msg=str(case))
