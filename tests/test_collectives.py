@@ -305,3 +305,39 @@ def test_replicated_fast_path_matches_full_machinery(hvd, monkeypatch):
             np.testing.assert_allclose(f, g, rtol=1e-6, err_msg=str(case))
         for f, g in zip(gfast, gfull):
             np.testing.assert_allclose(f, g, rtol=1e-6, err_msg=str(case))
+
+
+def test_replicated_fast_path_gating(hvd, monkeypatch):
+    """The closed form must NOT fire for stacked inputs, Adasum, or when
+    the escape hatch is set — those paths carry real collectives."""
+    import numpy as np
+
+    from horovod_tpu.core.process_sets import global_process_set
+    from horovod_tpu.ops import collectives as C
+    from horovod_tpu.common import types as T
+
+    ps = global_process_set
+    plain = np.ones((3,), np.float32)
+    k = ps.size()
+    stacked = np.ones((k, 3), np.float32)  # leading dim == local slots
+    assert C._replicated_fast_ok(ps, T.ReduceOp.SUM, None, (plain,))
+    assert not C._replicated_fast_ok(ps, T.ReduceOp.SUM, None, (stacked,))
+    assert not C._replicated_fast_ok(ps, T.ReduceOp.ADASUM, None, (plain,))
+    assert not C._replicated_fast_ok(ps, T.ReduceOp.SUM, object(), (plain,))
+    monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "1")
+    assert not C._replicated_fast_ok(ps, T.ReduceOp.SUM, None, (plain,))
+    # repo convention: boolean knobs parse '0'/'false' as OFF
+    monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "0")
+    assert C._replicated_fast_ok(ps, T.ReduceOp.SUM, None, (plain,))
+    monkeypatch.delenv("HOROVOD_NO_REPLICATED_FAST")
+    # mixed groups (one stacked member) must take the full path
+    assert not C._replicated_fast_ok(ps, T.ReduceOp.SUM, None,
+                                     (plain, stacked))
+
+
+def test_replicated_fast_path_rejects_bad_dtype(hvd):
+    import numpy as np
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        hvd.grouped_allreduce([np.ones((2,), np.complex64)], op="sum")
