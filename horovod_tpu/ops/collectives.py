@@ -440,8 +440,10 @@ def allreduce(tensor: Any,
                                           T.ReduceOp.AVERAGE)) else None
     if _replicated_fast_ok(ps, rop, hm, (tensor,)):
         shape = tuple(np.shape(tensor))
-        dtype = np.result_type(tensor) if not hasattr(tensor, "dtype") \
-            else tensor.dtype
+        # np.result_type on a LIST parses it as a dtype spec (numpy 2.x);
+        # np.asarray handles lists/scalars/arrays uniformly.
+        dtype = tensor.dtype if hasattr(tensor, "dtype") \
+            else np.asarray(tensor).dtype
         T.check_supported_dtype(np.dtype(dtype))
         key = ("ar_rep", shape, str(dtype), int(rop), ps.cache_token,
                float(prescale_factor), float(postscale_factor), k)
@@ -499,8 +501,10 @@ def grouped_allreduce(tensors: Sequence[Any],
                                           T.ReduceOp.AVERAGE)) else None
     if _replicated_fast_ok(ps, rop, hm, tensors):
         shapes = tuple(tuple(np.shape(t)) for t in tensors)
-        dtypes = tuple(str(getattr(t, "dtype", np.result_type(t)))
-                       for t in tensors)
+        # np.asarray, not np.result_type: the latter parses a list input
+        # as a dtype spec on numpy 2.x
+        dtypes = tuple(str(t.dtype) if hasattr(t, "dtype")
+                       else str(np.asarray(t).dtype) for t in tensors)
         for d in dtypes:  # same gate _to_global applies on the full path
             T.check_supported_dtype(np.dtype(d))
         key = ("gar_rep", shapes, dtypes, int(rop), ps.cache_token,

@@ -1,0 +1,111 @@
+"""Ablation profile of the ResNet-50 train step on one chip.
+
+Times progressively smaller slices of the step to locate the non-MXU time:
+full step -> grads only -> fwd only -> fwd without BN -> convs only.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from horovod_tpu.models import resnet
+
+B, IMG = 128, 224
+DT = jnp.bfloat16
+
+
+def timeit(name, fn, *args, iters=10, warmup=5):
+    f = jax.jit(fn)
+    out = None
+    for _ in range(warmup):
+        out = f(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    dt = (time.perf_counter() - t0) / iters * 1e3
+    print(f"{name:42s} {dt:8.2f} ms")
+    return dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, IMG, IMG, 3), np.float32), DT)
+    y = jnp.asarray(rng.integers(0, 1000, (B,)))
+    params, stats = resnet.init(jax.random.PRNGKey(0), depth=50,
+                                num_classes=1000, dtype=DT)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+
+    def loss(p, s):
+        return resnet.loss_fn(p, s, (x, y), depth=50, train=True)
+
+    def full(p, s, o):
+        (l, ns), g = jax.value_and_grad(loss, has_aux=True)(p, s)
+        u, o = opt.update(g, o, p)
+        return optax.apply_updates(p, u), ns, o, l
+
+    timeit("full step (loss+grad+sgd)", full, params, stats, opt_state)
+    timeit("value_and_grad only", lambda p, s: jax.value_and_grad(
+        loss, has_aux=True)(p, s), params, stats)
+    timeit("forward only", loss, params, stats)
+
+    def loss_eval(p, s):
+        return resnet.loss_fn(p, s, (x, y), depth=50, train=False)
+
+    timeit("forward only, train=False (no BN stats)", loss_eval, params,
+           stats)
+    timeit("grad, train=False", lambda p, s: jax.grad(
+        lambda pp: loss_eval(pp, s)[0])(p), params, stats)
+
+    # convs only: strip BN + maxpool, keep relu
+    def conv_only(p):
+        h = resnet._conv(x, p["stem"]["conv"], stride=2)
+        h = jax.nn.relu(h)
+        h = h[:, ::2, ::2, :]  # cheap downsample instead of maxpool
+        for s_i, n in enumerate(resnet.STAGE_BLOCKS[50]):
+            for b in range(n):
+                blk = p[f"s{s_i}b{b}"]
+                stride = 2 if (b == 0 and s_i > 0) else 1
+                yv = jax.nn.relu(resnet._conv(h, blk["conv1"]))
+                yv = jax.nn.relu(resnet._conv(yv, blk["conv2"], stride=stride))
+                yv = resnet._conv(yv, blk["conv3"])
+                sc = resnet._conv(h, blk["proj"], stride=stride) \
+                    if "proj" in blk else h
+                h = jax.nn.relu(yv + sc)
+        h = jnp.mean(h, axis=(1, 2))
+        logits = h @ p["fc"]["w"] + p["fc"]["b"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    timeit("convs+relu fwd only (no BN/maxpool)", conv_only, params)
+    timeit("convs+relu grad (no BN/maxpool)", lambda p: jax.grad(
+        conv_only)(p), params)
+
+    # stem alone (C_in=3 MXU waste?)
+    def stem_only(p):
+        h = resnet._conv(x, p["stem"]["conv"], stride=2)
+        return jnp.sum(h.astype(jnp.float32))
+
+    timeit("stem conv 7x7s2 fwd", stem_only, params)
+    timeit("stem conv 7x7s2 grad", lambda p: jax.grad(stem_only)(p), params)
+
+    # maxpool grad cost
+    def mp(xx):
+        h = lax.reduce_window(xx, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+        return jnp.sum(h.astype(jnp.float32))
+
+    h112 = jnp.asarray(rng.standard_normal((B, 112, 112, 64), np.float32), DT)
+    timeit("maxpool fwd (112x112x64)", mp, h112)
+    timeit("maxpool grad", lambda xx: jax.grad(mp)(xx), h112)
+
+
+if __name__ == "__main__":
+    main()
