@@ -8,9 +8,15 @@ horovod/tensorflow/compression.py, horovod/_keras/callbacks.py.
 
 TF tensors cross the boundary as numpy; the collective itself runs as a
 compiled XLA program over the mesh (the reference's own XLA custom-call
-path, tensorflow/xla_mpi_ops.cc, is the pattern this generalizes). Eager
-TF2 only — the graph-mode AsyncOpKernel machinery has no TPU-side analog
-to build against.
+path, tensorflow/xla_mpi_ops.cc, is the pattern this generalizes).
+
+Graph mode (`tf.function`): collectives lower to `tf.py_function` host
+calls into the same engine (reference analog: tensorflow/mpi_ops.cc:461
+AsyncOpKernels working inside graphs). Within one traced graph every
+collective is chained by control dependencies, so execution order equals
+trace order — identical across ranks, preserving the engine's SPMD
+call-order contract even when TF's scheduler would otherwise run
+independent ops in parallel.
 
     import horovod_tpu.frontends.tensorflow as hvd
     hvd.init()
@@ -94,10 +100,58 @@ def _like(arr, ref, keep_shape: bool = False):
     return out
 
 
+# -- Graph-mode (tf.function) bridge ---------------------------------------
+#
+# Inside a tf.function trace, tensors are symbolic and `.numpy()` does not
+# exist. Each collective lowers to ONE tf.py_function op that re-enters the
+# eager implementation at graph-execution time (reference analog: the TF
+# AsyncOpKernels of tensorflow/mpi_ops.cc:461, which likewise hop to the
+# runtime from inside a graph). TF may execute data-independent ops in any
+# order — different ranks could then submit collectives in different orders
+# and break the engine's SPMD call-order contract — so all bridge ops in a
+# graph are serialized with control dependencies (trace order == execution
+# order on every rank).
+
+def _in_graph() -> bool:
+    tf = _tf()
+    return not tf.executing_eagerly()
+
+
+def _py_collective(eager_fn, inputs, out_dtypes):
+    """One py_function op, control-dep-chained after the previous one in
+    this graph. The chain anchor lives ON the graph object so its lifetime
+    is the graph's own (a module-level map would pin every traced
+    FuncGraph forever)."""
+    import contextlib
+
+    tf = _tf()
+    g = tf.compat.v1.get_default_graph()
+    prev = getattr(g, "_hvd_tpu_chain_anchor", None)
+    dep = (tf.control_dependencies([prev]) if prev is not None
+           else contextlib.nullcontext())
+    with dep:
+        out = tf.py_function(eager_fn, inputs, out_dtypes)
+    g._hvd_tpu_chain_anchor = out[0] if isinstance(out, (list, tuple)) \
+        else out
+    return out
+
+
 def allreduce(tensor, average: Optional[bool] = None, name=None, op=None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               process_set: Optional[ProcessSet] = None):
-    """Reference: hvd.allreduce (tensorflow/mpi_ops.py)."""
+    """Reference: hvd.allreduce (tensorflow/mpi_ops.py). Works eagerly and
+    inside tf.function (py_function bridge)."""
+    if _in_graph():
+        def _eager(t):
+            out = C.allreduce(t.numpy(), average=average, name=name, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              process_set=process_set)
+            return _like(out, t, keep_shape=True)
+
+        out = _py_collective(_eager, [tensor], tensor.dtype)
+        out.set_shape(tensor.shape)
+        return out
     out = C.allreduce(_to_np(tensor), average=average, name=name, op=op,
                       prescale_factor=prescale_factor,
                       postscale_factor=postscale_factor,
@@ -109,6 +163,21 @@ def grouped_allreduce(tensors, average: Optional[bool] = None, name=None,
                       op=None, prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
                       process_set: Optional[ProcessSet] = None) -> List[Any]:
+    if _in_graph() and tensors:
+        def _eager(*ts):
+            outs = C.grouped_allreduce([t.numpy() for t in ts],
+                                       average=average, op=op,
+                                       prescale_factor=prescale_factor,
+                                       postscale_factor=postscale_factor,
+                                       process_set=process_set)
+            return [_like(o, t, keep_shape=True) for o, t in zip(outs, ts)]
+
+        outs = _py_collective(_eager, list(tensors),
+                              [t.dtype for t in tensors])
+        outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        for o, t in zip(outs, tensors):
+            o.set_shape(t.shape)
+        return outs
     outs = C.grouped_allreduce([_to_np(t) for t in tensors],
                                average=average, op=op,
                                prescale_factor=prescale_factor,
@@ -119,18 +188,43 @@ def grouped_allreduce(tensors, average: Optional[bool] = None, name=None,
 
 def broadcast(tensor, root_rank: int, name=None,
               process_set: Optional[ProcessSet] = None):
+    if _in_graph():
+        def _eager(t):
+            out = C.broadcast(t.numpy(), root_rank=root_rank, name=name,
+                              process_set=process_set)
+            return _like(out, t, keep_shape=True)
+
+        out = _py_collective(_eager, [tensor], tensor.dtype)
+        out.set_shape(tensor.shape)
+        return out
     out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
                       process_set=process_set)
     return _like(out, tensor, keep_shape=True)
 
 
 def allgather(tensor, name=None, process_set: Optional[ProcessSet] = None):
+    if _in_graph():
+        def _eager(t):
+            return _like(C.allgather(t.numpy(), name=name,
+                                     process_set=process_set), t)
+
+        out = _py_collective(_eager, [tensor], tensor.dtype)
+        out.set_shape([None] + list(tensor.shape)[1:])  # dim0: sum of ranks
+        return out
     out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
     return _like(out, tensor)
 
 
 def reducescatter(tensor, op=Average,
                   process_set: Optional[ProcessSet] = None, **kw):
+    if _in_graph():
+        def _eager(t):
+            return _like(C.reducescatter(t.numpy(), op=op,
+                                         process_set=process_set, **kw), t)
+
+        out = _py_collective(_eager, [tensor], tensor.dtype)
+        out.set_shape([None] + list(tensor.shape)[1:])  # dim0: this rank's
+        return out
     out = C.reducescatter(_to_np(tensor), op=op, process_set=process_set,
                           **kw)
     return _like(out, tensor)
@@ -138,9 +232,23 @@ def reducescatter(tensor, op=Average,
 
 def alltoall(tensor, splits=None, name=None,
              process_set: Optional[ProcessSet] = None):
+    tf = _tf()
+    if _in_graph():
+        def _eager(*args):
+            t = args[0]
+            sp = args[1].numpy() if len(args) > 1 else None
+            out, recv = C.alltoall(t.numpy(), splits=sp, name=name,
+                                   process_set=process_set)
+            return (_like(out, t), tf.convert_to_tensor(
+                np.asarray(recv).astype(np.int64)))
+
+        inputs = [tensor] if splits is None else [tensor, splits]
+        out, recv = _py_collective(_eager, inputs,
+                                   [tensor.dtype, tf.int64])
+        out.set_shape([None] + list(tensor.shape)[1:])
+        return out, recv
     out, recv = C.alltoall(_to_np(tensor), splits=splits, name=name,
                            process_set=process_set)
-    tf = _tf()
     # recv counts stay integral end-to-end — routing them through the input
     # dtype (e.g. fp16) would corrupt counts above the mantissa range.
     return _like(out, tensor), tf.convert_to_tensor(
@@ -148,6 +256,14 @@ def alltoall(tensor, splits=None, name=None,
 
 
 def barrier(process_set: Optional[ProcessSet] = None):
+    if _in_graph():
+        tf = _tf()
+
+        def _eager():
+            C.barrier(process_set=process_set)
+            return tf.constant(0, tf.int32)
+
+        return _py_collective(_eager, [], tf.int32)
     C.barrier(process_set=process_set)
 
 
@@ -218,11 +334,68 @@ class DistributedGradientTape:
         return out[0] if single else out
 
 
-class DistributedOptimizer:
-    """Keras-3 optimizer wrapper (reference: tensorflow/__init__.py:896 +
-    keras/__init__.py DistributedOptimizer): gradients are reduced across
-    ranks before apply, with local aggregation every
-    `backward_passes_per_step` steps."""
+def _make_keras3_distributed(optimizer, compression, op,
+                             gradient_predivide_factor: float,
+                             backward_passes_per_step: int, process_set):
+    """Dynamic subclass of the wrapped Keras-3 optimizer's own class, so
+    `model.compile(optimizer=...)` accepts it (reference:
+    horovod/_keras/__init__.py builds the same dynamic subclass). Gradients
+    are reduced across ranks inside `apply`, which runs both eagerly and
+    inside the tf.function Keras compiles around train_step (via the
+    py_function graph bridge). `backward_passes_per_step` maps onto
+    Keras 3's native `gradient_accumulation_steps`; note the allreduce
+    then runs every backward pass (correct math; the reduce-every-N-passes
+    comm saving applies only to the eager wrapper path)."""
+    import keras
+
+    allreduce_grads = _make_allreduce_grads_fn(
+        op, gradient_predivide_factor, compression or Compression.none,
+        process_set)
+    base_cls = optimizer.__class__
+
+    class _DistKeras(base_cls):
+        def apply(self, grads, trainable_variables=None):
+            reduced = allreduce_grads(list(grads))
+            return super().apply(reduced, trainable_variables)
+
+    _DistKeras.__name__ = "Distributed" + base_cls.__name__
+    _DistKeras.__qualname__ = _DistKeras.__name__
+    cfg = optimizer.get_config()
+    if backward_passes_per_step > 1:
+        if cfg.get("gradient_accumulation_steps"):
+            raise ValueError(
+                "pass either backward_passes_per_step or a "
+                "gradient_accumulation_steps-configured optimizer, not both")
+        cfg["gradient_accumulation_steps"] = backward_passes_per_step
+    del keras
+    return _DistKeras.from_config(cfg)
+
+
+def DistributedOptimizer(optimizer, compression=None, op=Average,
+                         gradient_predivide_factor: float = 1.0,
+                         backward_passes_per_step: int = 1,
+                         process_set: Optional[ProcessSet] = None):
+    """Reference: tensorflow/__init__.py:896 + keras/__init__.py. For a
+    Keras-3 optimizer, returns a dynamic subclass instance usable in
+    `model.compile` and inside compiled train steps; otherwise a generic
+    eager wrapper exposing `apply_gradients`."""
+    try:
+        import keras
+        if isinstance(optimizer, keras.optimizers.Optimizer):
+            return _make_keras3_distributed(
+                optimizer, compression, op, gradient_predivide_factor,
+                backward_passes_per_step, process_set)
+    except ImportError:
+        pass
+    return _EagerDistributedOptimizer(
+        optimizer, compression, op, gradient_predivide_factor,
+        backward_passes_per_step, process_set)
+
+
+class _EagerDistributedOptimizer:
+    """Generic duck-typed wrapper: reduces gradients across ranks before
+    delegating `apply_gradients`, with local aggregation every
+    `backward_passes_per_step` steps (eager only)."""
 
     def __init__(self, optimizer, compression=None, op=Average,
                  gradient_predivide_factor: float = 1.0,
@@ -242,6 +415,13 @@ class DistributedOptimizer:
     def apply_gradients(self, grads_and_vars, **kwargs):
         tf = _tf()
         grads, tvars = zip(*list(grads_and_vars))
+        if self._bpps > 1 and _in_graph():
+            raise NotImplementedError(
+                "backward_passes_per_step > 1 uses Python-side accumulation "
+                "state and cannot be traced into a tf.function; call "
+                "apply_gradients eagerly (run the train step without "
+                "@tf.function / with jit_compile=False and "
+                "run_eagerly=True), or aggregate with bpps=1.")
         self._count += 1
         if self._bpps > 1:
             # Local gradient aggregation (reference:
@@ -268,9 +448,12 @@ def _keras_callback_base():
 
 
 class BroadcastGlobalVariablesCallback:
-    """Broadcast initial variables from root at train start (reference:
-    _keras/callbacks.py:23). Implemented as a factory returning a Keras
-    callback so the keras import stays lazy."""
+    """Broadcast initial variables from root at train start, and optimizer
+    slot variables after they materialize on the first batch (reference:
+    _keras/callbacks.py:23-60 — the deferred broadcast exists because
+    optimizer slots are created lazily at the first apply; without it,
+    Adam moments start diverged across ranks). Implemented as a factory
+    returning a Keras callback so the keras import stays lazy."""
 
     def __new__(cls, root_rank: int = 0):
         Base = _keras_callback_base()
@@ -280,11 +463,21 @@ class BroadcastGlobalVariablesCallback:
                 super().__init__()
                 self.root = root
                 self._done = False
+                self._opt_done = False
 
             def on_train_begin(self, logs=None):
                 if not self._done:
                     broadcast_variables(self.model.variables, self.root)
                     self._done = True
+
+            def on_train_batch_end(self, batch, logs=None):
+                if self._opt_done:
+                    return
+                opt = getattr(self.model, "optimizer", None)
+                opt_vars = list(getattr(opt, "variables", None) or [])
+                if opt_vars:
+                    broadcast_variables(opt_vars, self.root)
+                self._opt_done = True
 
         return _CB(root_rank)
 

@@ -170,6 +170,74 @@ def scenario_tf_frontend(hvd, rank, size):
     np.testing.assert_allclose(w.numpy(), [[1.0]])
 
 
+def scenario_tf_function(hvd, rank, size):
+    """A tf.function-compiled train step with DistributedGradientTape
+    converges across real ranks (VERDICT r2 #3; reference:
+    tensorflow/mpi_ops.cc:461 graph-mode AsyncOpKernels)."""
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    # Rank-dependent data: only a REAL cross-rank mean converges to the
+    # global least-squares fit. y = 2x with x drawn per-rank.
+    xs = tf.constant([[float(rank + 1)], [float(rank + 2)]])
+    ys = 2.0 * xs
+    w = tf.Variable([[float(rank)]])  # ranks start diverged
+
+    @tf.function
+    def train_step():
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(tf.square(tf.matmul(xs, w) - ys))
+        dtape = tfvd.DistributedGradientTape(tape)
+        (g,) = dtape.gradient(loss, [w])
+        w.assign_sub(0.05 * g)
+        return loss
+
+    tfvd.broadcast_variables([w], root_rank=0)
+    losses = [float(train_step()) for _ in range(60)]
+    check(losses[-1] < 1e-3, f"no convergence: {losses[-1]}")
+    # all ranks must hold the SAME weights (identical reduced grads)
+    gathered = tfvd.allgather(tf.reshape(w, (1,)))
+    np.testing.assert_allclose(gathered.numpy(),
+                               np.full(size, gathered.numpy()[0]), rtol=1e-6)
+    np.testing.assert_allclose(w.numpy(), 2.0, atol=0.05)
+
+
+def scenario_keras_opt_broadcast(hvd, rank, size):
+    """Optimizer slot variables are broadcast after they materialize on the
+    first batch (VERDICT r2 #5; reference: _keras/callbacks.py:23-60)."""
+    import keras
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    keras.utils.set_random_seed(1234 + rank)  # ranks start diverged
+    model = keras.Sequential([keras.layers.Dense(3, input_shape=(2,))])
+    opt = tfvd.DistributedOptimizer(keras.optimizers.Adam(learning_rate=0.01))
+    model.compile(optimizer=opt, loss="mse")
+    cb = tfvd.BroadcastGlobalVariablesCallback(0)
+
+    # rank-dependent data too: without the deferred broadcast the Adam
+    # moments would differ across ranks after step 1
+    x = np.full((4, 2), float(rank + 1), np.float32)
+    y = np.full((4, 3), float(rank), np.float32)
+    model.fit(x, y, epochs=1, batch_size=4, verbose=0, callbacks=[cb])
+
+    flat = tf.concat([tf.reshape(tf.convert_to_tensor(v), (-1,))
+                      for v in model.optimizer.variables
+                      if "float" in str(v.dtype)], 0)
+    gathered = tfvd.allgather(tf.reshape(flat, (1, -1)))
+    for r in range(1, size):
+        np.testing.assert_allclose(gathered.numpy()[r], gathered.numpy()[0],
+                                   rtol=1e-6,
+                                   err_msg=f"optimizer state diverged r{r}")
+    # model weights also in sync
+    wflat = tf.concat([tf.reshape(w, (-1,)) for w in model.weights], 0)
+    gw = tfvd.allgather(tf.reshape(wflat, (1, -1)))
+    for r in range(1, size):
+        np.testing.assert_allclose(gw.numpy()[r], gw.numpy()[0], rtol=1e-6)
+
+
 def scenario_grouped_allgather(hvd, rank, size):
     """Fused grouped allgather with per-rank-uneven first dims: one size
     exchange + one program for the whole group."""
@@ -314,6 +382,8 @@ SCENARIOS = {
     "grouped_allgather": scenario_grouped_allgather,
     "torch_frontend": scenario_torch_frontend,
     "tf_frontend": scenario_tf_frontend,
+    "tf_function": scenario_tf_function,
+    "keras_opt_broadcast": scenario_keras_opt_broadcast,
     "broadcast_object": scenario_broadcast_object,
     "barrier": scenario_barrier,
     "autotune_sync": scenario_autotune_sync,

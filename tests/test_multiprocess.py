@@ -160,3 +160,21 @@ def test_tf_frontend_multiprocess(tmp_path):
     text = run_scenarios(2, "tf_frontend", tmp_path)
     for rank in range(2):
         assert f"MP_WORKER_OK tf_frontend rank={rank}" in text, text
+
+
+def test_tf_function_multiprocess(tmp_path):
+    """tf.function-wrapped train step converging across 2 real ranks
+    (VERDICT r2 #3)."""
+    pytest.importorskip("tensorflow")
+    text = run_scenarios(2, "tf_function", tmp_path)
+    for rank in range(2):
+        assert f"MP_WORKER_OK tf_function rank={rank}" in text, text
+
+
+def test_keras_optimizer_state_sync(tmp_path):
+    """Adam slots identical across ranks after step 1 (VERDICT r2 #5)."""
+    pytest.importorskip("tensorflow")
+    pytest.importorskip("keras")
+    text = run_scenarios(2, "keras_opt_broadcast", tmp_path)
+    for rank in range(2):
+        assert f"MP_WORKER_OK keras_opt_broadcast rank={rank}" in text, text
