@@ -11,7 +11,9 @@ compiled XLA program over the mesh (the reference's own XLA custom-call
 path, tensorflow/xla_mpi_ops.cc, is the pattern this generalizes).
 
 Graph mode (`tf.function`): collectives lower to `tf.py_function` host
-calls into the same engine (reference analog: tensorflow/mpi_ops.cc:461
+calls into the same engine — NOT supported under `jit_compile=True`
+(XLA cannot compile EagerPyFunc; keep collective-bearing functions
+un-jitted) (reference analog: tensorflow/mpi_ops.cc:461
 AsyncOpKernels working inside graphs). Within one traced graph every
 collective is chained by control dependencies, so execution order equals
 trace order — identical across ranks, preserving the engine's SPMD
@@ -346,8 +348,6 @@ def _make_keras3_distributed(optimizer, compression, op,
     Keras 3's native `gradient_accumulation_steps`; note the allreduce
     then runs every backward pass (correct math; the reduce-every-N-passes
     comm saving applies only to the eager wrapper path)."""
-    import keras
-
     allreduce_grads = _make_allreduce_grads_fn(
         op, gradient_predivide_factor, compression or Compression.none,
         process_set)
@@ -367,7 +367,6 @@ def _make_keras3_distributed(optimizer, compression, op,
                 "pass either backward_passes_per_step or a "
                 "gradient_accumulation_steps-configured optimizer, not both")
         cfg["gradient_accumulation_steps"] = backward_passes_per_step
-    del keras
     return _DistKeras.from_config(cfg)
 
 

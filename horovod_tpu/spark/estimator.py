@@ -14,11 +14,21 @@ TPU-first redesign:
     the reference's torch estimator over our torch frontend.
   * Petastorm readers are replaced by pyarrow shard reads (util.py).
 
-Data contract (documented in lieu of the reference's metadata-driven
-reshaping, spark/common/util.py:200+): feature columns are concatenated
-column-wise into a float32 matrix `X[batch, D]`; label columns likewise
-into `y`. Columns holding fixed-length vectors (numpy arrays / lists)
-are flattened into their slot.
+Data contract (reference: spark/common/util.py:200+ metadata-driven
+reshaping): per-column element dtype + shape are recorded in the dataset
+metadata at prepare time and restored end-to-end —
+
+  * a SINGLE feature column whose elements are >= 2-D (e.g. an 8x8x1
+    image) reaches the model as a shaped tensor `X[batch, *shape]` in
+    its recorded dtype;
+  * otherwise feature columns are concatenated column-wise into a
+    float32 matrix `X[batch, D]` (vector cells flatten into their slot);
+  * a single label column keeps its recorded dtype and shape (integer
+    class labels stay integers); multiple label columns concatenate to
+    float32.
+
+Spark ML Vector columns are accepted (converted to arrays at prepare
+time; Vector cells in pandas frames are materialized via .toArray()).
 """
 
 from __future__ import annotations
@@ -36,30 +46,53 @@ from horovod_tpu.spark import util as sutil
 _CKPT_FILE = "model.pkl"
 
 
-def _stack_columns(data: Dict[str, np.ndarray],
-                   cols: List[str]) -> np.ndarray:
+def _stack_columns(data: Dict[str, np.ndarray], cols: List[str],
+                   metadata: Optional[Dict] = None) -> np.ndarray:
     """Concat columns into a 2-D float32 matrix (vector cells flatten)."""
     mats = []
     for c in cols:
         a = np.asarray(data[c])
         if len(a) == 0:
-            # Empty shard/frame: element width of object columns is
-            # unknowable; scalar columns keep width 1, which is all the
-            # zero-row paths (init probes, empty transform) need.
-            # (reshape(0, -1) cannot infer a width from zero elements,
-            # so build the 2-D form directly.)
-            mats.append(np.zeros((0, max(1, int(np.prod(a.shape[1:])))),
-                                 np.float32))
+            # Empty shard/frame: take the element width from the dataset
+            # metadata when available; a bare object column keeps width 1,
+            # which is all the zero-row paths (init probes, empty
+            # transform) need. (reshape(0, -1) cannot infer a width from
+            # zero elements, so build the 2-D form directly.)
+            m = (metadata or {}).get(c)
+            width = int(np.prod(m["shape"] or [1])) if m else \
+                max(1, int(np.prod(a.shape[1:])))
+            mats.append(np.zeros((0, max(1, width)), np.float32))
             continue
         if a.dtype == object:
-            a = np.stack([np.asarray(v) for v in a])
+            a = sutil._stack_cells(a)
         a = a.reshape(len(a), -1)
         mats.append(a.astype(np.float32, copy=False))
     return np.concatenate(mats, axis=1) if len(mats) > 1 else mats[0]
 
 
-def _labels(data: Dict[str, np.ndarray], cols: List[str]) -> np.ndarray:
-    y = _stack_columns(data, cols)
+def _features(data: Dict[str, np.ndarray], cols: List[str],
+              metadata: Optional[Dict] = None) -> np.ndarray:
+    """Model input: a single >=2-D feature column arrives SHAPED in its
+    recorded dtype (image-style models); anything else is the flat
+    float32 matrix (reference: util.py:200+ reshaping per metadata)."""
+    if metadata and len(cols) == 1:
+        m = metadata.get(cols[0])
+        if m and len(m.get("shape") or ()) >= 2:
+            a = sutil.restore_column(data[cols[0]], m)
+            if a.dtype.kind == "f" and a.dtype != np.float32:
+                # float64 cells (numpy's default) would feed DoubleTensors
+                # to float32 torch/keras models; integer dtypes keep
+                a = a.astype(np.float32)
+            return a
+    return _stack_columns(data, cols, metadata)
+
+
+def _labels(data: Dict[str, np.ndarray], cols: List[str],
+            metadata: Optional[Dict] = None) -> np.ndarray:
+    if metadata and len(cols) == 1 and cols[0] in metadata:
+        # dtype/shape-preserving path: int class labels stay int
+        return sutil.restore_column(data[cols[0]], metadata[cols[0]])
+    y = _stack_columns(data, cols, metadata)
     return y[:, 0] if y.shape[1] == 1 else y
 
 
@@ -198,7 +231,7 @@ class HorovodModel(ModelParams):
             return out
         bs = self.getBatchSize()
         data = {c: pdf[c].values for c in self.getFeatureCols()}
-        X = _stack_columns(data, self.getFeatureCols())
+        X = _features(data, self.getFeatureCols(), self.getMetadata())
         preds = np.concatenate(
             [np.asarray(self._predict_batch(X[i:i + bs]))
              for i in range(0, len(X), bs)])
@@ -524,14 +557,19 @@ def _remote_train_jax(spec):
         apply_fn = model.apply
     loss_fn = t["loss"]
 
-    # Init from a zero 2-row probe with widths taken from the dataset
-    # METADATA, not from shard rows: an empty-shard rank cannot infer a
-    # vector column's width from its rows, and a width mismatch here
-    # would turn the params broadcast below into a cryptic collective
-    # shape error (reference: util.py metadata drives input shaping).
-    width = sum(max(1, int(np.prod(spec["metadata"][c]["shape"] or [1])))
-                for c in fcols)
-    sample = np.zeros((2, width), np.float32)
+    # Init from a zero 2-row probe shaped from the dataset METADATA, not
+    # from shard rows: an empty-shard rank cannot infer a vector column's
+    # width from its rows, and a width mismatch here would turn the
+    # params broadcast below into a cryptic collective shape error
+    # (reference: util.py metadata drives input shaping).
+    md = spec["metadata"]
+    m0 = md.get(fcols[0]) if len(fcols) == 1 else None
+    if m0 and len(m0.get("shape") or ()) >= 2:
+        sample = np.zeros((2, *m0["shape"]), np.dtype(m0["dtype"]))
+    else:
+        width = sum(max(1, int(np.prod(md[c]["shape"] or [1])))
+                    for c in fcols)
+        sample = np.zeros((2, width), np.float32)
     params = init_fn(jax.random.PRNGKey(spec["seed"]), sample)
     params = broadcast_parameters(params, root_rank=0)
 
@@ -555,14 +593,14 @@ def _remote_train_jax(spec):
     box = {"params": params, "opt_state": opt_state}
 
     def train_step(b) -> float:
-        xb, yb = _stack_columns(b, fcols), _labels(b, lcols)
+        xb, yb = _features(b, fcols, md), _labels(b, lcols, md)
         l, g = value_grad(box["params"], xb, yb)
         box["params"], box["opt_state"] = dist_opt.step(
             g, box["params"], box["opt_state"])
         return float(l)
 
     def eval_batch(b):
-        xv, yv = _stack_columns(b, fcols), _labels(b, lcols)
+        xv, yv = _features(b, fcols, md), _labels(b, lcols, md)
         preds = apply_fn(box["params"], xv)
         return float(loss_fn(preds, yv)), {
             k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
@@ -619,9 +657,11 @@ def _remote_train_torch(spec):
                                 t["optimizer"](model.parameters()))
     np_allreduce = _torch_np_allreduce(hvd)
 
+    md = spec["metadata"]
+
     def train_step(b) -> float:
-        xb = torch.from_numpy(_stack_columns(b, fcols))
-        yb = torch.from_numpy(np.asarray(_labels(b, lcols)))
+        xb = torch.from_numpy(_features(b, fcols, md))
+        yb = torch.from_numpy(np.asarray(_labels(b, lcols, md)))
         opt.zero_grad()
         loss = loss_fn(model(xb), yb)
         loss.backward()
@@ -630,8 +670,8 @@ def _remote_train_torch(spec):
 
     def eval_batch(b):
         with torch.no_grad():
-            xv = torch.from_numpy(_stack_columns(b, fcols))
-            yv = torch.from_numpy(np.asarray(_labels(b, lcols)))
+            xv = torch.from_numpy(_features(b, fcols, md))
+            yv = torch.from_numpy(np.asarray(_labels(b, lcols, md)))
             preds = model(xv)
             return float(loss_fn(preds, yv)), {
                 k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
@@ -748,9 +788,11 @@ def _remote_train_keras(spec):
         return np.asarray(hvd.allreduce(
             tf.constant(np.asarray(arr)), op=op))
 
+    md = spec["metadata"]
+
     def train_step(b) -> float:
-        xb = tf.constant(_stack_columns(b, fcols))
-        yb = tf.constant(np.asarray(_labels(b, lcols)))
+        xb = tf.constant(_features(b, fcols, md))
+        yb = tf.constant(np.asarray(_labels(b, lcols, md)))
         with tf.GradientTape() as tape:
             loss = tf.reduce_mean(loss_obj(yb, model(xb, training=True)))
         grads = tape.gradient(loss, model.trainable_variables)
@@ -776,8 +818,8 @@ def _remote_train_keras(spec):
         return float(loss)
 
     def eval_batch(b):
-        xv = tf.constant(_stack_columns(b, fcols))
-        yv = tf.constant(np.asarray(_labels(b, lcols)))
+        xv = tf.constant(_features(b, fcols, md))
+        yv = tf.constant(np.asarray(_labels(b, lcols, md)))
         preds = model(xv, training=False)
         return float(tf.reduce_mean(loss_obj(yv, preds))), {
             k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
@@ -894,9 +936,11 @@ def _remote_train_lightning(spec):
         step_counter["i"] = 0
         model.train()
 
+    md = spec["metadata"]
+
     def to_batch(b):
-        return (torch.from_numpy(_stack_columns(b, fcols)),
-                torch.from_numpy(np.asarray(_labels(b, lcols))))
+        return (torch.from_numpy(_features(b, fcols, md)),
+                torch.from_numpy(np.asarray(_labels(b, lcols, md))))
 
     def train_step(b) -> float:
         opt.zero_grad()

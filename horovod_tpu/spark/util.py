@@ -30,6 +30,16 @@ def _is_pyspark_df(df) -> bool:
     return mod.startswith("pyspark")
 
 
+def _cell_array(v) -> np.ndarray:
+    """One cell as ndarray; Spark ML Vectors (anything with .toArray)
+    are materialized (reference: store.py:617 vector adapters)."""
+    return np.asarray(v.toArray() if hasattr(v, "toArray") else v)
+
+
+def _stack_cells(values) -> np.ndarray:
+    return np.stack([_cell_array(v) for v in values])
+
+
 def _col_meta(arr: np.ndarray) -> Dict:
     """Shape/dtype metadata for one column (reference: util.py metadata
     dict with 'shape'/'intermediate_format' per column)."""
@@ -38,10 +48,41 @@ def _col_meta(arr: np.ndarray) -> Dict:
     return {"dtype": str(a.dtype), "shape": list(elem_shape)}
 
 
+def restore_column(arr, meta: Dict) -> np.ndarray:
+    """Restore a column read from parquet to its recorded per-element
+    shape and dtype (reference: util.py:200+ metadata-driven reshaping —
+    cells are stored flattened; shape/dtype live in the dataset
+    metadata). Accepts object arrays of lists/arrays/Vectors or plain
+    ndarrays."""
+    shape = tuple(meta.get("shape") or ())
+    dtype = np.dtype(meta["dtype"])
+    a = np.asarray(arr)
+    n = len(a)
+    if a.dtype == object:
+        a = _stack_cells(a) if n else np.zeros((0,) + shape, dtype)
+    a = a.reshape((n,) + shape)
+    return a.astype(dtype, copy=False)
+
+
 def _pandas_to_parquet(df, path: str, store, n_shards: int) -> int:
-    """Write a pandas DataFrame as n parquet shard files under `path`."""
+    """Write a pandas DataFrame as n parquet shard files under `path`.
+
+    Object cells (ndarrays / nested lists / Spark ML Vectors) are stored
+    FLATTENED as 1-D lists — arrow cannot hold multi-dim cells — with the
+    element shape recorded in the dataset metadata and restored by
+    `restore_column` on read (reference: util.py:200+ same contract)."""
     import pyarrow as pa
     import pyarrow.parquet as pq
+
+    flat = {}
+    for c in df.columns:
+        vals = df[c].values
+        if vals.dtype == object and len(vals) and (
+                hasattr(vals[0], "toArray")
+                or np.asarray(vals[0]).ndim >= 1):
+            flat[c] = [_cell_array(v).ravel().tolist() for v in vals]
+        else:
+            flat[c] = vals
 
     store.makedirs(path)
     n = len(df)
@@ -49,8 +90,7 @@ def _pandas_to_parquet(df, path: str, store, n_shards: int) -> int:
     fs = store.fs()
     for i in range(n_shards):
         lo, hi = int(bounds[i]), int(bounds[i + 1])
-        table = pa.Table.from_pandas(df.iloc[lo:hi],
-                                     preserve_index=False)
+        table = pa.table({c: v[lo:hi] for c, v in flat.items()})
         with fs.open(posixpath.join(path, f"part-{i:05d}.parquet"),
                      "wb") as f:
             pq.write_table(table, f)
@@ -79,6 +119,17 @@ def _pyspark_to_parquet(df, cols, validation, store,
     """Split + write a pyspark DataFrame from the executors."""
     from pyspark.sql import functions as F
 
+    # Spark ML Vector columns -> array<double> so parquet holds plain
+    # lists (reference: store.py:617 to_petastorm vector adapters).
+    try:
+        from pyspark.ml.functions import vector_to_array
+        from pyspark.ml.linalg import VectorUDT
+        for f in df.schema.fields:
+            if f.name in cols and isinstance(f.dataType, VectorUDT):
+                df = df.withColumn(f.name, vector_to_array(F.col(f.name)))
+    except (ImportError, AttributeError):
+        pass  # pyspark without ML (or the test stub)
+
     if isinstance(validation, str):
         base = df.select(*(cols + [validation]))
         val_df = base.filter(F.col(validation).cast("boolean")) \
@@ -104,8 +155,8 @@ def _pyspark_to_parquet(df, cols, validation, store,
     train_rows = _parquet_row_count(store, train_path)
     sample = _parquet_sample(store, train_path, cols, n=64)
     metadata = {
-        c: _col_meta(np.stack(sample[c]) if sample[c].dtype == object
-                     else sample[c])
+        c: _col_meta(_stack_cells(sample[c]) if sample[c].dtype == object
+                     and len(sample[c]) else sample[c])
         for c in cols
     }
     return train_rows, val_rows, metadata
@@ -197,9 +248,9 @@ def prepare_data(num_processes: int, store, df,
         val_rows = (_pandas_to_parquet(val_df, val_path, store, shards)
                     if val_df is not None and len(val_df) else 0)
         metadata = {
-            c: _col_meta(np.stack(train_df[c].values)
+            c: _col_meta(_stack_cells(train_df[c].values)
                          if train_df[c].dtype == object
-                         else train_df[c].values)
+                         and len(train_df) else train_df[c].values)
             for c in cols
         }
     meta = {"train_rows": train_rows, "val_rows": val_rows,
