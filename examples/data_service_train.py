@@ -26,11 +26,13 @@ def main():
 
     from horovod_tpu.data.service import (DataDispatcher,
                                           DataServiceClient, DataWorker)
+    from horovod_tpu.runner.secret import make_secret_key
 
-    disp = DataDispatcher(expected_workers=args.workers)
+    sk = make_secret_key().encode()  # service RPC is HMAC-authed, always
+    disp = DataDispatcher(expected_workers=args.workers, secret=sk)
     port = disp.start()
     addr = ("127.0.0.1", port)
-    workers = [DataWorker(addr, poll_interval=0.05)
+    workers = [DataWorker(addr, secret=sk, poll_interval=0.05)
                for _ in range(args.workers)]
     for w in workers:
         w.start()
@@ -44,7 +46,7 @@ def main():
             X = rng.normal(size=(64, 4)).astype(np.float32)
             yield {"x": X, "y": X @ w_true}
 
-    client = DataServiceClient(addr)
+    client = DataServiceClient(addr, secret=sk)
     client.register_dataset("train", dataset_fn)
 
     params = {"w": jnp.zeros((4,), jnp.float32)}

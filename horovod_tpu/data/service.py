@@ -18,8 +18,9 @@ trainers. Topology follows the reference's two-sided split:
   * `DataServiceClient.stream(name)` — iterator over all shards'
     batches, fanned in round-robin from every worker.
 
-All frames carry the job's HMAC digest (runner/secret.py) when a secret
-is set — same trust model as the rendezvous KV.
+All frames carry the job's HMAC digest (runner/secret.py); a secret is
+REQUIRED (frames are pickled — see _require_secret) — same trust model
+as the rendezvous KV.
 """
 
 from __future__ import annotations
@@ -40,6 +41,23 @@ _MAX_FRAME = 1 << 30
 
 class DataServiceError(RuntimeError):
     pass
+
+
+def _require_secret(secret: Optional[bytes]) -> bytes:
+    """Authentication is NOT optional: frames are pickled (and
+    register_dataset ships cloudpickled callables by design), so an
+    unauthenticated listener on 0.0.0.0 is arbitrary code execution for
+    anyone who can reach the port. The reference's service wire protocol
+    likewise requires the per-job secret unconditionally
+    (runner/common/service/*, secret-keyed wire). Falls back to the job
+    secret in HOROVOD_SECRET_KEY (set by the launcher)."""
+    secret = secret or secret_mod.secret_from_env()
+    if not secret:
+        raise ValueError(
+            "the data service requires an HMAC secret: pass secret=..., "
+            "or run under the launcher / set HOROVOD_SECRET_KEY "
+            "(see horovod_tpu.runner.secret.make_secret_key)")
+    return secret
 
 
 # ----------------------------------------------------------------------
@@ -82,6 +100,15 @@ def _recv_frame(sock: socket.socket, secret: Optional[bytes]) -> Any:
                                        digest.decode() if digest else None):
             raise DataServiceError("bad or missing frame HMAC")
     return pickle.loads(payload)
+
+
+def _routable_local_addr(peer: Tuple[str, int]) -> str:
+    """The local address of the route to `peer` (no traffic sent)."""
+    try:
+        with socket.create_connection(peer, timeout=10) as s:
+            return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
 
 
 def _rpc(addr: Tuple[str, int], obj: Any, secret: Optional[bytes],
@@ -147,7 +174,7 @@ class DataDispatcher:
     def __init__(self, expected_workers: int,
                  secret: Optional[bytes] = None):
         self.expected_workers = expected_workers
-        self._secret = secret
+        self._secret = _require_secret(secret)
         self._lock = threading.Lock()
         self._workers: List[Tuple[str, int]] = []
         self._datasets: Dict[str, bytes] = {}
@@ -216,9 +243,11 @@ class DataWorker:
     def __init__(self, dispatcher: Tuple[str, int],
                  secret: Optional[bytes] = None, prefetch: int = 4,
                  poll_interval: float = 0.1,
-                 dispatcher_timeout: float = 300.0):
+                 dispatcher_timeout: float = 300.0,
+                 advertise_addr: Optional[str] = None):
         self.dispatcher = dispatcher
-        self._secret = secret
+        self._secret = _require_secret(secret)
+        self.advertise_addr = advertise_addr
         self.prefetch = prefetch
         self.poll_interval = poll_interval
         self.dispatcher_timeout = dispatcher_timeout
@@ -229,7 +258,11 @@ class DataWorker:
 
     def start(self) -> int:
         self._srv, self.port = _serve(self._handle, self._secret)
-        host = socket.gethostbyname(socket.gethostname())
+        # Advertise the address the DISPATCHER route actually uses — on
+        # multi-NIC/container hosts gethostbyname(gethostname()) commonly
+        # resolves to 127.0.0.1 or an unroutable NIC (the silent failure
+        # runner/network.py exists to fix).
+        host = self.advertise_addr or _routable_local_addr(self.dispatcher)
         st = _rpc(self.dispatcher,
                   ("register_worker", (host, self.port)), self._secret)
         if st[0] != "ok":
@@ -359,7 +392,7 @@ class DataServiceClient:
     def __init__(self, dispatcher: Tuple[str, int],
                  secret: Optional[bytes] = None):
         self.dispatcher = dispatcher
-        self._secret = secret
+        self._secret = _require_secret(secret)
 
     def register_dataset(self, name: str,
                          dataset_fn: Callable[[int, int], Iterator[Any]]

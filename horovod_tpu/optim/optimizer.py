@@ -242,12 +242,24 @@ class DistributedOptimizer:
                                                    **update_extra)
             return optax.apply_updates(params, updates), new_state
         try:
-            return self._jitted_apply()(avg, opt_state, params)
-        except (jax.errors.TracerBoolConversionError,
-                jax.errors.ConcretizationTypeError,
-                jax.errors.TracerArrayConversionError):
-            # the user's transform does host-side / value-dependent work
-            # (legal before this path was jitted) — fall back for good
+            out = self._jitted_apply()(avg, opt_state, params)
+            # success means tracing worked; later errors of the caught
+            # types are runtime failures, not traceability, and re-raise
+            self._apply_traced_ok = True
+            return out
+        except (jax.errors.JAXTypeError, jax.errors.JAXIndexError,
+                TypeError, ValueError) as e:
+            # the user's transform does host-side / value-dependent work,
+            # leaks tracers, or keeps non-array leaves in its state — all
+            # legal before this path was jitted. Fall back for good, but
+            # only for errors raised by TRACING: a failure from the
+            # already-compiled executable (e.g. device OOM) re-raises.
+            if getattr(self, "_apply_traced_ok", False):
+                raise
+            from horovod_tpu.common.hvd_logging import get_logger
+            get_logger().info(
+                "optimizer apply not jittable (%s); running eagerly",
+                type(e).__name__)
             self._apply_eager = True
             updates, new_state = self.inner.update(avg, opt_state, params)
             return optax.apply_updates(params, updates), new_state

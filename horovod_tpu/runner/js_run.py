@@ -67,8 +67,10 @@ def build_jsrun_command(num_proc: int, command: List[str],
            "--cpu_per_rs", str(cpus_per_rs)]
     if gpus_per_rs:
         cmd += ["--gpu_per_rs", str(gpus_per_rs)]
+    # export by NAME (-E): values stay in the subprocess environment and
+    # off the world-readable command line (they include the HMAC secret)
     for k in sorted(env):
-        cmd += ["--env", f"{k}={env[k]}"]
+        cmd += ["-E", k]
     cmd += ["--stdio_mode", "prepended"]
     cmd += list(extra_flags or [])
     cmd += list(command)

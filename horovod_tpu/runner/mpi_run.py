@@ -100,9 +100,12 @@ def build_mpirun_command(num_proc: int, hosts: str, command: List[str],
     """Flavor-specific mpirun invocation (reference: mpi_run settings →
     mpirun_command assembly, mpi_run.py:133-240).
 
-    `env` entries travel with `-x NAME` (OpenMPI/Spectrum: values come
-    from the launcher's exported environment) or `-genv NAME value`
-    (MPICH/Intel).
+    `env` entries travel BY NAME ONLY — `-x NAME` (OpenMPI/Spectrum) or
+    `-genvlist N1,N2,...` (MPICH/Intel); values come from the launcher's
+    exported subprocess environment. Values must never ride the command
+    line: it is world-readable via /proc on shared HPC nodes and these
+    vars include the job HMAC secret (reference passes env by name the
+    same way, mpi_run.py:-x).
     """
     if implementation in (MISSING, UNKNOWN):
         raise RuntimeError(
@@ -122,8 +125,8 @@ def build_mpirun_command(num_proc: int, hosts: str, command: List[str],
                                    for h in hosts.split(","))]
         if nics:
             cmd += ["-iface", nics[0]]
-        for k in sorted(env):
-            cmd += ["-genv", k, env[k]]
+        if env:
+            cmd += ["-genvlist", ",".join(sorted(env))]
     cmd += binding
     cmd += list(extra_flags or [])
     cmd += list(command)

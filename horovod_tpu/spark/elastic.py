@@ -98,11 +98,27 @@ def agent_main(kv, index: int, stop_event: Optional[threading.Event] = None,
                 if rec["round"] <= proc_round:
                     continue
                 if proc is not None and proc.poll() is None:
-                    # a still-running worker for an older round is a
-                    # SURVIVOR — it re-rendezvouses in-process; never
-                    # restart it (driver only writes launch for slots it
-                    # actually spawned)
-                    continue
+                    # A still-running worker with NO newer launch record
+                    # is a survivor (it re-rendezvouses in-process; the
+                    # driver only writes launch for slots it actually
+                    # spawned). But a newer launch record for this host
+                    # means the driver replaced the worker — if its kill
+                    # command was swallowed by spawn()'s stale-key
+                    # cleanup before we consumed it (ADVICE r2), the old
+                    # process would live forever and stall the host.
+                    # The launch record IS the authoritative kill.
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        try:
+                            proc.wait(timeout=30)
+                        except subprocess.TimeoutExpired:
+                            # unreapable (D-state): abandon the corpse
+                            # rather than crash the agent and lose the
+                            # host's capacity for good
+                            pass
                 if fn_path is None:
                     blob = kv.get(_SCOPE, "fn")
                     with tempfile.NamedTemporaryFile(

@@ -90,8 +90,12 @@ def _features(data: Dict[str, np.ndarray], cols: List[str],
 def _labels(data: Dict[str, np.ndarray], cols: List[str],
             metadata: Optional[Dict] = None) -> np.ndarray:
     if metadata and len(cols) == 1 and cols[0] in metadata:
-        # dtype/shape-preserving path: int class labels stay int
-        return sutil.restore_column(data[cols[0]], metadata[cols[0]])
+        # dtype/shape-preserving path: int class labels stay int; float64
+        # (numpy/Spark default) normalizes to float32 for f32 models
+        y = sutil.restore_column(data[cols[0]], metadata[cols[0]])
+        if y.dtype.kind == "f" and y.dtype != np.float32:
+            y = y.astype(np.float32)
+        return y
     y = _stack_columns(data, cols, metadata)
     return y[:, 0] if y.shape[1] == 1 else y
 

@@ -73,7 +73,8 @@ def test_worker_error_surfaces_to_client(service):
 
 def test_unsigned_frames_rejected(service):
     disp, workers, client, secret = service
-    intruder = DataServiceClient(("127.0.0.1", disp.port), secret=None)
+    intruder = DataServiceClient(("127.0.0.1", disp.port),
+                                 secret=b"wrong-secret")
     # The server's error response is also signed, so the unsigned client
     # fails either on the request (rejected) or on reading the reply.
     with pytest.raises((DataServiceError, Exception)):
@@ -82,10 +83,11 @@ def test_unsigned_frames_rejected(service):
 
 
 def test_wait_for_workers_times_out():
-    disp = DataDispatcher(expected_workers=3)
+    sk = b"k1"
+    disp = DataDispatcher(expected_workers=3, secret=sk)
     port = disp.start()
     try:
-        client = DataServiceClient(("127.0.0.1", port))
+        client = DataServiceClient(("127.0.0.1", port), secret=sk)
         with pytest.raises(DataServiceError, match="data workers"):
             client.wait_for_workers(timeout=0.3)
     finally:
@@ -121,13 +123,25 @@ def test_prefetch_overlaps_production(service, tmp_path):
 def test_run_worker_entry(tmp_path):
     from horovod_tpu.data.service import run_worker
 
-    disp = DataDispatcher(expected_workers=1)
+    sk = b"k2"
+    disp = DataDispatcher(expected_workers=1, secret=sk)
     port = disp.start()
     try:
-        w = run_worker(f"127.0.0.1:{port}")
-        client = DataServiceClient(("127.0.0.1", port))
+        w = run_worker(f"127.0.0.1:{port}", secret=sk)
+        client = DataServiceClient(("127.0.0.1", port), secret=sk)
         client.register_dataset("t", lambda s, n: iter([42]))
         assert list(client.stream("t")) == [42]
         w.stop()
     finally:
         disp.stop()
+
+
+def test_secret_is_required(monkeypatch):
+    """ADVICE r2: pickle over the wire must never be unauthenticated."""
+    monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+    with pytest.raises(ValueError, match="secret"):
+        DataDispatcher(expected_workers=1)
+    with pytest.raises(ValueError, match="secret"):
+        DataWorker(("127.0.0.1", 1))
+    with pytest.raises(ValueError, match="secret"):
+        DataServiceClient(("127.0.0.1", 1))

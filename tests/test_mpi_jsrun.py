@@ -53,13 +53,17 @@ def test_openmpi_command_shape():
     assert cmd[-2:] == ["python", "train.py"]
 
 
-def test_mpich_command_uses_genv_and_hosts():
+def test_mpich_command_uses_genvlist_and_hosts():
     cmd = mpr.build_mpirun_command(
         2, "h1:1,h2:1", ["python", "t.py"],
-        env={"B": "2"}, implementation=mpr.MPICH, nics=["ib0"])
+        env={"B": "2", "HOROVOD_SECRET_KEY": "s3cret"},
+        implementation=mpr.MPICH, nics=["ib0"])
     s = " ".join(cmd)
     assert "-hosts h1,h2" in s
-    assert "-genv B 2" in s
+    # names only — env VALUES (incl. the HMAC secret) must never ride
+    # the world-readable command line (ADVICE r2)
+    assert "-genvlist B,HOROVOD_SECRET_KEY" in s
+    assert "s3cret" not in s
     assert "-iface ib0" in s
 
 
@@ -101,7 +105,8 @@ def test_jsrun_command_shape():
     assert cmd[0] == "jsrun"
     assert "--nrs 8" in s and "--tasks_per_rs 1" in s
     assert "--cpu_per_rs 4" in s and "--gpu_per_rs 1" in s
-    assert "--env HOROVOD_SIZE=8" in s
+    # name-only export: values stay out of the command line (ADVICE r2)
+    assert "-E HOROVOD_SIZE" in s and "=8" not in s
     assert cmd[-2:] == ["python", "train.py"]
 
 

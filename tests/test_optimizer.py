@@ -227,3 +227,37 @@ def test_adasum_halving_non_power_of_two_set(hvd):
     finally:
         cfg.dynamic_process_sets = old_dyn
         cfg.adasum_halving = old_halving
+
+
+def test_unjittable_inner_transform_falls_back_eager(hvd):
+    """ADVICE r2: an inner optax transform that cannot trace (host-side
+    value-dependent control flow / non-array state) must degrade to the
+    eager apply path, not raise from the jitted one."""
+    import optax
+
+    from horovod_tpu.optim.optimizer import DistributedOptimizer
+
+    calls = {"n": 0}
+
+    def init_fn(params):
+        return {"note": "not-an-array", "count": 0}
+
+    def update_fn(updates, state, params=None):
+        calls["n"] += 1
+        # host-side branching on a value — untraceable on purpose
+        lead = jax.tree_util.tree_leaves(updates)[0]
+        if float(np.asarray(lead).ravel()[0]) > -1e30:
+            scaled = jax.tree_util.tree_map(lambda g: -0.1 * g, updates)
+        return scaled, {"note": state["note"], "count": state["count"] + 1}
+
+    opt = DistributedOptimizer(
+        optax.GradientTransformation(init_fn, update_fn))
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    state = opt.init(params)
+    grads = {"w": jnp.ones((3,), jnp.float32)}
+    new_params, state = opt.step(grads, params, state)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 0.9, rtol=1e-6)
+    # second step stays on the (now permanent) eager path
+    new_params, state = opt.step(grads, new_params, state)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 0.8, rtol=1e-6)
+    assert state[-1]["count"] == 2 if isinstance(state, tuple) else True

@@ -198,3 +198,93 @@ def test_spark_elastic_no_agents_times_out(monkeypatch):
     with pytest.raises(TimeoutError, match="agent registered"):
         spe.run_elastic(_make_train_fn(), num_proc=1, start_timeout=1.0,
                         _agent_runner=lambda n, m: None)
+
+
+def test_newer_launch_record_replaces_live_worker(tmp_path):
+    """ADVICE r2: if the kill command for a replaced worker is swallowed
+    (spawn()'s stale-key cleanup races the agent's consumption), the
+    NEWER launch record itself must terminate the old process — a live
+    worker with a newer launch is a replacement, not a survivor."""
+    import cloudpickle
+
+    key = secret_mod.make_secret_key()
+    rdv = RendezvousServer(secret=key.encode())
+    port = rdv.start()
+    stop = threading.Event()
+    try:
+        kv = KVClient("127.0.0.1", port, secret=key.encode())
+
+        marker_dir = str(tmp_path)
+
+        def sleeper():
+            import os
+            import time as _t
+            rnd = os.environ.get("HOROVOD_ELASTIC_ROUND", "?")
+            open(os.path.join(os.environ["MARKER_DIR"],
+                              f"pid_{rnd}_{os.getpid()}"), "w").close()
+            _t.sleep(120)
+            return None
+
+        kv.put(spe._SCOPE, "fn", cloudpickle.dumps(sleeper))
+
+        t = threading.Thread(
+            target=spe.agent_main,
+            args=(KVClient("127.0.0.1", port, secret=key.encode()), 0),
+            kwargs={"stop_event": stop, "poll_interval": 0.05},
+            daemon=True)
+        t.start()
+        # the agent heartbeats its hostname; round records are host-keyed
+        deadline = time.monotonic() + 10
+        host = None
+        while time.monotonic() < deadline and host is None:
+            raw = kv.get(spe._SCOPE, "agent/0", timeout=0)
+            if raw:
+                host = json.loads(raw)["host"]
+            time.sleep(0.05)
+        assert host, "agent never heartbeat"
+
+        def launch(round_id):
+            kv.put(spe._SCOPE, f"launch/{round_id}/{host}",
+                   json.dumps({
+                       "round": round_id, "rank": 0,
+                       "env": {"HOROVOD_ELASTIC_ROUND": str(round_id),
+                               "MARKER_DIR": marker_dir}}).encode())
+            kv.put(spe._SCOPE, "round_hint", str(round_id).encode())
+
+        import os
+
+        def pids(rnd):
+            return [int(f.split("_")[-1]) for f in os.listdir(marker_dir)
+                    if f.startswith(f"pid_{rnd}_")]
+
+        launch(1)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not pids(1):
+            time.sleep(0.1)
+        assert pids(1), "round-1 worker never started"
+        (old_pid,) = pids(1)
+
+        # NO kill key (simulating the swallowed kill) — just a newer
+        # launch record. The agent must terminate the old worker and
+        # start the new one.
+        launch(2)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not pids(2):
+            time.sleep(0.1)
+        assert pids(2), "round-2 worker never started"
+
+        def alive(pid):
+            try:
+                os.kill(pid, 0)
+                return True
+            except ProcessLookupError:
+                return False
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and alive(old_pid):
+            time.sleep(0.1)
+        assert not alive(old_pid), "replaced worker still running"
+        kv.put(spe._SCOPE, "stopall", b"1")
+    finally:
+        stop.set()
+        rdv.stop()
