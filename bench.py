@@ -399,6 +399,7 @@ def bench_autotune(on_cpu):
 
     cfg = topology.raw_state().config
     orig = cfg.fusion_threshold_bytes
+    orig_hier, orig_cache = cfg.hierarchical_allreduce, cfg.cache_capacity
     saved = (cfg.autotune_warmup_samples, cfg.autotune_steps_per_sample,
              cfg.autotune_bayes_opt_max_samples)
     # Tight sampling budget: the bench wants a frozen choice in ~30 steps,
@@ -419,6 +420,7 @@ def bench_autotune(on_cpu):
             if pm.update():
                 clear_compiled_cache()  # threshold changed: new buckets
             steps += 1
+        tuned = pm.frozen_choice()  # >=2-dim frozen decision
         tuned_mb = cfg.fusion_threshold_bytes / (1024 * 1024)
         # Score the frozen choice.
         outs = hvd.grouped_allreduce(tensors, op="sum")
@@ -432,11 +434,15 @@ def bench_autotune(on_cpu):
     finally:
         cfg.autotune = False
         cfg.fusion_threshold_bytes = orig
+        cfg.hierarchical_allreduce, cfg.cache_capacity = \
+            orig_hier, orig_cache
         (cfg.autotune_warmup_samples, cfg.autotune_steps_per_sample,
          cfg.autotune_bayes_opt_max_samples) = saved
         clear_compiled_cache()
     return {"frozen": pm.frozen, "steps": steps,
             "tuned_threshold_mb": round(tuned_mb, 1),
+            "tuned_knobs": {k: (v if not isinstance(v, bool) else int(v))
+                            for k, v in tuned.items()},
             "tuned_ms": round(tuned_ms, 2)}
 
 

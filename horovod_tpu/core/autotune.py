@@ -6,12 +6,15 @@ window in bytes/sec, proposes the next knob setting by GP + expected
 improvement, broadcasts it, and freezes the best after
 HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES.
 
-TPU redesign: the tunables that survive are trace-time knobs — the fusion
-bucket threshold (drives how many psums a grouped reduce compiles to) and
-buffer donation. Cycle time and hierarchical flags have no meaning when
-collectives are compiled. Changing the threshold recompiles (cache miss),
-so the tuner holds each sample longer than the reference's per-cycle
-cadence; scores are steady-state bytes/sec within a sample window.
+TPU redesign: the tunables that survive are the knobs that shape compiled
+programs or their retention — the fusion bucket threshold (how many psums
+a grouped reduce compiles to), hierarchical allreduce on/off (one-hop vs
+RS-ici/AR-dcn/AG-ici decomposition when an ici x dcn mesh is configured),
+and the compiled-executable cache capacity (the ResponseCache analog).
+Cycle time has no meaning when collectives are compiled. Changing a knob
+recompiles (cache miss), so the tuner holds each sample longer than the
+reference's per-cycle cadence; scores are steady-state bytes/sec within a
+sample window.
 """
 
 from __future__ import annotations
@@ -105,8 +108,94 @@ class BayesianOptimization:
 # --------------------------------------------------------------------------
 
 _MB = 1024 * 1024
-_THRESH_LOG2_MIN = math.log2(1 * _MB)
-_THRESH_LOG2_MAX = math.log2(256 * _MB)
+
+
+# --------------------------------------------------------------------------
+# Knobs (reference: parameter_manager.h:58-101 — the reference tunes fusion
+# threshold, cycle time, cache on/off, hierarchical allreduce/allgather and
+# torus; the dimensions that survive the TPU redesign are below. Each knob
+# maps to one coordinate of the GP's [0,1]^d search space.)
+# --------------------------------------------------------------------------
+
+class _Knob:
+    name: str
+    # Does changing this knob alter compiled programs (so the caller must
+    # clear its compiled-executable cache)? Cache capacity does not — the
+    # LRU reads it live at eviction time.
+    recompiles: bool = True
+
+    def get(self, cfg):
+        raise NotImplementedError
+
+    def set(self, cfg, value) -> bool:
+        """Apply; returns True if the config changed."""
+        raise NotImplementedError
+
+    def to_unit(self, value) -> float:
+        raise NotImplementedError
+
+    def from_unit(self, u: float):
+        raise NotImplementedError
+
+
+class _Log2Knob(_Knob):
+    """Continuous knob on a log2 scale."""
+
+    def __init__(self, name: str, attr: str, lo: float, hi: float):
+        self.name, self.attr = name, attr
+        self.lo, self.hi = math.log2(lo), math.log2(hi)
+
+    def get(self, cfg):
+        return int(getattr(cfg, self.attr))
+
+    def set(self, cfg, value) -> bool:
+        changed = int(value) != int(getattr(cfg, self.attr))
+        setattr(cfg, self.attr, int(value))
+        return changed
+
+    def to_unit(self, value) -> float:
+        u = (math.log2(max(value, 1)) - self.lo) / (self.hi - self.lo)
+        return min(max(u, 0.0), 1.0)
+
+    def from_unit(self, u: float):
+        return int(2 ** (self.lo + float(u) * (self.hi - self.lo)))
+
+
+class _BoolKnob(_Knob):
+    def __init__(self, name: str, attr: str):
+        self.name, self.attr = name, attr
+
+    def get(self, cfg):
+        return bool(getattr(cfg, self.attr))
+
+    def set(self, cfg, value) -> bool:
+        changed = bool(value) != bool(getattr(cfg, self.attr))
+        setattr(cfg, self.attr, bool(value))
+        return changed
+
+    def to_unit(self, value) -> float:
+        return 0.75 if value else 0.25
+
+    def from_unit(self, u: float):
+        return float(u) >= 0.5
+
+
+def default_knobs(cfg=None) -> List[_Knob]:
+    knobs: List[_Knob] = [
+        _Log2Knob("fusion_threshold", "fusion_threshold_bytes",
+                  1 * _MB, 256 * _MB),
+    ]
+    # The hierarchical flag only does anything when an ici x dcn mesh is
+    # configured (_hier_usable, ops/collectives.py:360) — on a flat
+    # topology it would be a no-op GP dimension wasting the fixed sample
+    # budget and reporting a meaningless "tuned" decision.
+    if cfg is not None and getattr(cfg, "mesh_shape", ""):
+        knobs.append(_BoolKnob("hierarchical_allreduce",
+                               "hierarchical_allreduce"))
+    cache = _Log2Knob("cache_capacity", "cache_capacity", 16, 4096)
+    cache.recompiles = False
+    knobs.append(cache)
+    return knobs
 
 
 @dataclasses.dataclass
@@ -127,37 +216,35 @@ class ParameterManager:
 
     Drive it from the gradient-reduction hot path:
         pm.record(total_bytes, seconds)   # per reduction
-        if pm.update():                   # True when knobs changed
-            <invalidate compiled cache>
-    Reads/writes config.fusion_threshold_bytes.
+        if pm.update():                   # True when compiled programs
+            <invalidate compiled cache>   # are affected by the change
+    Reads/writes the config fields behind `default_knobs(cfg)`: fusion
+    threshold, cache capacity, and (with an ici x dcn mesh) hierarchical
+    allreduce.
     """
 
-    def __init__(self, config, process_set=None):
+    def __init__(self, config, process_set=None, knobs=None):
         self.cfg = config
         self.enabled = bool(config.autotune)
         self.warmup_remaining = config.autotune_warmup_samples
         self.steps_per_sample = config.autotune_steps_per_sample
         self.max_samples = config.autotune_bayes_opt_max_samples
+        self.knobs = knobs if knobs is not None else default_knobs(config)
         self.bayes = BayesianOptimization(
-            dims=1, noise=config.autotune_gaussian_process_noise)
-        self._current = _Sample(x=self._to_unit(
-            config.fusion_threshold_bytes))
+            dims=len(self.knobs),
+            noise=config.autotune_gaussian_process_noise)
+        self._current = _Sample(x=self._to_unit())
         self._samples_done = 0
         self._frozen = False
         self._log_rows: List[Tuple] = []
 
     # -- knob encoding ------------------------------------------------------
-    @staticmethod
-    def _to_unit(threshold_bytes: int) -> np.ndarray:
-        u = (math.log2(max(threshold_bytes, 1)) - _THRESH_LOG2_MIN) / \
-            (_THRESH_LOG2_MAX - _THRESH_LOG2_MIN)
-        return np.asarray([min(max(u, 0.0), 1.0)])
+    def _to_unit(self) -> np.ndarray:
+        return np.asarray([k.to_unit(k.get(self.cfg)) for k in self.knobs])
 
-    @staticmethod
-    def _from_unit(x: np.ndarray) -> int:
-        log2b = _THRESH_LOG2_MIN + float(x[0]) * \
-            (_THRESH_LOG2_MAX - _THRESH_LOG2_MIN)
-        return int(2 ** log2b)
+    def _decode(self, x: np.ndarray) -> dict:
+        return {k.name: k.from_unit(x[i])
+                for i, k in enumerate(self.knobs)}
 
     # -- hot-path hooks -----------------------------------------------------
     def record(self, nbytes: float, seconds: float) -> None:
@@ -206,7 +293,7 @@ class ParameterManager:
             new_x, self._frozen = self._coordinate_multiprocess(s.x, score)
         else:
             self.bayes.register(s.x, score)
-            self._log_rows.append((self._from_unit(s.x), score))
+            self._log_rows.append((self._decode(s.x), score))
             self._samples_done += 1
             if self._samples_done >= self.max_samples:
                 new_x = self.bayes.xs[int(np.argmax(self.bayes.ys))]
@@ -226,7 +313,7 @@ class ParameterManager:
         from horovod_tpu.optim.functions import broadcast_object
         if topology.rank() == 0:
             self.bayes.register(x, score)
-            self._log_rows.append((self._from_unit(x), score))
+            self._log_rows.append((self._decode(x), score))
             self._samples_done += 1
             if self._samples_done >= self.max_samples:
                 new_x = self.bayes.xs[int(np.argmax(self.bayes.ys))]
@@ -240,10 +327,18 @@ class ParameterManager:
         return np.asarray(new_x_list), frozen
 
     def _apply(self, x: np.ndarray) -> bool:
-        new_thresh = self._from_unit(x)
-        changed = new_thresh != self.cfg.fusion_threshold_bytes
-        self.cfg.fusion_threshold_bytes = new_thresh
-        return changed
+        """Write every knob into the config; True only when a change
+        alters compiled programs (threshold buckets, hierarchical
+        decomposition) — the caller then invalidates its compiled cache.
+        A cache-capacity-only move returns False: the LRU reads capacity
+        live, and a spurious cache clear would bill recompiles to the
+        next sample's score."""
+        vals = self._decode(np.asarray(x))
+        recompile = False
+        for k in self.knobs:
+            if k.set(self.cfg, vals[k.name]):
+                recompile |= k.recompiles
+        return recompile
 
     def _maybe_log(self) -> None:
         # In multi-process mode only rank 0 appends to _log_rows
@@ -252,8 +347,9 @@ class ParameterManager:
             return
         try:
             with open(self.cfg.autotune_log, "a") as f:
-                th, score = self._log_rows[-1]
-                f.write(f"{th}\t{score:.3e}\t"
+                vals, score = self._log_rows[-1]
+                row = "\t".join(f"{k}={v}" for k, v in vals.items())
+                f.write(f"{row}\t{score:.3e}\t"
                         f"{'frozen' if self._frozen else 'tuning'}\n")
         except OSError:
             pass
@@ -264,3 +360,8 @@ class ParameterManager:
 
     def best_threshold(self) -> int:
         return self.cfg.fusion_threshold_bytes
+
+    def frozen_choice(self) -> dict:
+        """The currently-applied knob values (the frozen best once
+        `frozen` is True)."""
+        return {k.name: k.get(self.cfg) for k in self.knobs}

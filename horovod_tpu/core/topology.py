@@ -95,7 +95,12 @@ def _maybe_distributed_init(cfg: Config) -> None:
     """
     if cfg.size is None or cfg.size <= 1:
         return
-    if jax._src.distributed.global_state.client is not None:
+    try:
+        already = jax._src.distributed.global_state.client is not None
+    except AttributeError:  # private API moved: use the public probe
+        already = bool(getattr(jax.distributed, "is_initialized",
+                               lambda: False)())
+    if already:
         return
     # The jax.distributed coordinator must be BOUND BY RANK 0 on rank 0's
     # host. An explicit HOROVOD_COORDINATOR_ADDR env wins (single-host
@@ -154,31 +159,61 @@ def _elastic_distributed_init(coord: str, cfg: Config) -> None:
        empirically); failures surface through the data-plane collectives
        as catchable errors instead.
     """
-    from jax._src import distributed as _dist
-    from jax._src.lib import _jax as _jaxlib
-
-    hb = int(os.environ.get("HOROVOD_ELASTIC_HEARTBEAT_SECONDS", "10"))
-    sd = int(os.environ.get("HOROVOD_ELASTIC_SHUTDOWN_SECONDS", "10"))
-    st = _dist.global_state
+    # Private-API probe: the recoverable client only exists behind
+    # jax._src internals, which any jaxlib bump may move or re-sign.
+    # Probed here (not imported at module scope) with a DOCUMENTED
+    # fallback — jax.distributed.initialize with a non-recoverable
+    # client — so elastic degrades from in-process recovery to
+    # worker-restart recovery instead of crashing at init
+    # (docs/elastic.md "jaxlib compatibility").
+    _dist = _jaxlib = None
+    try:
+        from jax._src import distributed as _dist
+        from jax._src.lib import _jax as _jaxlib
+    except ImportError:
+        pass
+    factory = getattr(_jaxlib, "get_distributed_runtime_client", None)
+    state = getattr(_dist, "global_state", None)
     rank = cfg.rank or 0
-    st.num_processes = cfg.size
-    st.process_id = rank
-    st.coordinator_address = coord
-    client = _jaxlib.get_distributed_runtime_client(
-        coord, rank, init_timeout=300, heartbeat_timeout=hb,
-        shutdown_timeout=sd, use_compression=True, recoverable=True,
-        shutdown_on_destruction=False)
-    client.connect()
-    st.client = client
+    if factory is not None and state is not None:
+        hb = int(os.environ.get("HOROVOD_ELASTIC_HEARTBEAT_SECONDS", "10"))
+        sd = int(os.environ.get("HOROVOD_ELASTIC_SHUTDOWN_SECONDS", "10"))
+        try:
+            client = factory(
+                coord, rank, init_timeout=300, heartbeat_timeout=hb,
+                shutdown_timeout=sd, use_compression=True,
+                recoverable=True, shutdown_on_destruction=False)
+            client.connect()
+            state.num_processes = cfg.size
+            state.process_id = rank
+            state.coordinator_address = coord
+            state.client = client
+            return
+        except TypeError:
+            pass  # jaxlib changed the factory signature — fall back
+    from horovod_tpu.common.hvd_logging import get_logger
+    get_logger().warning(
+        "recoverable jax.distributed client unavailable in this jaxlib "
+        "(private API moved); elastic falls back to a standard client — "
+        "peer failure recovery degrades from in-process reset to full "
+        "worker restart")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=cfg.size, process_id=rank)
 
 
 def distributed_teardown() -> None:
     """Tear down the jax.distributed client/service, tolerating dead peers
     (used by the elastic reset; every step is best-effort because the ring
     may already be half-gone)."""
-    from jax._src import distributed as _dist
-
-    st = _dist.global_state
+    try:
+        from jax._src import distributed as _dist
+        st = _dist.global_state
+    except (ImportError, AttributeError):
+        try:  # private state moved: best-effort public teardown
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        return
     if st.client is None and st.service is None:
         return
     try:

@@ -384,23 +384,39 @@ class DistributedOptimizer:
     def __init__(self, optimizer, named_parameters=None,
                  compression=None, backward_passes_per_step: int = 1,
                  op=Average, gradient_predivide_factor: float = 1.0,
-                 sparse_as_dense: bool = False,
+                 sparse_as_dense: bool = False, groups=None,
                  process_set: Optional[ProcessSet] = None):
         if gradient_predivide_factor != 1.0 and op != Average:
             raise ValueError(
                 "gradient_predivide_factor not supported with op != Average "
                 "(reference: torch/optimizer.py)")
+        if groups is not None:
+            if isinstance(groups, int):
+                if groups < 0:
+                    raise ValueError("groups must be a non-negative integer "
+                                     "or a list of lists of tensors "
+                                     "(reference: torch/optimizer.py:88)")
+            elif not all(isinstance(g, (list, tuple)) for g in groups):
+                raise ValueError("groups must be a non-negative integer or "
+                                 "a list of lists of tensors")
         self.opt = optimizer
         self.op = op
         self.process_set = process_set
         self.compression = compression or Compression.none
         self.gradient_predivide_factor = gradient_predivide_factor
         self.sparse_as_dense = sparse_as_dense
+        self.groups = groups
         self._bpps = backward_passes_per_step
         self._count = 0
         self._handles: dict = {}   # param -> (_Handle, compression ctx)
         self._hooked: set = set()
-        if named_parameters is not None and backward_passes_per_step == 1:
+        # Explicit groups pin which tensors co-fuse into ONE engine call
+        # (one XLA program); the per-parameter hook path would defeat
+        # that, so grouped mode always reduces fused at step time
+        # (reference: optimizer.py:521-575 groups force grouped
+        # allreduce submission).
+        if named_parameters is not None and backward_passes_per_step == 1 \
+                and groups is None:
             self._register_hooks(named_parameters)
 
     def __getattr__(self, name):
@@ -447,6 +463,38 @@ class DistributedOptimizer:
         self._handles.clear()
 
     # -- step-time (fused) mode ---------------------------------------------
+    def _group_plan(self, dense):
+        """Partition `dense` params into per-call fusion groups (reference:
+        torch/optimizer.py:88-165 `groups` — int N splits into N groups;
+        a list of lists pins co-fused tensors, the remainder rides the
+        default plan). Each returned sublist becomes ONE grouped engine
+        call (one XLA program)."""
+        if self.groups is None or not dense:
+            return [dense] if dense else []
+        if isinstance(self.groups, int):
+            if self.groups == 0:
+                return [dense]
+            n = min(self.groups, len(dense))
+            bounds = np.linspace(0, len(dense), n + 1, dtype=int)
+            return [dense[bounds[i]:bounds[i + 1]] for i in range(n)
+                    if bounds[i] < bounds[i + 1]]
+        gid = {}
+        for i, grp in enumerate(self.groups):
+            for p in grp:
+                gid[id(p)] = i
+        plans: dict = {}
+        rest = []
+        for p in dense:
+            g = gid.get(id(p))
+            if g is None:
+                rest.append(p)
+            else:
+                plans.setdefault(g, []).append(p)
+        out = [plans[g] for g in sorted(plans)]
+        if rest:
+            out.append(rest)
+        return out
+
     def _reduce_grads(self, exclude=()) -> None:
         dense, sparse = [], []
         for group in self.opt.param_groups:
@@ -461,14 +509,14 @@ class DistributedOptimizer:
                         sparse.append(p)
                 else:
                     dense.append(p)
-        if dense:
-            pre, post = self._scales()
-            pairs = [self.compression.compress(p.grad.data) for p in dense]
+        pre, post = self._scales()
+        for plan in self._group_plan(dense):
+            pairs = [self.compression.compress(p.grad.data) for p in plan]
             reduced = grouped_allreduce(
                 [t for t, _ in pairs], op=self.op,
                 prescale_factor=pre, postscale_factor=post,
                 process_set=self.process_set)
-            for p, r, (_, ctx) in zip(dense, reduced, pairs):
+            for p, r, (_, ctx) in zip(plan, reduced, pairs):
                 p.grad.data.copy_(self.compression.decompress(r, ctx))
         for p in sparse:
             p.grad = _sparse_allreduce(

@@ -164,6 +164,11 @@ class NativeKVClient:
         import time
         deadline = time.monotonic() + timeout
         payload = int(expected).to_bytes(8, "little", signed=True)
+        # Escalating backoff: the common case (consistency agreement on
+        # every eager collective) completes within a few hundred µs of
+        # the last rank's contribution — a flat 5 ms sleep would tax
+        # EVERY collective by one interval. Spin fine first, then yield.
+        delay = 0.0002
         while time.monotonic() < deadline:
             out = ctypes.create_string_buffer(maxlen)
             st = self._lib.hvdn_kv_request(
@@ -174,7 +179,8 @@ class NativeKVClient:
                     self._h, OP_GETC, key.encode(), payload, 8, out, int(st))
             if st >= 0:
                 return out.raw[:st]
-            time.sleep(0.005)
+            time.sleep(delay)
+            delay = min(delay * 2, 0.005)
         return None
 
     def barrier(self, name: str, size: int, timeout: float = 60.0) -> bool:

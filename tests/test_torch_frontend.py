@@ -241,3 +241,88 @@ def test_torch_backward_passes_per_step_defers_apply(hvd):
     (p * 2.0).sum().backward()                # grads accumulate: 1 + 2
     opt.step()
     np.testing.assert_allclose(p.detach().numpy(), -3.0, rtol=1e-6)
+
+
+def test_optimizer_explicit_groups_plan(hvd):
+    """`groups=[[...]]` pins co-fused tensors into one engine call each;
+    `groups=N` splits into N calls (VERDICT r2 #6; reference:
+    torch/optimizer.py:88-165)."""
+    import horovod_tpu.frontends.torch as thvd
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.Linear(8, 8), torch.nn.Linear(8, 2))
+    params = [p for p in model.parameters()]
+
+    def run_step(opt):
+        calls = []
+        orig = thvd.grouped_allreduce
+
+        def spy(tensors, **kw):
+            calls.append(len(tensors))
+            return orig(tensors, **kw)
+
+        thvd.grouped_allreduce = spy
+        try:
+            opt.zero_grad()
+            loss = model(torch.ones(3, 4)).sum()
+            loss.backward()
+            opt.step()
+        finally:
+            thvd.grouped_allreduce = orig
+        return calls
+
+    # explicit list groups: [w0,b0] together, [w1] alone, rest defaulted
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        groups=[[params[0], params[1]], [params[2]]])
+    calls = run_step(opt)
+    # 3 calls: group0 (2 tensors), group1 (1), remainder (3)
+    assert calls == [2, 1, 3], calls
+
+    # groups=N: N calls covering all 6 tensors
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01), groups=2)
+    calls = run_step(opt)
+    assert len(calls) == 2 and sum(calls) == 6, calls
+
+    # groups=0 behaves like default single fused call
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01), groups=0)
+    calls = run_step(opt)
+    assert calls == [6], calls
+
+    with pytest.raises(ValueError, match="groups"):
+        thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.01), groups=-1)
+    with pytest.raises(ValueError, match="groups"):
+        thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.01),
+            groups=[params[0]])  # not a list of lists
+
+
+def test_optimizer_groups_numerics(hvd):
+    """Grouped plans must not change results: reduced grads equal the
+    ungrouped reduction (identical ranks -> local grads)."""
+    import horovod_tpu.frontends.torch as thvd
+
+    torch.manual_seed(7)
+    model = torch.nn.Linear(5, 3)
+    x = torch.randn(4, 5)
+
+    def grads_with(group_fn):
+        m = torch.nn.Linear(5, 3)
+        m.load_state_dict(model.state_dict())
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.0),
+            groups=group_fn(m) if group_fn else None)
+        opt.zero_grad()
+        m(x).sum().backward()
+        opt.step()
+        return [p.grad.clone() for p in m.parameters()]
+
+    base = grads_with(None)
+    for group_fn in (lambda m: 2,
+                     lambda m: [[next(iter(m.parameters()))]]):
+        got = grads_with(group_fn)
+        for a, b in zip(base, got):
+            torch.testing.assert_close(a, b)
