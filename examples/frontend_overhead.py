@@ -1,0 +1,175 @@
+"""Measure the frontend shims' per-step cost against the native JAX path.
+
+The torch/TF frontends route every collective through host numpy and the
+eager engine (a deliberate parity shim — reference users keep their
+training loop unchanged). This script quantifies what that costs on an
+MNIST-shaped MLP (784-128-10, batch 64) so migration users can decide
+when to move the training step to the native JAX path.
+
+Usage:  python examples/frontend_overhead.py [--steps 50] [--platform cpu]
+Prints a markdown table (the one in docs/frontends.md).
+"""
+
+import argparse
+import time
+
+
+def timed(fn, steps, warmup=5):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fn()
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def bench_native_jax(steps, make_batch):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
+
+    k = hvd.size()
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    params = {"w1": jax.random.normal(k1, (784, 128), jnp.float32) * 0.05,
+              "b1": jnp.zeros((128,)),
+              "w2": jax.random.normal(k2, (128, 10), jnp.float32) * 0.05,
+              "b2": jnp.zeros((10,))}
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    xb, yb = make_batch()
+    xb, yb = jnp.asarray(xb), jnp.asarray(yb)
+
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.core import topology
+
+    mesh = topology.mesh()
+
+    def local_step(params, opt_state, xb, yb):
+        def loss(p):
+            h = jax.nn.relu(xb @ p["w1"] + p["b1"])
+            logits = h @ p["w2"] + p["b2"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1))
+
+        l, g = jax.value_and_grad(loss)(params)
+        g = reduce_gradients_in_jit(g, num_ranks=k)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state,
+                jax.lax.pmean(l, "hvd"))
+
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+    state = {"p": params, "o": opt_state}
+
+    def one():
+        state["p"], state["o"], l = step(state["p"], state["o"], xb, yb)
+        float(l)
+
+    return timed(one, steps)
+
+
+def bench_torch_frontend(steps, make_batch):
+    import torch
+
+    import horovod_tpu.frontends.torch as hvd
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(784, 128), torch.nn.ReLU(),
+        torch.nn.Linear(128, 10))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    xb, yb = make_batch()
+    xb = torch.from_numpy(xb)
+    yb = torch.from_numpy(yb)
+
+    def one():
+        opt.zero_grad()
+        loss = loss_fn(model(xb), yb)
+        loss.backward()
+        opt.step()
+        float(loss.detach())
+
+    return timed(one, steps)
+
+
+def bench_tf_frontend(steps, make_batch):
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as hvd
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10)])
+    opt = tf.keras.optimizers.SGD(0.1)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+    xb, yb = make_batch()
+    xb = tf.constant(xb)
+    yb = tf.constant(yb)
+    model(xb)  # build
+    hvd.broadcast_variables(model.variables, root_rank=0)
+
+    def one():
+        with tf.GradientTape() as tape:
+            loss = loss_fn(yb, model(xb))
+        dtape = hvd.DistributedGradientTape(tape)
+        grads = dtape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        float(loss)
+
+    return timed(one, steps)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--platform", default=None,
+                    help="jax platform override (e.g. cpu)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    import horovod_tpu as hvd
+    hvd.init()
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return (rng.standard_normal((64, 784)).astype(np.float32),
+                rng.integers(0, 10, (64,)).astype(np.int64))
+
+    rows = [("native JAX (jit step)", bench_native_jax(args.steps,
+                                                       make_batch))]
+    for name, fn in (("torch frontend (eager shim)", bench_torch_frontend),
+                     ("TF frontend (eager shim)", bench_tf_frontend)):
+        try:
+            rows.append((name, fn(args.steps, make_batch)))
+        except ImportError as e:
+            print(f"[skipped] {name}: {e}")
+
+    base = rows[0][1]
+    print(f"\nMNIST MLP 784-128-10, batch 64, {args.steps} steps, "
+          f"1 process:\n")
+    print("| path | step ms | vs native |")
+    print("|---|---|---|")
+    for name, ms in rows:
+        print(f"| {name} | {ms:.2f} | {ms / base:.1f}x |")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,3 +67,12 @@ def test_data_service_example():
     w = [float(v) for v in m.group(1).split(",")]
     import numpy as _np
     assert _np.allclose(w, [1.0, -2.0, 0.5, 3.0], atol=0.35), (w, out)
+
+
+def test_frontend_overhead_example():
+    pytest.importorskip("torch")
+    pytest.importorskip("tensorflow")
+    out = _run_example("frontend_overhead.py", "--steps", "3")
+    assert "native JAX" in out and "vs native" in out, out
+    assert "torch frontend" in out and "TF frontend" in out, out
+    assert "[skipped]" not in out, out
