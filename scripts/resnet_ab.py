@@ -1,0 +1,90 @@
+"""A/B the ResNet step in one window: current model vs variants.
+
+Run when the tunnel is healthy (scripts/watch_and_profile.sh gates on
+the calibration matmul). Everything is timed inside a device-side scan
+with all arrays in the carry.
+"""
+import sys
+import time
+
+sys.path[:0] = ["/root/repo", "/root/.axon_site"]
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from horovod_tpu.models import resnet
+
+B, IMG, DT = 128, 224, jnp.bfloat16
+
+
+def cal():
+    a8 = jax.random.normal(jax.random.PRNGKey(1), (8192, 8192), jnp.bfloat16)
+    f = jax.jit(lambda a: lax.scan(
+        lambda x, _: ((x @ a) * 1e-2, ()), a, None, length=10)[0])
+    out = f(a8)
+    jax.block_until_ready(out)
+    np.asarray(out[0, :1])
+    t0 = time.perf_counter()
+    out = f(a8)
+    jax.block_until_ready(out)
+    np.asarray(out[0, :1])
+    return round(2 * 8192 ** 3 * 10 / (time.perf_counter() - t0) / 1e12)
+
+
+def scan_step(step, state, K=10, reps=3):
+    body = jax.jit(lambda s: lax.scan(
+        lambda c, _: (step(c), ()), s, None, length=K)[0],
+        donate_argnums=(0,))
+    out = body(state)
+    jax.block_until_ready(out)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = body(out)
+        jax.block_until_ready(out)
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        best = min(best, (time.perf_counter() - t0) / K)
+    return best * 1e3
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(
+        rng.standard_normal((B, IMG, IMG, 3), np.float32), DT))
+    y = jax.device_put(jnp.asarray(rng.integers(0, 1000, (B,))))
+    params, stats = resnet.init(jax.random.PRNGKey(0), depth=50,
+                                num_classes=1000, dtype=DT)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+
+    def loss(p, s, xx, yy):
+        return resnet.loss_fn(p, s, (xx, yy), depth=50, train=True)
+
+    def full(c):
+        p, s, o, xx, yy, _ = c
+        (l, ns), g = jax.value_and_grad(loss, has_aux=True)(p, s, xx, yy)
+        u, o = opt.update(g, o, p)
+        return (optax.apply_updates(p, u), ns, o, xx, yy, l)
+
+    print("cal pre:", cal(), "TF/s")
+    st = (params, stats, opt_state, x, y, jnp.zeros(()))
+    dt = scan_step(full, st)
+    print(f"full step: {dt:.2f} ms  {B/dt*1e3:.0f} img/s  "
+          f"MFU {B/dt*1e3*12.3e9/197e12:.3f}")
+
+    def fwd(c):
+        p, s, xx, yy, _ = c
+        l, ns = loss(p, s, xx, yy)
+        return (p, ns, xx, yy, l)
+
+    dt_f = scan_step(fwd, (params, stats, x, y, jnp.zeros(())))
+    print(f"fwd only: {dt_f:.2f} ms")
+    print("cal post:", cal(), "TF/s")
+
+
+if __name__ == "__main__":
+    main()

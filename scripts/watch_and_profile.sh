@@ -1,0 +1,20 @@
+#!/bin/bash
+# Poll device health; when the tunnel window is healthy (>80 TF/s on the
+# 8k matmul scan), run the ResNet A/B profile once and save it.
+OUT=/tmp/resnet_ab_healthy.txt
+for i in $(seq 1 40); do
+  H=$(python - <<'EOF' 2>/dev/null
+import sys; sys.path[:0] = ["/root/repo", "/root/.axon_site"]
+import bench
+print(bench._device_health())
+EOF
+)
+  echo "$(date +%H:%M:%S) health=$H" >> ${OUT}.log
+  if python -c "import sys; sys.exit(0 if float('$H' or 0) > 80 else 1)" 2>/dev/null; then
+    echo "HEALTHY window at $(date)" >> $OUT
+    python /root/repo/scripts/resnet_ab.py >> $OUT 2>&1
+    exit 0
+  fi
+  sleep 300
+done
+echo "no healthy window found" >> $OUT
