@@ -307,7 +307,13 @@ def _replicated_reduce_one(x: jax.Array, op: T.ReduceOp, k: int,
         y = x
     elif op == T.ReduceOp.PRODUCT:
         y = x ** k
-    else:  # pragma: no cover - callers gate ADASUM out
+    elif op == T.ReduceOp.ADASUM:
+        # Adasum of identical vectors is the vector itself: combine(a,a)
+        # has dot = |a|^2 = na = nb, so a·(1 - dot/(2na)) + a·(1 -
+        # dot/(2nb)) = a — at every VHDD level (adasum.h:195's combine is
+        # idempotent on equal inputs, and the non-pow2 fold likewise).
+        y = x
+    else:  # pragma: no cover - all ops handled above
         raise HorovodTpuError(f"unsupported replicated reduce {op}")
     if postscale != 1.0:
         y = y * jnp.asarray(postscale, y.dtype)
@@ -318,7 +324,10 @@ def _replicated_fast_ok(ps: ProcessSet, rop: T.ReduceOp, hm,
                         tensors) -> bool:
     """Eligibility for the identical-contributions closed form: one
     process (multi-process inputs genuinely differ per rank), no
-    hierarchical mesh, not Adasum, and no stacked per-slot inputs.
+    hierarchical mesh, and no stacked per-slot inputs. Adasum qualifies
+    too — its combine is idempotent on identical inputs (see
+    _replicated_reduce_one) — which matters because the full path's
+    per-tensor lift dominates eager Adasum optimizer steps.
     HOROVOD_NO_REPLICATED_FAST=1 forces the full collective machinery
     (used by benchmarks that measure it)."""
     from horovod_tpu.common.config import _env_bool
@@ -326,8 +335,6 @@ def _replicated_fast_ok(ps: ProcessSet, rop: T.ReduceOp, hm,
     if _env_bool("HOROVOD_NO_REPLICATED_FAST"):
         return False
     if jax.process_count() != 1 or hm is not None:
-        return False
-    if rop == T.ReduceOp.ADASUM:
         return False
     L = _local_member_count(ps)
     return not any(_is_stacked(t, ps, L) for t in tensors)

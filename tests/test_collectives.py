@@ -290,6 +290,7 @@ def test_replicated_fast_path_matches_full_machinery(hvd, monkeypatch):
           np.float32(2.5)]
     cases = [dict(op="sum"), dict(op="average"),
              dict(op="min"), dict(op="max"), dict(op="product"),
+             dict(op="adasum"),
              dict(op="average", prescale_factor=0.5,
                   postscale_factor=2.0)]
     for case in cases:
@@ -308,8 +309,11 @@ def test_replicated_fast_path_matches_full_machinery(hvd, monkeypatch):
 
 
 def test_replicated_fast_path_gating(hvd, monkeypatch):
-    """The closed form must NOT fire for stacked inputs, Adasum, or when
-    the escape hatch is set — those paths carry real collectives."""
+    """The closed form must NOT fire for stacked inputs or when the
+    escape hatch is set — those paths carry real collectives. Adasum of
+    replicated inputs IS eligible (its combine is idempotent on equal
+    vectors), which is what keeps eager Adasum optimizer steps from
+    paying a per-tensor lift."""
     import numpy as np
 
     from horovod_tpu.core.process_sets import global_process_set
@@ -322,7 +326,8 @@ def test_replicated_fast_path_gating(hvd, monkeypatch):
     stacked = np.ones((k, 3), np.float32)  # leading dim == local slots
     assert C._replicated_fast_ok(ps, T.ReduceOp.SUM, None, (plain,))
     assert not C._replicated_fast_ok(ps, T.ReduceOp.SUM, None, (stacked,))
-    assert not C._replicated_fast_ok(ps, T.ReduceOp.ADASUM, None, (plain,))
+    assert C._replicated_fast_ok(ps, T.ReduceOp.ADASUM, None, (plain,))
+    assert not C._replicated_fast_ok(ps, T.ReduceOp.ADASUM, None, (stacked,))
     assert not C._replicated_fast_ok(ps, T.ReduceOp.SUM, object(), (plain,))
     monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "1")
     assert not C._replicated_fast_ok(ps, T.ReduceOp.SUM, None, (plain,))
