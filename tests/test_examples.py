@@ -76,3 +76,12 @@ def test_frontend_overhead_example():
     assert "native JAX" in out and "vs native" in out, out
     assert "torch frontend" in out and "TF frontend" in out, out
     assert "[skipped]" not in out, out
+
+
+def test_tf_keras_fit_example():
+    """compile+fit with the distributed optimizer and callbacks — the
+    reference's canonical Keras workflow (keras_mnist.py)."""
+    pytest.importorskip("tensorflow")
+    pytest.importorskip("keras")
+    out = _run_example("tf_keras_fit_mnist.py")
+    assert "final accuracy" in out, out
