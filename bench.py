@@ -516,15 +516,19 @@ def main():
     health = None
     if not on_cpu:
         # If the tunnel/device window is degraded, wait for it to recover
-        # (bounded): a bench captured in a bad window undersells every
-        # number by the same factor.
-        for attempt in range(4):
+        # (bounded, ~12 min worst case): a bench captured in a bad window
+        # undersells every number by the same factor, and this is the
+        # round's one driver-recorded capture. Degradation is episodic
+        # HBM/tunnel contention — small-working-set programs (the LM) are
+        # unaffected while big-buffer ops (ResNet, the 8k matmul probe)
+        # slow ~3x.
+        for attempt in range(8):
             health = _section("device_health", _device_health, retries=0)
-            if health is None or health > 80.0 or attempt == 3:
+            if health is None or health > 80.0 or attempt == 7:
                 break
             print(f"[bench] device window degraded ({health:.0f} TF/s "
-                  f"matmul); waiting 60s", flush=True)
-            time.sleep(60)
+                  f"matmul); waiting 90s", flush=True)
+            time.sleep(90)
 
     # --- ResNet-50: per-chip batch sweep, report the best ---
     # Each sweep point is individually guarded: one OOM/tunnel failure
