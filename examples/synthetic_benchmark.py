@@ -19,20 +19,22 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.core import topology
-from horovod_tpu.models import resnet
+from horovod_tpu.models import inception, resnet, vgg
 from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
 
 
 def parse_args():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "resnet101", "resnet152"])
+                   choices=["resnet50", "resnet101", "resnet152",
+                            "vgg16", "vgg19", "inception3"])
     p.add_argument("--batch-size", type=int, default=32,
                    help="per-rank batch size")
     p.add_argument("--num-warmup-batches", type=int, default=2)
     p.add_argument("--num-batches-per-iter", type=int, default=5)
     p.add_argument("--num-iters", type=int, default=3)
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="default: 299 for inception3, else 224")
     p.add_argument("--fp16-allreduce", action="store_true")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["float32", "bfloat16"])
@@ -44,11 +46,29 @@ def main():
     hvd.init()
     mesh = topology.mesh()
     k = hvd.size()
-    depth = int(args.model.replace("resnet", ""))
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.image_size is None:
+        args.image_size = 299 if args.model == "inception3" else 224
 
-    params, stats = resnet.init(jax.random.PRNGKey(0), depth=depth,
-                                dtype=dtype)
+    # One loss_maker signature across families: (params, stats, batch) ->
+    # (loss, new_stats). VGG has no BN state (stats = empty dict).
+    if args.model.startswith("resnet"):
+        depth = int(args.model.replace("resnet", ""))
+        params, stats = resnet.init(jax.random.PRNGKey(0), depth=depth,
+                                    dtype=dtype)
+        loss_maker = lambda p, s, b: resnet.loss_fn(  # noqa: E731
+            p, s, b, depth=depth, train=True, axis_name="hvd")
+    elif args.model.startswith("vgg"):
+        vdepth = int(args.model.replace("vgg", ""))
+        params = vgg.init(jax.random.PRNGKey(0), depth=vdepth, dtype=dtype,
+                          image_size=args.image_size)  # noqa: E501
+        stats = {}
+        loss_maker = lambda p, s, b: (  # noqa: E731
+            vgg.loss_fn(p, b, depth=vdepth), s)
+    else:  # inception3 — canonical input is 299x299
+        params, stats = inception.init(jax.random.PRNGKey(0), dtype=dtype)
+        loss_maker = lambda p, s, b: inception.loss_fn(  # noqa: E731
+            p, s, b, train=True, axis_name="hvd")
     opt = optax.sgd(0.01 * k, momentum=0.9)
     opt_state = opt.init(params)
 
@@ -58,8 +78,7 @@ def main():
 
     def local_step(params, stats, opt_state, batch):
         def loss(p):
-            return resnet.loss_fn(p, stats, batch, depth=depth, train=True,
-                                  axis_name="hvd")
+            return loss_maker(p, stats, batch)
         (l, ns), g = jax.value_and_grad(loss, has_aux=True)(params)
         g = reduce_gradients_in_jit(g, num_ranks=k, compression=compression)
         updates, opt_state = opt.update(g, opt_state, params)

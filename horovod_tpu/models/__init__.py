@@ -8,4 +8,6 @@ Models are plain functional JAX: `init(key, ...) -> params` pytrees and
 pure `apply` functions — idiomatic for pjit/shard_map, no framework layer.
 """
 
-from horovod_tpu.models import mlp, resnet, transformer  # noqa: F401
+from horovod_tpu.models import (  # noqa: F401
+    inception, mlp, resnet, transformer, vgg,
+)

@@ -1,0 +1,270 @@
+"""Inception V3 — the reference's top headline scaling model
+(reference: README.rst:102-108 — 90% scaling efficiency for Inception V3
+on 512 GPUs is THE Horovod result; docs/benchmarks.rst tf_cnn_benchmarks
+recipe).
+
+TPU-first choices mirror models/resnet.py: NHWC + bf16 convs, functional
+BN returning (out, new_stats), no Python control flow on data. The
+asymmetric 1x7/7x1 factorized convs tile the MXU fine in NHWC. The
+training-only auxiliary classifier head is omitted (synthetic-benchmark
+scope; torchvision's aux_logits=False equivalent).
+
+Channel plan follows the canonical V3 (torchvision inception_v3 /
+Szegedy et al. 2015): 299x299 input, stem to 35x35x192, 3x InceptionA,
+ReductionA, 4x InceptionB, ReductionB, 2x InceptionC, global avg pool,
+fc 2048->classes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.models.resnet import batch_norm
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * \
+        (2.0 / fan_in) ** 0.5
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_stats(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+# Each conv is (kh, kw, cout, stride, padding) — padding "SAME"/"VALID".
+# A block is {branch_name: [conv, conv, ...]}; branches concatenate on C.
+# "pool" / "avgpool" pseudo-convs insert a 3x3 max/avg pool first.
+
+def _stem_plan():
+    return [("c0", 3, 3, 32, 2, "VALID"), ("c1", 3, 3, 32, 1, "VALID"),
+            ("c2", 3, 3, 64, 1, "SAME"), ("maxpool", 0, 0, 0, 2, ""),
+            ("c3", 1, 1, 80, 1, "VALID"), ("c4", 3, 3, 192, 1, "VALID"),
+            ("maxpool", 0, 0, 0, 2, "")]
+
+
+def _inception_a(pool_feat):
+    return {
+        "b1x1": [(1, 1, 64, 1, "SAME")],
+        "b5x5": [(1, 1, 48, 1, "SAME"), (5, 5, 64, 1, "SAME")],
+        "b3x3dbl": [(1, 1, 64, 1, "SAME"), (3, 3, 96, 1, "SAME"),
+                    (3, 3, 96, 1, "SAME")],
+        "bpool": ["avgpool", (1, 1, pool_feat, 1, "SAME")],
+    }
+
+
+def _reduction_a():
+    return {
+        "b3x3": [(3, 3, 384, 2, "VALID")],
+        "b3x3dbl": [(1, 1, 64, 1, "SAME"), (3, 3, 96, 1, "SAME"),
+                    (3, 3, 96, 2, "VALID")],
+        "bpool": ["maxpool"],
+    }
+
+
+def _inception_b(c7):
+    return {
+        "b1x1": [(1, 1, 192, 1, "SAME")],
+        "b7x7": [(1, 1, c7, 1, "SAME"), (1, 7, c7, 1, "SAME"),
+                 (7, 1, 192, 1, "SAME")],
+        "b7x7dbl": [(1, 1, c7, 1, "SAME"), (7, 1, c7, 1, "SAME"),
+                    (1, 7, c7, 1, "SAME"), (7, 1, c7, 1, "SAME"),
+                    (1, 7, 192, 1, "SAME")],
+        "bpool": ["avgpool", (1, 1, 192, 1, "SAME")],
+    }
+
+
+def _reduction_b():
+    return {
+        "b3x3": [(1, 1, 192, 1, "SAME"), (3, 3, 320, 2, "VALID")],
+        "b7x7x3": [(1, 1, 192, 1, "SAME"), (1, 7, 192, 1, "SAME"),
+                   (7, 1, 192, 1, "SAME"), (3, 3, 192, 2, "VALID")],
+        "bpool": ["maxpool"],
+    }
+
+
+def _inception_c():
+    # b3x3 and b3x3dbl each END in a pair of parallel (1,3)/(3,1) convs
+    # whose outputs concatenate — encoded as a "split" tail.
+    return {
+        "b1x1": [(1, 1, 320, 1, "SAME")],
+        "b3x3": [(1, 1, 384, 1, "SAME"),
+                 ("split", (1, 3, 384, 1, "SAME"), (3, 1, 384, 1, "SAME"))],
+        "b3x3dbl": [(1, 1, 448, 1, "SAME"), (3, 3, 384, 1, "SAME"),
+                    ("split", (1, 3, 384, 1, "SAME"),
+                     (3, 1, 384, 1, "SAME"))],
+        "bpool": ["avgpool", (1, 1, 192, 1, "SAME")],
+    }
+
+
+_BLOCKS = (
+    [("a0", _inception_a(32)), ("a1", _inception_a(64)),
+     ("a2", _inception_a(64)), ("ra", _reduction_a()),
+     ("b0", _inception_b(128)), ("b1", _inception_b(160)),
+     ("b2", _inception_b(160)), ("b3", _inception_b(192)),
+     ("rb", _reduction_b()), ("c0", _inception_c()),
+     ("c1", _inception_c())])
+
+
+def _iter_convs(plan):
+    for step in plan:
+        if step in ("avgpool", "maxpool"):
+            continue
+        if step[0] == "split":
+            yield from step[1:]
+        else:
+            yield step
+
+
+def init(key: jax.Array, num_classes: int = 1000,
+         dtype=jnp.float32) -> Tuple[Dict, Dict]:
+    """Returns (params, batch_stats)."""
+    params: Dict = {"stem": {}}
+    stats: Dict = {"stem": {}}
+    cin = 3
+    for name, kh, kw, cout, _s, _p in _stem_plan():
+        if name == "maxpool":
+            continue
+        key, k1 = jax.random.split(key)
+        params["stem"][name] = {"w": _conv_init(k1, kh, kw, cin, cout,
+                                                dtype),
+                                "bn": _bn_init(cout, dtype)}
+        stats["stem"][name] = _bn_stats(cout)
+        cin = cout
+    for bname, spec in _BLOCKS:
+        bp: Dict = {}
+        bs: Dict = {}
+        c_out_total = 0
+        for br, plan in spec.items():
+            c = cin
+            convs = []
+            cstats = []
+            for kh, kw, cout, _s, _p in _iter_convs(plan):
+                key, k1 = jax.random.split(key)
+                convs.append({"w": _conv_init(k1, kh, kw, c, cout, dtype),
+                              "bn": _bn_init(cout, dtype)})
+                cstats.append(_bn_stats(cout))
+                c = cout
+            # split tails: both arms read the SAME input channel count
+            if plan and isinstance(plan[-1], tuple) and \
+                    plan[-1][0] == "split":
+                arms = plan[-1][1:]
+                c = sum(a[2] for a in arms)
+                # fix the second arm's cin (built above with chained c)
+                pre_c = (convs[-3]["w"].shape[-1]
+                         if len(convs) >= 3 else cin)
+                key, k1 = jax.random.split(key)
+                a2 = arms[1]
+                convs[-1] = {"w": _conv_init(k1, a2[0], a2[1], pre_c,
+                                             a2[2], dtype),
+                             "bn": _bn_init(a2[2], dtype)}
+                cstats[-1] = _bn_stats(a2[2])
+            bp[br] = convs
+            bs[br] = cstats
+            c_out_total += c
+        params[bname] = bp
+        stats[bname] = bs
+        cin = c_out_total
+    key, kf = jax.random.split(key)
+    params["fc"] = {"w": jax.random.normal(kf, (cin, num_classes), dtype) *
+                    cin ** -0.5,
+                    "b": jnp.zeros((num_classes,), dtype)}
+    return params, stats
+
+
+def _conv(x, w, stride, padding):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _pool(x, kind, stride=1, padding="SAME"):
+    if kind == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                                 (1, stride, stride, 1), padding)
+    # literal 0. init so JAX recognizes the differentiable
+    # reduce-window-sum monoid (a non-literal init has no transpose rule)
+    s = lax.reduce_window(x, 0.0, lax.add, (1, 3, 3, 1),
+                          (1, stride, stride, 1), padding)
+    if padding == "SAME":
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, (1, 3, 3, 1),
+                                (1, stride, stride, 1), padding)
+        return (s / cnt).astype(x.dtype)
+    return (s / 9.0).astype(x.dtype)
+
+
+def apply(params, stats, x: jax.Array, train: bool = True,
+          axis_name=None) -> Tuple[jax.Array, Dict]:
+    """x: (N, 299, 299, 3) NHWC. Returns (logits, new_batch_stats)."""
+    bn = functools.partial(batch_norm, train=train, axis_name=axis_name)
+    new_stats: Dict = {"stem": {}}
+    h = x
+    for name, _kh, _kw, _cout, s, p in _stem_plan():
+        if name == "maxpool":
+            h = _pool(h, "max", stride=2, padding="VALID")
+            continue
+        blk = params["stem"][name]
+        h = _conv(h, blk["w"], s, p)
+        h, new_stats["stem"][name] = bn(h, blk["bn"],
+                                        stats["stem"][name])
+        h = jax.nn.relu(h)
+    for bname, spec in _BLOCKS:
+        outs = []
+        ns: Dict = {}
+        for br, plan in spec.items():
+            y = h
+            ci = 0
+            nst = []
+            for step in plan:
+                if step == "avgpool":
+                    y = _pool(y, "avg")
+                    continue
+                if step == "maxpool":
+                    y = _pool(y, "max", stride=2, padding="VALID")
+                    continue
+                if step[0] == "split":
+                    arms_out = []
+                    for arm in step[1:]:
+                        kh, kw, cout, s, p = arm
+                        blk = params[bname][br][ci]
+                        a = _conv(y, blk["w"], s, p)
+                        a, st = bn(a, blk["bn"], stats[bname][br][ci])
+                        nst.append(st)
+                        arms_out.append(jax.nn.relu(a))
+                        ci += 1
+                    y = jnp.concatenate(arms_out, axis=-1)
+                    continue
+                kh, kw, cout, s, p = step
+                blk = params[bname][br][ci]
+                y = _conv(y, blk["w"], s, p)
+                y, st = bn(y, blk["bn"], stats[bname][br][ci])
+                nst.append(st)
+                y = jax.nn.relu(y)
+                ci += 1
+            ns[br] = nst
+            outs.append(y)
+        h = jnp.concatenate(outs, axis=-1)
+        new_stats[bname] = ns
+    h = jnp.mean(h, axis=(1, 2))
+    logits = h @ params["fc"]["w"] + params["fc"]["b"]
+    return logits, new_stats
+
+
+def loss_fn(params, stats, batch, train: bool = True, axis_name=None):
+    x, y = batch
+    logits, new_stats = apply(params, stats, x, train=train,
+                              axis_name=axis_name)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    return loss, new_stats

@@ -1,0 +1,86 @@
+"""VGG-16/19 — one of the reference's three headline scaling models
+(reference: README.rst:108 reports 68% scaling efficiency for VGG-16 on
+512 GPUs; docs/benchmarks.rst tf_cnn_benchmarks recipe).
+
+TPU-first choices mirror models/resnet.py: NHWC + bf16 convs for the
+MXU, functional apply (no mutable state — VGG has no batch norm in its
+classic form), one traced graph end to end. The classifier head's two
+4096-wide FC layers are where VGG's parameters live (~90%), which is
+exactly why its gradient allreduce is the reference's hardest scaling
+case — a useful stress shape for fusion/bucketing work.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Stage plans: (convs per stage, channels); pooling after each stage.
+STAGE_PLANS = {
+    16: ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)),
+    19: ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512)),
+}
+
+
+def _conv_init(key, cin, cout, dtype):
+    fan_in = 9 * cin
+    return jax.random.normal(key, (3, 3, cin, cout), dtype) * \
+        (2.0 / fan_in) ** 0.5
+
+
+def init(key: jax.Array, depth: int = 16, num_classes: int = 1000,
+         dtype=jnp.float32, image_size: int = 224) -> Dict:
+    plan = STAGE_PLANS[depth]
+    params: Dict = {}
+    cin = 3
+    for s, (n, cout) in enumerate(plan):
+        for b in range(n):
+            key, k1 = jax.random.split(key)
+            params[f"s{s}c{b}"] = {
+                "w": _conv_init(k1, cin, cout, dtype),
+                "b": jnp.zeros((cout,), dtype),
+            }
+            cin = cout
+    feat = (image_size // 2 ** len(plan)) ** 2 * cin
+    dims = (feat, 4096, 4096, num_classes)
+    for i in range(3):
+        key, k1 = jax.random.split(key)
+        params[f"fc{i}"] = {
+            "w": jax.random.normal(k1, (dims[i], dims[i + 1]), dtype) *
+            dims[i] ** -0.5,
+            "b": jnp.zeros((dims[i + 1],), dtype),
+        }
+    return params
+
+
+def apply(params: Dict, x: jax.Array, depth: int = 16) -> jax.Array:
+    """x: (N, H, W, 3) NHWC -> logits (N, num_classes)."""
+    plan = STAGE_PLANS[depth]
+    h = x
+    for s, (n, _cout) in enumerate(plan):
+        for b in range(n):
+            p = params[f"s{s}c{b}"]
+            h = lax.conv_general_dilated(
+                h, p["w"], (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + p["b"]
+            h = jax.nn.relu(h)
+        h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 2, 2, 1),
+                              (1, 2, 2, 1), "VALID")
+    h = h.reshape(h.shape[0], -1)
+    for i in range(3):
+        p = params[f"fc{i}"]
+        h = h @ p["w"] + p["b"]
+        if i < 2:
+            h = jax.nn.relu(h)
+    return h
+
+
+def loss_fn(params: Dict, batch: Tuple[jax.Array, jax.Array],
+            depth: int = 16) -> jax.Array:
+    x, y = batch
+    logits = apply(params, x, depth=depth)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
