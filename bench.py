@@ -155,6 +155,55 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup):
     }
 
 
+def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
+    """Inception V3 @299 — THE reference headline model (README.rst:102:
+    90% scaling efficiency on 512 GPUs is the original Horovod result)."""
+    from horovod_tpu.models import inception
+
+    # CPU smoke: >=75px or reduction_b collapses spatial dims to 0x0
+    # (global mean over zero elements = NaN)
+    img = 80 if on_cpu else 299
+    b = 2 if on_cpu else 64
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+    batch = b * k
+    params, stats = inception.init(jax.random.PRNGKey(0), dtype=dtype)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+
+    def local_step(params, stats, opt_state, batch_):
+        def loss(p):
+            return inception.loss_fn(p, stats, batch_, train=True,
+                                     axis_name="hvd")
+        (l, ns), g = jax.value_and_grad(loss, has_aux=True)(params)
+        g = reduce_gradients_in_jit(g, num_ranks=k)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return (optax.apply_updates(params, updates), ns, opt_state,
+                lax.pmean(l, "hvd"))
+
+    step = jax.shard_map(local_step, mesh=mesh,
+                         in_specs=(P(), P(), P(), P("hvd")),
+                         out_specs=(P(), P(), P(), P()),
+                         check_vma=False)
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        rng.standard_normal((batch, img, img, 3), np.float32).astype(dtype),
+        NamedSharding(mesh, P("hvd")))
+    labels = jax.device_put(rng.integers(0, 1000, (batch,)),
+                            NamedSharding(mesh, P("hvd")))
+
+    def body(carry):
+        p, s, o, im, lb, _ = carry
+        p, s, o, l = step(p, s, o, (im, lb))
+        return (p, s, o, im, lb, l)
+
+    state = (params, stats, opt_state, images, labels, jnp.zeros(()))
+    sec = _scan_timed(body, state, chain=max(steps // 3, 1), reps=3,
+                      warmup=warmup)
+    return {"images_per_sec_per_chip": round(b / sec, 2),
+            "per_chip_batch": b, "image_size": img,
+            "step_ms": round(sec * 1e3, 2)}
+
+
 # --------------------------------------------------------------------------
 # Transformer LM (the framework flagship; MXU-bound)
 # --------------------------------------------------------------------------
@@ -563,6 +612,7 @@ def main():
             tr["tokens_per_sec_per_chip"] * tr["model_flops_per_token"]
             / peak, 4)
 
+    incep = _section("inception_v3", bench_inception, mesh, k, on_cpu)
     bert = _section("bert_adasum", bench_bert_adasum, on_cpu)
     fusion = _section("fusion_sweep", bench_fusion_sweep, on_cpu)
     autotune = _section("autotune", bench_autotune, on_cpu)
@@ -582,6 +632,7 @@ def main():
             "device": jax.devices()[0].device_kind,
             "num_chips": k,
             "resnet50": best,
+            "inception_v3": incep,
             "transformer_lm": tr,
             "bert_base_finetune": bert,
             "fusion_sweep_grouped_allreduce": fusion,

@@ -24,13 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from horovod_tpu.models.resnet import batch_norm
-
-
-def _conv_init(key, kh, kw, cin, cout, dtype):
-    fan_in = kh * kw * cin
-    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * \
-        (2.0 / fan_in) ** 0.5
+from horovod_tpu.models.resnet import _conv_init, batch_norm
 
 
 def _bn_init(c, dtype):
@@ -116,16 +110,6 @@ _BLOCKS = (
      ("c1", _inception_c())])
 
 
-def _iter_convs(plan):
-    for step in plan:
-        if step in ("avgpool", "maxpool"):
-            continue
-        if step[0] == "split":
-            yield from step[1:]
-        else:
-            yield step
-
-
 def init(key: jax.Array, num_classes: int = 1000,
          dtype=jnp.float32) -> Tuple[Dict, Dict]:
     """Returns (params, batch_stats)."""
@@ -149,26 +133,28 @@ def init(key: jax.Array, num_classes: int = 1000,
             c = cin
             convs = []
             cstats = []
-            for kh, kw, cout, _s, _p in _iter_convs(plan):
+            for step in plan:
+                if step in ("avgpool", "maxpool"):
+                    continue
+                if isinstance(step, tuple) and step[0] == "split":
+                    # every arm reads the SAME pre-split channel count;
+                    # the concat of arm outputs is the branch output
+                    pre_c = c
+                    c = 0
+                    for kh, kw, cout, _s, _p in step[1:]:
+                        key, k1 = jax.random.split(key)
+                        convs.append({"w": _conv_init(k1, kh, kw, pre_c,
+                                                      cout, dtype),
+                                      "bn": _bn_init(cout, dtype)})
+                        cstats.append(_bn_stats(cout))
+                        c += cout
+                    continue
+                kh, kw, cout, _s, _p = step
                 key, k1 = jax.random.split(key)
                 convs.append({"w": _conv_init(k1, kh, kw, c, cout, dtype),
                               "bn": _bn_init(cout, dtype)})
                 cstats.append(_bn_stats(cout))
                 c = cout
-            # split tails: both arms read the SAME input channel count
-            if plan and isinstance(plan[-1], tuple) and \
-                    plan[-1][0] == "split":
-                arms = plan[-1][1:]
-                c = sum(a[2] for a in arms)
-                # fix the second arm's cin (built above with chained c)
-                pre_c = (convs[-3]["w"].shape[-1]
-                         if len(convs) >= 3 else cin)
-                key, k1 = jax.random.split(key)
-                a2 = arms[1]
-                convs[-1] = {"w": _conv_init(k1, a2[0], a2[1], pre_c,
-                                             a2[2], dtype),
-                             "bn": _bn_init(a2[2], dtype)}
-                cstats[-1] = _bn_stats(a2[2])
             bp[br] = convs
             bs[br] = cstats
             c_out_total += c
