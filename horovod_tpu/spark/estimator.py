@@ -358,7 +358,8 @@ class TorchEstimator(HorovodEstimator):
     (reference: spark/torch/estimator.py TorchEstimator).
 
     optimizer: factory `(params_iter) -> torch.optim.Optimizer`.
-    loss: `loss(preds, y) -> scalar` (torch ops).
+    loss: `loss(preds, y[, sample_weight]) -> scalar` (torch ops; the
+        third positional arg is passed iff sampleWeightCol is set).
     """
 
     _kind = "torch"
@@ -419,6 +420,16 @@ def _load_shards(spec, rank: int, size: int):
         val = sutil.read_shard(store, store.get_val_data_path(
             spec["dataset_idx"]), rank, size, cols)
     return train, val
+
+
+def _batch_weights(b, spec):
+    """The sample-weight column of one batch (reference:
+    spark/common/params.py sample_weight_col — weights flow into the
+    loss), or None when unconfigured."""
+    col = spec.get("sample_weight_col")
+    if not col:
+        return None
+    return np.asarray(b[col], np.float32).reshape(-1)
 
 
 def _local_batch_count(data, batch_size: int) -> int:
@@ -586,8 +597,11 @@ def _remote_train_jax(spec):
         gradient_predivide_factor=spec["predivide"])
     opt_state = dist_opt.init(params)
 
-    def batch_loss(p, xb, yb):
-        return loss_fn(apply_fn(p, xb), yb)
+    has_sw = bool(spec.get("sample_weight_col"))
+
+    def batch_loss(p, xb, yb, wb=None):
+        preds = apply_fn(p, xb)
+        return loss_fn(preds, yb, wb) if has_sw else loss_fn(preds, yb)
 
     value_grad = jax.jit(jax.value_and_grad(batch_loss))
     metric_fns = _metric_dict(t.get("metrics"))
@@ -598,7 +612,7 @@ def _remote_train_jax(spec):
 
     def train_step(b) -> float:
         xb, yb = _features(b, fcols, md), _labels(b, lcols, md)
-        l, g = value_grad(box["params"], xb, yb)
+        l, g = value_grad(box["params"], xb, yb, _batch_weights(b, spec))
         box["params"], box["opt_state"] = dist_opt.step(
             g, box["params"], box["opt_state"])
         return float(l)
@@ -606,7 +620,9 @@ def _remote_train_jax(spec):
     def eval_batch(b):
         xv, yv = _features(b, fcols, md), _labels(b, lcols, md)
         preds = apply_fn(box["params"], xv)
-        return float(loss_fn(preds, yv)), {
+        wv = _batch_weights(b, spec)
+        return float(loss_fn(preds, yv, wv) if has_sw
+                     else loss_fn(preds, yv)), {
             k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
 
     history = _run_training(spec, train, val, rank,
@@ -666,8 +682,11 @@ def _remote_train_torch(spec):
     def train_step(b) -> float:
         xb = torch.from_numpy(_features(b, fcols, md))
         yb = torch.from_numpy(np.asarray(_labels(b, lcols, md)))
+        wb = _batch_weights(b, spec)
+        loss_args = (model(xb), yb) + \
+            ((torch.from_numpy(wb),) if wb is not None else ())
         opt.zero_grad()
-        loss = loss_fn(model(xb), yb)
+        loss = loss_fn(*loss_args)
         loss.backward()
         opt.step()
         return float(loss.detach())
@@ -676,8 +695,11 @@ def _remote_train_torch(spec):
         with torch.no_grad():
             xv = torch.from_numpy(_features(b, fcols, md))
             yv = torch.from_numpy(np.asarray(_labels(b, lcols, md)))
-            preds = model(xv)
-            return float(loss_fn(preds, yv)), {
+            wv = _batch_weights(b, spec)
+            args = (model(xv), yv) + \
+                ((torch.from_numpy(wv),) if wv is not None else ())
+            preds = args[0]
+            return float(loss_fn(*args)), {
                 k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
 
     history = _run_training(spec, train, val, rank,
@@ -775,6 +797,17 @@ def _remote_train_keras(spec):
     loss_obj = t["loss"]
     if isinstance(loss_obj, str):
         loss_obj = tf.keras.losses.get(loss_obj)
+    # Loss INSTANCES take sample_weight; plain functions (what a name
+    # string resolves to) return per-sample values we weight manually.
+    loss_takes_sw = isinstance(loss_obj, tf.keras.losses.Loss)
+
+    def weighted_loss(y, preds, w):
+        if w is None:
+            return tf.reduce_mean(loss_obj(y, preds))
+        wt = tf.constant(w)
+        if loss_takes_sw:
+            return tf.reduce_mean(loss_obj(y, preds, sample_weight=wt))
+        return tf.reduce_mean(wt * loss_obj(y, preds))
     metric_fns = _metric_dict(t.get("metrics"))
 
     # The frontend's gradient fn handles None grads (variables off the
@@ -797,8 +830,9 @@ def _remote_train_keras(spec):
     def train_step(b) -> float:
         xb = tf.constant(_features(b, fcols, md))
         yb = tf.constant(np.asarray(_labels(b, lcols, md)))
+        wb = _batch_weights(b, spec)
         with tf.GradientTape() as tape:
-            loss = tf.reduce_mean(loss_obj(yb, model(xb, training=True)))
+            loss = weighted_loss(yb, model(xb, training=True), wb)
         grads = tape.gradient(loss, model.trainable_variables)
         if bpps > 1:  # local aggregation (reference:
             # gradient_aggregation.py LocalGradientAggregationHelper)
@@ -825,7 +859,8 @@ def _remote_train_keras(spec):
         xv = tf.constant(_features(b, fcols, md))
         yv = tf.constant(np.asarray(_labels(b, lcols, md)))
         preds = model(xv, training=False)
-        return float(tf.reduce_mean(loss_obj(yv, preds))), {
+        wv = _batch_weights(b, spec)
+        return float(weighted_loss(yv, preds, wv)), {
             k: float(fn(preds, yv)) for k, fn in metric_fns.items()}
 
     history = _run_training(spec, train, val, rank,
@@ -866,6 +901,12 @@ class LightningEstimator(HorovodEstimator):
         model = self.getModel()
         if model is None:
             raise ValueError("LightningEstimator requires model=")
+        if self.getSampleWeightCol():
+            raise ValueError(
+                "sample_weight_col is not supported by LightningEstimator: "
+                "batches reach training_step as (features, labels) tuples "
+                "per the Lightning contract; fold weights into the module "
+                "or use JaxEstimator/TorchEstimator/KerasEstimator")
         for attr in ("training_step", "configure_optimizers"):
             if not callable(getattr(model, attr, None)):
                 raise ValueError(
