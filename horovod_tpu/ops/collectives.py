@@ -236,6 +236,65 @@ def _to_global(x: Any, ps: ProcessSet) -> Tuple[jax.Array, bool]:
         global_shape, sharding, shards), stacked
 
 
+def _lift_group(tensors: Sequence[Any], ps: ProcessSet):
+    """Lift a group of per-rank tensors to their global form — the one
+    entry point for grouped ops.
+
+    Single-process, for eligible tensors: ONE compiled program raises
+    the whole group to its row-sharded form (out_shardings does the
+    placement), collapsing 2N+1 dispatches to ~2 — the dominant cost of
+    eager grouped ops on remote/tunneled devices. COMMITTED arrays
+    (outputs of previous collectives via _from_global, or user
+    device_put-pinned inputs) cannot enter a jit whose out_shardings
+    spans other devices ("incompatible devices"), so they take the
+    per-tensor _to_global path, as does multi-process mode."""
+    if jax.process_count() != 1:
+        pairs = [_to_global(t, ps) for t in tensors]
+        return [p[0] for p in pairs], [p[1] for p in pairs]
+    mesh = ps.mesh
+    assert mesh is not None
+    L = _local_member_count(ps)
+    sharding = NamedSharding(mesh, P(_AXIS))
+    flags = []
+    need: List[int] = []
+    outs: List[Any] = [None] * len(tensors)
+    arrs: List[Any] = [None] * len(tensors)
+    for i, t in enumerate(tensors):
+        stacked = _is_stacked(t, ps, L)
+        flags.append(stacked)
+        if isinstance(t, jax.Array):
+            if t.sharding == sharding and stacked:
+                outs[i] = t
+                continue
+            if getattr(t, "committed", getattr(t, "_committed", True)):
+                outs[i] = _to_global(t, ps)[0]
+                continue
+        a = t if isinstance(t, (jax.Array, np.ndarray)) else jnp.asarray(t)
+        T.check_supported_dtype(np.dtype(a.dtype))
+        arrs[i] = a
+        need.append(i)
+    if need:
+        key = ("lift", tuple((tuple(np.shape(arrs[i])),
+                              str(arrs[i].dtype), flags[i])
+                             for i in need), L, ps.cache_token)
+        sub_flags = [flags[i] for i in need]
+
+        def build() -> Callable:
+            def lift(*xs):
+                res = []
+                for x, st in zip(xs, sub_flags):
+                    res.append(x if st else jnp.broadcast_to(
+                        x[None], (max(L, 1),) + x.shape))
+                return tuple(res)
+            return jax.jit(lift, out_shardings=(sharding,) * len(need))
+
+        fn = _cache.get_or_build(key, build)
+        lifted = fn(*[arrs[i] for i in need])
+        for i, g in zip(need, lifted):
+            outs[i] = g
+    return outs, flags
+
+
 def _from_global(y: jax.Array, stacked: bool) -> jax.Array:
     """Return the caller-facing view of a stacked global result."""
     if stacked:
@@ -533,7 +592,7 @@ def grouped_allreduce(tensors: Sequence[Any],
         with _timeline_span(name or "grouped_allreduce", "ALLREDUCE"):
             outs = _execute(fn, *[jnp.asarray(t) for t in tensors])
         return list(outs)
-    gs, stackeds = zip(*[_to_global(t, ps) for t in tensors])
+    gs, stackeds = _lift_group(tensors, ps)
     key = ("gar", tuple((g.shape, str(g.dtype)) for g in gs), int(rop),
            ps.cache_token, float(prescale_factor), float(postscale_factor),
            cfg.fusion_threshold_bytes, cfg.disable_group_fusion,
@@ -785,7 +844,7 @@ def grouped_reducescatter(tensors: Sequence[Any], op: Any = T.ReduceOp.AVERAGE,
     rop = _normalize_op(None, op) if op is not None else T.ReduceOp.AVERAGE
     if rop not in (T.ReduceOp.SUM, T.ReduceOp.AVERAGE):
         raise HorovodTpuError("reducescatter supports SUM and AVERAGE only")
-    gs, stackeds = zip(*[_to_global(t, ps) for t in tensors])
+    gs, stackeds = _lift_group(tensors, ps)
     k = ps.size()
     d0s = [int(g.shape[1]) for g in gs]
     key = ("grs", tuple((g.shape, str(g.dtype)) for g in gs), int(rop),
@@ -823,16 +882,12 @@ def grouped_allgather(tensors: Sequence[Any],
     ps = _resolve_ps(process_set)
     if not tensors:
         return []
-    gs = []
-    stackeds = []
-    for t in tensors:
-        g, st = _to_global(t, ps)
+    gs, stackeds = _lift_group(tensors, ps)
+    for g in gs:
         if g.ndim < 2:
             raise HorovodTpuError(
                 "allgather requires per-rank tensors with at least one "
                 "dimension")
-        gs.append(g)
-        stackeds.append(st)
     k = ps.size()
     n = len(gs)
     _consistency(f"grouped_allgather(n={n},"

@@ -346,3 +346,23 @@ def test_replicated_fast_path_rejects_bad_dtype(hvd):
 
     with _pytest.raises(Exception):
         hvd.grouped_allreduce([np.ones((2,), np.complex64)], op="sum")
+
+
+def test_grouped_chaining_committed_inputs(hvd, monkeypatch):
+    """Outputs of one collective (committed single-device arrays) must be
+    valid inputs to the next grouped collective — the batched group lift
+    routes committed arrays per-tensor instead of into a jit whose
+    out_shardings spans other devices."""
+    import numpy as np
+
+    x = [np.ones((3,), np.float32), np.full((2, 2), 2.0, np.float32)]
+    once = hvd.grouped_allreduce(x, op="sum")
+    twice = hvd.grouped_allreduce(once, op="sum")  # committed inputs
+    k = hvd.size()
+    np.testing.assert_allclose(np.asarray(twice[0]), k * k)
+    g = hvd.grouped_allgather([hvd.allreduce(np.ones((2, 3), np.float32))])
+    assert np.asarray(g[0]).shape[0] == 2 * k
+    # and with the full machinery forced
+    monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "1")
+    thrice = hvd.grouped_allreduce(twice, op="sum")
+    np.testing.assert_allclose(np.asarray(thrice[1]), 2.0 * k ** 3)
