@@ -18,9 +18,10 @@ from horovod_tpu.common.exceptions import (  # noqa: F401
     HostsUpdatedInterrupt, TensorShapeMismatchError, VersionMismatchError,
 )
 from horovod_tpu.core.topology import (  # noqa: F401
-    ccl_built, cross_rank, cross_size, gloo_built, init, is_homogeneous,
-    is_initialized, local_rank, local_size, local_slot_ranks, mesh, mpi_built,
-    mpi_enabled, mpi_threads_supported, nccl_built, rank, shutdown, size,
+    ccl_built, cross_rank, cross_size, cuda_built, ddl_built, gloo_built,
+    gloo_enabled, init, is_homogeneous, is_initialized, local_rank,
+    local_size, local_slot_ranks, mesh, mpi_built, mpi_enabled,
+    mpi_threads_supported, nccl_built, rank, rocm_built, shutdown, size,
     tpu_built,
 )
 from horovod_tpu.core.process_sets import (  # noqa: F401

@@ -580,7 +580,23 @@ def ccl_built() -> bool:
     return False
 
 
+def ddl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
 def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
     return False
 
 

@@ -34,9 +34,11 @@ import numpy as np
 
 from horovod_tpu.common import types as T
 from horovod_tpu.core.topology import (  # noqa: F401
-    cross_rank, cross_size, gloo_built, init, is_homogeneous,
+    ccl_built, cross_rank, cross_size, cuda_built, ddl_built,
+    gloo_built, gloo_enabled, init, is_homogeneous,
     is_initialized, local_rank, local_size, mpi_built, mpi_enabled,
-    mpi_threads_supported, nccl_built, rank, shutdown, size, tpu_built,
+    mpi_threads_supported, nccl_built, rank, rocm_built, shutdown,
+    size, tpu_built,
 )
 from horovod_tpu.core.join import join  # noqa: F401
 from horovod_tpu.optim.functions import allgather_object  # noqa: F401

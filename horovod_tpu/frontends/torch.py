@@ -25,9 +25,10 @@ import numpy as np
 
 from horovod_tpu.common import types as T
 from horovod_tpu.core.topology import (  # noqa: F401
-    cross_rank, cross_size, gloo_built, init, is_homogeneous,
-    is_initialized, local_rank, local_size, mpi_built, mpi_enabled,
-    mpi_threads_supported, nccl_built, rank, shutdown, size, tpu_built,
+    ccl_built, cross_rank, cross_size, cuda_built, ddl_built, gloo_built,
+    gloo_enabled, init, is_homogeneous, is_initialized, local_rank,
+    local_size, mpi_built, mpi_enabled, mpi_threads_supported, nccl_built,
+    rank, rocm_built, shutdown, size, tpu_built,
 )
 from horovod_tpu.core.join import join  # noqa: F401
 from horovod_tpu.optim.functions import allgather_object  # noqa: F401
@@ -159,6 +160,19 @@ def _sparse_allreduce(tensor, average: Optional[bool], op,
         out = torch.sparse_coo_tensor(out.indices(), out.values() * scale,
                                       size=t.shape).coalesce()
     return out
+
+
+def sparse_allreduce_async(tensor, name=None, op=Average,
+                           process_set: Optional[ProcessSet] = None):
+    """Reference: torch/mpi_ops.py:567 sparse_allreduce_async — allreduce a
+    torch.sparse tensor (allgather of indices+values, coalesced sum).
+    Dispatch here is synchronous under the hood; the returned handle
+    matches the async API (synchronize()/poll() work)."""
+    out = _sparse_allreduce(tensor, average=None, op=op,
+                            process_set=process_set)
+    fut = concurrent.futures.Future()
+    fut.set_result(out)
+    return _Handle(fut, tensor, same_shape=True)
 
 
 def allreduce(tensor, average: Optional[bool] = None, name=None, op=None,
@@ -321,8 +335,12 @@ def synchronize(handle):
     mpi_ops.py:1269). Non-handle values pass through (sync-API results)."""
     if not isinstance(handle, _Handle):
         return handle
-    out = _like(handle.future.result(), handle.ref,
-                keep_shape=handle.same_shape)
+    res = handle.future.result()
+    torch = _torch()
+    if isinstance(res, torch.Tensor):
+        out = res  # already a torch tensor (sparse path)
+    else:
+        out = _like(res, handle.ref, keep_shape=handle.same_shape)
     if handle.target is not None:
         handle.target.copy_(out)
         return handle.target

@@ -205,7 +205,9 @@ def _to_global(x: Any, ps: ProcessSet) -> Tuple[jax.Array, bool]:
     """Lift a local (or locally-stacked) per-rank tensor to a global array
     sharded one-row-per-rank over the set's mesh.
 
-    Returns (global_array, was_stacked).
+    Returns (global_array, was_stacked). NOTE: the single-process lifting
+    rule (stacked pass-through vs broadcast to (L, *shape)) is mirrored
+    inside _lift_group's compiled batch lift — change them TOGETHER.
     """
     mesh = ps.mesh
     assert mesh is not None
@@ -280,6 +282,8 @@ def _lift_group(tensors: Sequence[Any], ps: ProcessSet):
         sub_flags = [flags[i] for i in need]
 
         def build() -> Callable:
+            # MIRROR of _to_global's single-process lifting rule (stacked
+            # pass-through vs broadcast to (L, *shape)) — keep in lockstep
             def lift(*xs):
                 res = []
                 for x, st in zip(xs, sub_flags):

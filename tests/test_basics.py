@@ -67,3 +67,36 @@ def test_dynamic_process_sets_gate(hvd):
     topology.raw_state().config.dynamic_process_sets = False
     with pytest.raises(hvd_error(hvd)):
         hvd.add_process_set([0, 1])
+
+
+def test_build_info_api_parity(hvd):
+    """Reference basics.py build-info surface exists end to end."""
+    import horovod_tpu as hv
+
+    assert hv.tpu_built() is True
+    for fn in (hv.mpi_built, hv.gloo_built, hv.nccl_built, hv.ccl_built,
+               hv.ddl_built, hv.cuda_built, hv.rocm_built,
+               hv.mpi_enabled, hv.gloo_enabled,
+               hv.mpi_threads_supported):
+        assert fn() is False
+
+
+def test_build_info_on_frontends(hvd):
+    """Frontends mirror the build-info surface (reference: each framework
+    module re-exports basics.py)."""
+    mods = []
+    try:
+        import horovod_tpu.frontends.torch as th
+        mods.append(th)
+    except ImportError:
+        pass
+    try:
+        import horovod_tpu.frontends.tensorflow as tfv
+        mods.append(tfv)
+    except ImportError:
+        pass
+    for m in mods:
+        for name in ("cuda_built", "rocm_built", "ddl_built",
+                     "gloo_enabled", "ccl_built"):
+            assert hasattr(m, name), (m.__name__, name)
+            assert m.__dict__[name]() is False

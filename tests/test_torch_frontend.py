@@ -326,3 +326,19 @@ def test_optimizer_groups_numerics(hvd):
         got = grads_with(group_fn)
         for a, b in zip(base, got):
             torch.testing.assert_close(a, b)
+
+
+def test_sparse_allreduce_async_api(hvd):
+    """Reference name parity: torch/mpi_ops.py:567 sparse_allreduce_async
+    returns a handle; synchronize yields the reduced sparse tensor."""
+    import horovod_tpu.frontends.torch as thvd
+
+    i = torch.tensor([[0, 2]])
+    v = torch.tensor([[1.0, 2.0], [3.0, 4.0]])
+    sp = torch.sparse_coo_tensor(i, v, (3, 2))
+    h = thvd.sparse_allreduce_async(sp, name="s", op=thvd.Sum)
+    assert thvd.poll(h)
+    out = thvd.synchronize(h)
+    assert out.is_sparse
+    k = thvd.size()
+    torch.testing.assert_close(out.to_dense()[0], torch.tensor([1.0, 2.0]) * k)
