@@ -531,6 +531,48 @@ class LearningRateWarmupCallback:
         return _CB()
 
 
+class LearningRateScheduleCallback:
+    """Multiply the learning rate by `multiplier` over an epoch range
+    (reference: _keras/callbacks.py:108 LearningRateScheduleCallbackImpl —
+    `multiplier` is a constant or a callable(epoch); active during
+    [start_epoch, end_epoch))."""
+
+    def __new__(cls, initial_lr: float, multiplier, start_epoch: int = 0,
+                end_epoch=None, staircase: bool = True, verbose: int = 0):
+        Base = _keras_callback_base()
+        mult_fn = multiplier if callable(multiplier) \
+            else (lambda epoch: multiplier)
+
+        class _CB(Base):
+            def on_epoch_begin(self, epoch, logs=None):
+                if epoch < start_epoch or \
+                        (end_epoch is not None and epoch >= end_epoch):
+                    return
+                lr = initial_lr * float(mult_fn(epoch))
+                self.model.optimizer.learning_rate.assign(lr)
+                if verbose:
+                    print(f"Epoch {epoch}: LearningRateScheduleCallback "
+                          f"sets learning rate to {lr:.6g}")
+
+        return _CB()
+
+
+class _CallbacksNamespace:
+    """`hvd.callbacks.*` — the reference keras namespace
+    (horovod/tensorflow/keras/callbacks.py) so migrating scripts keep
+    their spelling."""
+
+    def __init__(self):
+        self.BroadcastGlobalVariablesCallback = \
+            BroadcastGlobalVariablesCallback
+        self.MetricAverageCallback = MetricAverageCallback
+        self.LearningRateWarmupCallback = LearningRateWarmupCallback
+        self.LearningRateScheduleCallback = LearningRateScheduleCallback
+
+
+callbacks = _CallbacksNamespace()
+
+
 # Elastic substate (reference: horovod/tensorflow/elastic.py) —
 # hvd.elastic.TfKerasState, @hvd.elastic.run.
 from horovod_tpu.frontends import tensorflow_elastic as elastic  # noqa: E402,F401

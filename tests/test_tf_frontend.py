@@ -219,3 +219,36 @@ def test_tf_metric_average_callback(hvd):
     logs = {"loss": 4.0}
     cb.on_epoch_end(0, logs)
     np.testing.assert_allclose(logs["loss"], 4.0)  # identical ranks
+
+
+def test_callbacks_namespace_and_lr_schedule(hvd):
+    """Reference spelling parity: hvd.callbacks.* exists
+    (tensorflow/keras/callbacks.py), and LearningRateScheduleCallback
+    applies a multiplier over its epoch range."""
+    import keras
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    for name in ("BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+                 "LearningRateWarmupCallback",
+                 "LearningRateScheduleCallback"):
+        assert hasattr(tfvd.callbacks, name)
+
+    model = keras.Sequential([keras.layers.Input((2,)),
+                              keras.layers.Dense(1)])
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=1.0),
+                  loss="mse")
+    cb = tfvd.callbacks.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** e,
+        start_epoch=1, end_epoch=3)
+    cb.set_model(model)
+    cb.on_epoch_begin(0)
+    np.testing.assert_allclose(float(model.optimizer.learning_rate), 1.0)
+    cb.on_epoch_begin(1)
+    np.testing.assert_allclose(float(model.optimizer.learning_rate), 0.1)
+    cb.on_epoch_begin(2)
+    np.testing.assert_allclose(float(model.optimizer.learning_rate), 0.01,
+                               rtol=1e-6)
+    cb.on_epoch_begin(3)  # out of range: unchanged
+    np.testing.assert_allclose(float(model.optimizer.learning_rate), 0.01,
+                               rtol=1e-6)
