@@ -535,24 +535,67 @@ class LearningRateScheduleCallback:
     """Multiply the learning rate by `multiplier` over an epoch range
     (reference: _keras/callbacks.py:108 LearningRateScheduleCallbackImpl —
     `multiplier` is a constant or a callable(epoch); active during
-    [start_epoch, end_epoch))."""
+    [start_epoch, end_epoch)). With staircase=False the LR interpolates
+    per batch at fractional epochs (needs steps_per_epoch);
+    momentum_correction rescales SGD momentum proportionally to the LR
+    change, as the reference does. Mirrors optim/callbacks.py's JAX
+    sibling."""
 
     def __new__(cls, initial_lr: float, multiplier, start_epoch: int = 0,
-                end_epoch=None, staircase: bool = True, verbose: int = 0):
+                end_epoch=None, staircase: bool = True,
+                momentum_correction: bool = True,
+                steps_per_epoch=None, verbose: int = 0):
         Base = _keras_callback_base()
         mult_fn = multiplier if callable(multiplier) \
             else (lambda epoch: multiplier)
 
         class _CB(Base):
-            def on_epoch_begin(self, epoch, logs=None):
-                if epoch < start_epoch or \
-                        (end_epoch is not None and epoch >= end_epoch):
+            def __init__(self):
+                super().__init__()
+                self._epoch = 0
+                self._steps = steps_per_epoch
+
+            def _in_range(self, epoch) -> bool:
+                return epoch >= start_epoch and \
+                    (end_epoch is None or epoch < end_epoch)
+
+            def _apply(self, epoch):
+                if not self._in_range(epoch):
                     return
+                opt = self.model.optimizer
                 lr = initial_lr * float(mult_fn(epoch))
-                self.model.optimizer.learning_rate.assign(lr)
+                if momentum_correction and \
+                        getattr(opt, "momentum", None) is not None:
+                    # restore then rescale momentum with the LR ratio
+                    # (reference: momentum correction for LR changes)
+                    old_lr = float(opt.learning_rate)
+                    if old_lr > 0 and lr != old_lr:
+                        mom = opt.momentum
+                        try:
+                            mom.assign(float(mom) * lr / old_lr)
+                        except AttributeError:
+                            opt.momentum = float(mom) * lr / old_lr
+                opt.learning_rate.assign(lr)
                 if verbose:
                     print(f"Epoch {epoch}: LearningRateScheduleCallback "
                           f"sets learning rate to {lr:.6g}")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                self._epoch = epoch
+                if staircase:
+                    self._apply(epoch)
+
+            def on_train_batch_end(self, batch, logs=None):
+                if staircase:
+                    return
+                if self._steps is None:
+                    # derive steps/epoch from the first epoch's batches
+                    self._steps = max(batch + 1, 1)
+                    frac = 0.0
+                else:
+                    self._steps = max(self._steps, batch + 1)
+                    frac = (batch + 1) / float(self._steps)
+                self._apply(self._epoch + min(frac, 1.0))
 
         return _CB()
 

@@ -252,3 +252,25 @@ def test_callbacks_namespace_and_lr_schedule(hvd):
     cb.on_epoch_begin(3)  # out of range: unchanged
     np.testing.assert_allclose(float(model.optimizer.learning_rate), 0.01,
                                rtol=1e-6)
+
+
+def test_lr_schedule_smooth_and_reference_kwargs(hvd):
+    """staircase=False interpolates per batch; reference kwargs
+    (momentum_correction, steps_per_epoch) are accepted
+    (reference: _keras/callbacks.py:108)."""
+    import keras
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    model = keras.Sequential([keras.layers.Input((2,)),
+                              keras.layers.Dense(1)])
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=1.0),
+                  loss="mse")
+    cb = tfvd.callbacks.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.5 ** e,
+        staircase=False, momentum_correction=False, steps_per_epoch=4)
+    cb.set_model(model)
+    cb.on_epoch_begin(1)
+    cb.on_train_batch_end(1)  # epoch 1.5 -> 0.5**1.5
+    np.testing.assert_allclose(float(model.optimizer.learning_rate),
+                               0.5 ** 1.5, rtol=1e-5)
