@@ -5,9 +5,18 @@ examples/tensorflow2/tensorflow2_synthetic_benchmark.py from the reference:
 random data, fixed image shape, prints images/sec per iteration batch.
 
 Run:  python examples/synthetic_benchmark.py --batch-size 32 --num-iters 5
+
+Scaling report (the reference's north-star metric, BASELINE.md: 90%
+efficiency 1→N): run the same model on a 1-device mesh and an N-device
+mesh and report per-chip efficiency. On a pod this uses N real chips; on
+a CPU host use XLA_FLAGS=--xla_force_host_platform_device_count=N to
+rehearse the harness.
+
+    python examples/synthetic_benchmark.py --scaling-report 8
 """
 
 import argparse
+import json
 import time
 
 import jax
@@ -38,20 +47,15 @@ def parse_args():
     p.add_argument("--fp16-allreduce", action="store_true")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--scaling-report", type=int, default=None,
+                   metavar="N",
+                   help="run on 1 then N devices; print per-chip "
+                        "efficiency (needs N local devices)")
     return p.parse_args()
 
 
-def main():
-    args = parse_args()
-    hvd.init()
-    mesh = topology.mesh()
-    k = hvd.size()
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    if args.image_size is None:
-        args.image_size = 299 if args.model == "inception3" else 224
-
-    # One loss_maker signature across families: (params, stats, batch) ->
-    # (loss, new_stats). VGG has no BN state (stats = empty dict).
+def build_model(args, dtype):
+    """Returns (params, stats, loss_maker) for the chosen family."""
     if args.model.startswith("resnet"):
         depth = int(args.model.replace("resnet", ""))
         params, stats = resnet.init(jax.random.PRNGKey(0), depth=depth,
@@ -61,7 +65,7 @@ def main():
     elif args.model.startswith("vgg"):
         vdepth = int(args.model.replace("vgg", ""))
         params = vgg.init(jax.random.PRNGKey(0), depth=vdepth, dtype=dtype,
-                          image_size=args.image_size)  # noqa: E501
+                          image_size=args.image_size)
         stats = {}
         loss_maker = lambda p, s, b: (  # noqa: E731
             vgg.loss_fn(p, b, depth=vdepth), s)
@@ -69,6 +73,14 @@ def main():
         params, stats = inception.init(jax.random.PRNGKey(0), dtype=dtype)
         loss_maker = lambda p, s, b: inception.loss_fn(  # noqa: E731
             p, s, b, train=True, axis_name="hvd")
+    return params, stats, loss_maker
+
+
+def run_bench(args, mesh, k, quiet=False):
+    """Run the training loop over `mesh` (k ranks); returns mean total
+    images/sec across iters."""
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    params, stats, loss_maker = build_model(args, dtype)
     opt = optax.sgd(0.01 * k, momentum=0.9)
     opt_state = opt.init(params)
 
@@ -101,10 +113,6 @@ def main():
                        NamedSharding(mesh, P("hvd"))),
     )
 
-    if hvd.rank() == 0:
-        print(f"Model: {args.model}, batch {args.batch_size}/rank, "
-              f"{k} rank(s), dtype {args.dtype}")
-
     for _ in range(args.num_warmup_batches):
         params, stats, opt_state, l = step(params, stats, opt_state, data)
     float(l)
@@ -119,11 +127,53 @@ def main():
         dt = time.perf_counter() - t0
         ips = n * args.num_batches_per_iter / dt
         img_secs.append(ips)
-        if hvd.rank() == 0:
+        if not quiet and hvd.rank() == 0:
             print(f"Iter #{it}: {ips:.1f} img/sec total")
+    return float(np.mean(img_secs))
+
+
+def scaling_report(args):
+    """1 vs N device run of the identical step; prints one JSON line with
+    per-chip rates and efficiency — the number the reference publishes
+    (90% for ResNet-101/Inception V3 on 512 GPUs, README.rst:102-108)."""
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = args.scaling_report
+    if len(devs) < n:
+        raise SystemExit(
+            f"--scaling-report {n} needs {n} local devices, have "
+            f"{len(devs)}. On a pod run under the launcher; on CPU set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}.")
+    mesh1 = Mesh(np.array(devs[:1]), ("hvd",))
+    meshN = Mesh(np.array(devs[:n]), ("hvd",))
+    ips1 = run_bench(args, mesh1, 1, quiet=True)
+    ipsN = run_bench(args, meshN, n, quiet=True)
+    eff = (ipsN / n) / ips1
+    print(json.dumps({
+        "model": args.model, "per_rank_batch": args.batch_size,
+        "ips_1chip": round(ips1, 1),
+        "ips_per_chip_at_n": round(ipsN / n, 1),
+        "n": n, "scaling_efficiency": round(eff, 4),
+    }))
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    if args.image_size is None:
+        args.image_size = 299 if args.model == "inception3" else 224
+    if args.scaling_report:
+        scaling_report(args)
+        return
+    mesh = topology.mesh()
+    k = hvd.size()
     if hvd.rank() == 0:
-        print(f"Img/sec per rank: {np.mean(img_secs) / k:.1f} "
-              f"+- {1.96 * np.std(img_secs) / k:.1f}")
+        print(f"Model: {args.model}, batch {args.batch_size}/rank, "
+              f"{k} rank(s), dtype {args.dtype}")
+    img_secs = run_bench(args, mesh, k)
+    if hvd.rank() == 0:
+        print(f"Img/sec per rank: {img_secs / k:.1f}")
 
 
 if __name__ == "__main__":

@@ -201,6 +201,48 @@ def _elastic_distributed_init(coord: str, cfg: Config) -> None:
                                num_processes=cfg.size, process_id=rank)
 
 
+# jaxlib versions whose private distributed-runtime API this elastic
+# path has been verified against (see recoverable_client_contract).
+RECOVERABLE_CLIENT_TESTED_JAXLIB = ("0.7", "0.9")
+
+
+def recoverable_client_contract():
+    """Probe — WITHOUT connecting — whether this jaxlib still exposes the
+    recoverable distributed-runtime client `_elastic_distributed_init`
+    needs (jax._src internals; any jaxlib bump may move or re-sign them).
+
+    Returns (ok, reason). Used by tests/CI to fail LOUDLY on signature
+    drift: the runtime path degrades gracefully (worker-restart
+    recovery), but the degradation must never be silent — a CI run on a
+    tested jaxlib version with a broken contract is a bug, not a
+    fallback (docs/elastic.md "jaxlib compatibility")."""
+    try:
+        from jax._src import distributed as _dist  # noqa: F401
+        from jax._src.lib import _jax as _jaxlib
+    except ImportError as e:
+        return False, f"jax._src import moved: {e}"
+    factory = getattr(_jaxlib, "get_distributed_runtime_client", None)
+    if factory is None:
+        return False, "get_distributed_runtime_client gone from jaxlib"
+    if getattr(_dist, "global_state", None) is None:
+        return False, "jax._src.distributed.global_state gone"
+    try:
+        # construct only — no .connect(), and shutdown_on_destruction
+        # False means the destructor performs no RPC
+        factory("127.0.0.1:1", 0, init_timeout=1, heartbeat_timeout=1,
+                shutdown_timeout=1, use_compression=True,
+                recoverable=True, shutdown_on_destruction=False)
+    except TypeError as e:
+        return False, f"factory signature drifted: {e}"
+    except Exception as e:
+        # kwargs were ACCEPTED (no TypeError) but the native ctor
+        # rejected the dummy address/values at runtime — the signature
+        # contract holds; note the caveat instead of raising out of a
+        # probe documented to always return (ok, reason)
+        return True, f"signature ok; ctor runtime caveat: {e!r}"
+    return True, "ok"
+
+
 def distributed_teardown() -> None:
     """Tear down the jax.distributed client/service, tolerating dead peers
     (used by the elastic reset; every step is best-effort because the ring

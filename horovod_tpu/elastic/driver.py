@@ -15,6 +15,7 @@ Worker state survives through the elastic State sync (state.py).
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 import threading
 import time
@@ -449,8 +450,21 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
             "HOROVOD_ELASTIC_TIMEOUT": str(args.elastic_timeout),
             "HOROVOD_COORDINATOR_ADDR": publisher.round_coords[round_id],
         })
-        cmd, full_env = make_worker_cmd(slot, command, env)
-        return safe_exec.WorkerProcess(slot.rank, cmd, full_env)
+        cmd, full_env = make_worker_cmd(
+            slot, command, env,
+            ssh_port=getattr(args, "ssh_port", None),
+            ssh_identity_file=getattr(args, "ssh_identity_file", None))
+        logfile = None
+        out_dir = getattr(args, "output_filename", None)
+        if out_dir:
+            d = os.path.join(out_dir, f"rank.{slot.rank}")
+            os.makedirs(d, exist_ok=True)
+            # elastic respawns reuse rank slots: suffix by round so a
+            # later round never clobbers the crashed round's log
+            logfile = os.path.join(d, f"stdout.r{round_id}")
+        return safe_exec.WorkerProcess(
+            slot.rank, cmd, full_env, logfile=logfile,
+            timestamp=getattr(args, "prefix_timestamp", False))
 
     driver = ElasticDriver(
         hm, spawn, lambda h: h.terminate(),

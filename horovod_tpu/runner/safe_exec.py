@@ -19,11 +19,15 @@ from typing import Dict, List, Optional
 class WorkerProcess:
     def __init__(self, index: int, cmd: List[str], env: Dict[str, str],
                  prefix_output: bool = True,
-                 stdout=None):
+                 stdout=None,
+                 logfile: Optional[str] = None,
+                 timestamp: bool = False):
         self.index = index
         self.cmd = cmd
         self._stdout = stdout or sys.stdout
         self._prefix = prefix_output
+        self._timestamp = timestamp
+        self._logfile = open(logfile, "w") if logfile else None
         self.proc = subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True, bufsize=1,
@@ -34,11 +38,19 @@ class WorkerProcess:
     def _pump_output(self):
         assert self.proc.stdout is not None
         for line in self.proc.stdout:
+            if self._logfile is not None:
+                self._logfile.write(line)  # raw per-rank log
+                self._logfile.flush()
             if self._prefix:
-                self._stdout.write(f"[{self.index}]<stdout>: {line}")
+                stamp = ""
+                if self._timestamp:
+                    stamp = time.strftime("%a %b %d %H:%M:%S %Y") + " "
+                self._stdout.write(f"{stamp}[{self.index}]<stdout>: {line}")
             else:
                 self._stdout.write(line)
             self._stdout.flush()
+        if self._logfile is not None:
+            self._logfile.close()
 
     def wait(self, timeout: Optional[float] = None) -> int:
         rc = self.proc.wait(timeout=timeout)

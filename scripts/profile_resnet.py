@@ -1,0 +1,93 @@
+"""Per-component ResNet-50 step breakdown with latency-cancelling slope
+timing (see bench.py _scan_timed). Establishes where the step time goes
+before attacking the ~50%-MFU HBM roofline (docs/benchmarks.md).
+
+Usage: python scripts/profile_resnet.py [batch ...]
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from bench import _scan_timed  # ONE copy of the slope-timing logic
+from horovod_tpu.models import resnet
+
+PEAK = 197e12
+
+
+def slope_timed(body, state, chain=10, reps=3, warmup=2):
+    return _scan_timed(body, state, chain=chain, reps=reps, warmup=warmup)
+
+
+def make_step(batch, fwd_only=False, dtype=jnp.bfloat16):
+    params, stats = resnet.init(jax.random.PRNGKey(0), depth=50,
+                                num_classes=1000, dtype=dtype)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((batch, 224, 224, 3),
+                                             np.float32).astype(dtype))
+    labels = jnp.asarray(rng.integers(0, 1000, (batch,)))
+
+    def loss(p, s):
+        return resnet.loss_fn(p, s, (images, labels), depth=50, train=True)
+
+    if fwd_only:
+        def body(carry):
+            p, s, o, _ = carry
+            l, ns = loss(p, s)
+            # feed the loss back into the params: without a carry
+            # dependency XLA hoists the whole loop-invariant forward out
+            # of the scan and the timing reads ~0
+            p = jax.tree_util.tree_map(
+                lambda a: a + (l * 1e-30).astype(a.dtype), p)
+            return (p, ns, o, l)
+    else:
+        def body(carry):
+            p, s, o, _ = carry
+            (l, ns), g = jax.value_and_grad(loss, has_aux=True)(p, s)
+            updates, no = opt.update(g, o, p)
+            return (optax.apply_updates(p, updates), ns, no, l)
+    state = (params, stats, opt_state, jnp.zeros(()))
+    return body, state
+
+
+def main():
+    import horovod_tpu.models.resnet as rn
+    batches = [int(b) for b in sys.argv[1:]] or [128, 256]
+    orig_rw = rn.lax.reduce_window
+    for b in batches:
+        for label, patch in (
+                ("maxpool  ", None),
+                ("avgpool  ", "avg"),   # cheap-bwd pool: isolates
+                ("nopool   ", "skip"),  # SelectAndScatter cost
+        ):
+            if patch == "avg":
+                # init must be a CONCRETE scalar or reduce_window takes
+                # the generic (non-differentiable) variadic path
+                rn.lax.reduce_window = lambda x, init, op, wd, ws, pad: \
+                    orig_rw(x, np.zeros((), x.dtype)[()], lax.add, wd, ws,
+                            pad) / 9.0
+            elif patch == "skip":
+                rn.lax.reduce_window = \
+                    lambda x, init, op, wd, ws, pad: x[:, ::2, ::2, :]
+            try:
+                body, state = make_step(b)
+                t = slope_timed(body, state)
+                ips = b / t
+                print(f"B={b} {label} full: {t*1e3:6.1f} ms, {ips:6.0f} "
+                      f"img/s, MFU {ips*12.3e9/PEAK:.1%}", flush=True)
+                if patch is None:
+                    body, state = make_step(b, fwd_only=True)
+                    t = slope_timed(body, state)
+                    print(f"B={b} {label} fwd:  {t*1e3:6.1f} ms "
+                          f"(fwd MFU {b/t*4.1e9/PEAK:.1%})", flush=True)
+            finally:
+                rn.lax.reduce_window = orig_rw
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,7 @@ for i in $(seq 1 40); do
   H=$(python - <<'EOF' 2>/dev/null
 import sys; sys.path[:0] = ["/root/repo", "/root/.axon_site"]
 import bench
-print(bench._device_health())
+print(bench._device_health()['matmul_tflops'])
 EOF
 )
   echo "$(date +%H:%M:%S) health=$H" >> ${OUT}.log

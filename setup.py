@@ -11,7 +11,7 @@ setup(
                 "capabilities of Horovod",
     packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
     python_requires=">=3.10",
-    install_requires=["jax", "numpy", "optax"],
+    install_requires=["jax", "numpy", "optax", "pyyaml"],
     extras_require={
         "spark": ["pyspark"],
         "ray": ["ray"],

@@ -163,3 +163,35 @@ def test_lagging_ranks_names_absentee():
     with pytest.raises(HorovodTpuError):
         c0.check("solo-op")
     assert c0.lagging_ranks() == [1]
+
+
+def test_consistency_check_coverage_matrix(monkeypatch):
+    """Pins WHEN checks are live (docs/concepts.md matrix): default
+    follows the launcher's native-KV injection; explicit env wins both
+    ways; size<=1 self-disables regardless."""
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.core import consistency
+
+    # launcher-started (KV injected) -> default ON
+    monkeypatch.setenv("HOROVOD_NATIVE_KV_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_NATIVE_KV_PORT", "12345")
+    monkeypatch.delenv("HOROVOD_CONSISTENCY_CHECK", raising=False)
+    assert Config.from_env().consistency_check is True
+
+    # explicit opt-out wins
+    monkeypatch.setenv("HOROVOD_CONSISTENCY_CHECK", "0")
+    assert Config.from_env().consistency_check is False
+
+    # manual multi-process (no KV injected) -> default OFF
+    monkeypatch.delenv("HOROVOD_NATIVE_KV_ADDR")
+    monkeypatch.delenv("HOROVOD_NATIVE_KV_PORT")
+    monkeypatch.delenv("HOROVOD_CONSISTENCY_CHECK")
+    assert Config.from_env().consistency_check is False
+
+    # ... unless opted in
+    monkeypatch.setenv("HOROVOD_CONSISTENCY_CHECK", "1")
+    assert Config.from_env().consistency_check is True
+
+    # single process self-disables even when enabled
+    consistency.reset()
+    assert consistency.maybe_init(Config.from_env(), 0, 1) is None

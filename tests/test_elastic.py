@@ -292,3 +292,87 @@ def test_elastic_init_survives_missing_private_api(monkeypatch):
                         new_signature_factory, raising=False)
     topology._elastic_distributed_init("10.0.0.2:9998", cfg)
     assert calls["args"] == ("10.0.0.2:9998", 4, 1)
+
+
+def test_recoverable_client_contract_pinned():
+    """The elastic in-process recovery path leans on jax._src internals
+    (core/topology.py _elastic_distributed_init). On a jaxlib inside the
+    tested range this must NOT have silently decayed to the
+    worker-restart fallback; outside the range, a broken contract is a
+    documented degradation (skip, visibly)."""
+    import jaxlib
+
+    from horovod_tpu.core.topology import (
+        RECOVERABLE_CLIENT_TESTED_JAXLIB, recoverable_client_contract)
+
+    lo, hi = RECOVERABLE_CLIENT_TESTED_JAXLIB
+    ver = tuple(int(x) for x in jaxlib.__version__.split(".")[:2])
+    in_range = tuple(int(x) for x in lo.split(".")) <= ver <= \
+        tuple(int(x) for x in hi.split("."))
+    ok, reason = recoverable_client_contract()
+    if not in_range:
+        if not ok:
+            pytest.skip(f"jaxlib {jaxlib.__version__} outside tested "
+                        f"range {lo}-{hi}; contract broken: {reason} — "
+                        f"elastic degrades to worker-restart recovery")
+        return
+    assert ok, (
+        f"jaxlib {jaxlib.__version__} is INSIDE the tested range "
+        f"{lo}-{hi} but the recoverable-client contract broke: {reason}. "
+        "Fix _elastic_distributed_init or extend the tested range.")
+
+
+def test_elastic_reset_warm_compile_cache(tmp_path):
+    """SURVEY §7 names fast reset as THE elastic risk: a post-reset
+    re-init must skip recompiles. The framework wires
+    HOROVOD_TPU_COMPILE_CACHE → jax_compilation_cache_dir at init
+    (core/topology.py); two worker 'rounds' (process restart = the
+    worker-restart recovery path) share the cache dir, and the warm
+    round's compile must be a fraction of the cold one."""
+    import subprocess
+    import sys
+    import textwrap
+    import time
+
+    code = textwrap.dedent("""
+        import os, time
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        # CPU compiles are fast; drop the persistence threshold so the
+        # test program is cacheable (TPU compiles clear the default 1 s)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            for i in range(30):
+                x = jnp.tanh(x @ x) + i
+            return x
+        t0 = time.perf_counter()
+        f(jnp.ones((128, 128), jnp.float32)).block_until_ready()
+        print("ELAPSED", time.perf_counter() - t0)
+    """)
+    env = dict(os.environ)
+    env["HOROVOD_TPU_COMPILE_CACHE"] = str(tmp_path)
+    env.pop("JAX_PLATFORMS", None)
+
+    def round_time():
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        for ln in r.stdout.splitlines():
+            if ln.startswith("ELAPSED"):
+                return float(ln.split()[1])
+        raise AssertionError(f"no timing in output: {r.stdout}")
+
+    cold = round_time()
+    assert os.listdir(str(tmp_path)), \
+        "init did not wire the persistent compile cache"
+    warm = round_time()
+    # generous bound: warm resets measured ~10x faster; flag anything
+    # that did a full recompile
+    assert warm < cold * 0.6, (
+        f"post-reset re-init recompiled: cold {cold:.2f}s vs warm "
+        f"{warm:.2f}s — compile cache not effective")

@@ -85,3 +85,20 @@ def test_tf_keras_fit_example():
     pytest.importorskip("keras")
     out = _run_example("tf_keras_fit_mnist.py")
     assert "final accuracy" in out, out
+
+
+def test_scaling_report():
+    """--scaling-report 1 vs N on the virtual CPU mesh: the harness
+    itself must run end to end and emit the JSON line (on a pod the same
+    flag measures real 1→N chip efficiency; BASELINE.md north star)."""
+    import json
+
+    out = _run_example("synthetic_benchmark.py", "--scaling-report", "4",
+                       "--batch-size", "2", "--image-size", "32",
+                       "--num-iters", "2", "--num-batches-per-iter", "2",
+                       "--dtype", "float32")
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["n"] == 4
+    assert rec["scaling_efficiency"] > 0

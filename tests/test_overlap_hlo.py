@@ -1,0 +1,125 @@
+"""Collective/compute overlap evidence from TPU-scheduled HLO.
+
+The 90%-scaling north star (BASELINE.md) rests on XLA overlapping
+per-bucket gradient all-reduces with backward compute inside the
+compiled DP train step (`optim/optimizer.py` reduce_gradients_in_jit).
+These tests make that claim checkable without TPU hardware: they
+AOT-compile the step for a real v5e 2x4 topology via the PJRT
+compile-only client (jax.experimental.topologies) and assert on the
+OPTIMIZED, SCHEDULED module that collectives are interleaved with
+backward compute — not sunk to the end of the schedule.
+
+Skipped automatically where the TPU compile-only client is unavailable
+(pure-CPU CI images); on this repo's target environment it runs without
+any TPU chips attached.
+
+Reference analog: overlap is the entire point of the reference's
+background thread + NCCL stream machinery (nccl_operations.cc:308);
+here the XLA scheduler provides it, and this test pins that it does.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _topo_mesh(names, shape):
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x4")
+    except Exception as e:  # pragma: no cover - CI without libtpu
+        pytest.skip(f"TPU compile-only client unavailable: {e}")
+    return Mesh(np.array(topo.devices).reshape(shape), names)
+
+
+def _entry_instructions(hlo_text):
+    m = re.search(r"ENTRY [^{]*\{(.*?)\n\}", hlo_text, re.S)
+    assert m, "no ENTRY computation in HLO"
+    return [ln.strip() for ln in m.group(1).splitlines() if " = " in ln]
+
+
+def _dp_step(mesh, axes, width=4096):
+    """A 6-layer MLP DP train step through the framework's in-jit
+    reduction, one psum bucket per layer (tiny threshold). Layers are
+    32 MB so the buckets survive XLA's all-reduce combiner — smaller
+    grads get merged into one tupled all-reduce, which is the combiner
+    doing its job but leaves nothing to interleave."""
+    from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
+
+    nlayer = 6
+    params = {f"w{i}": jnp.ones((width, width), jnp.bfloat16)
+              for i in range(nlayer)}
+
+    def local_step(p, x):
+        def loss(p):
+            h = x
+            for i in range(nlayer):
+                h = jnp.tanh(h @ p[f"w{i}"])
+            return jnp.sum(h.astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss)(p)
+        g = reduce_gradients_in_jit(g, axis=axes, num_ranks=8,
+                                    fusion_threshold_bytes=1)
+        return jax.tree_util.tree_map(
+            lambda a, b: (a - 0.1 * b).astype(a.dtype), p, g)
+
+    spec_x = P(axes) if isinstance(axes, str) else P(axes[0])
+    step = jax.shard_map(local_step, mesh=mesh,
+                         in_specs=(P(), spec_x), out_specs=P(),
+                         check_vma=False)
+    x = jnp.ones((256, width), jnp.bfloat16)
+    return jax.jit(step).lower(params, x)
+
+
+def test_dp_step_allreduces_interleave_with_backward():
+    mesh = _topo_mesh(("hvd",), (8,))
+    comp = _dp_step(mesh, "hvd").compile()
+    lines = _entry_instructions(comp.as_text())
+
+    def is_ar(ln):
+        # scheduled-HLO form: %name = (tuple types...) all-reduce(...)
+        return re.search(r" all-reduce\(", ln) is not None
+
+    def is_compute(ln):
+        # MXU work in the scheduled module: fused convolutions/dots ride
+        # in %fusion/%custom-call ops
+        return ("fusion(" in ln or "custom-call(" in ln) \
+            and "all-reduce" not in ln
+
+    ar = [i for i, ln in enumerate(lines) if is_ar(ln)]
+    compute = [i for i, ln in enumerate(lines) if is_compute(ln)]
+    assert len(ar) >= 3, (
+        f"expected per-bucket all-reduces, got {len(ar)} - "
+        "did the combiner swallow them?")
+    assert compute, "no fused compute in the scheduled module"
+    # Interleaving, the actual overlap evidence: at least one gradient
+    # all-reduce is SCHEDULED BEFORE later backward compute (XLA runs
+    # collectives concurrently with subsequent ops), rather than the
+    # whole reduction phase trailing the compute phase.
+    assert min(ar) < max(compute), (
+        "all collectives are sunk to the end of the schedule - "
+        "no overlap with backward compute")
+
+
+def test_hierarchical_mesh_dp_step_compiles_with_collectives():
+    """dcn x ici mesh: psum over both axes — XLA decomposes onto the
+    hierarchy itself (the in-jit analog of the eager RS-ici → AR-dcn →
+    AG-ici path, ops/collectives.py)."""
+    mesh = _topo_mesh(("dcn", "ici"), (2, 4))
+    comp = _dp_step(mesh, ("dcn", "ici")).compile()
+    txt = comp.as_text()
+    assert "all-reduce" in txt
+    # every device participates: the flattened replica groups cover 0..7
+    groups = re.findall(r"replica_groups=\{([^}]*)\}", txt)
+    assert groups, "no replica groups in scheduled module"
+    covered = set()
+    for g in groups:
+        covered |= {int(t) for t in re.findall(r"\d+", g)}
+    assert covered == set(range(8))

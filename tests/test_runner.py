@@ -162,3 +162,144 @@ def test_check_build_reports_capabilities(capsys):
     assert "horovod-tpu v" in out
     assert "[X] JAX (native)" in out
     assert "XLA collectives" in out
+
+
+def test_flag_parity_env_mappings():
+    """Round-4 flag sweep (reference launch.py:286-595): every new flag
+    with engine meaning lands in the right HOROVOD_* env knob."""
+    from horovod_tpu.common import config as C
+
+    args = build_parser().parse_args([
+        "-np", "1",
+        "--hierarchical-allreduce", "--no-hierarchical-allgather",
+        "--autotune-warmup-samples", "5", "--autotune-steps-per-sample",
+        "7", "--autotune-bayes-opt-max-samples", "11",
+        "--autotune-gaussian-process-noise", "0.7",
+        "--no-stall-check", "--stall-check-warning-time-seconds", "30",
+        "--stall-check-shutdown-time-seconds", "90",
+        "--log-without-timestamp", "x"])
+    env = args_to_env(args)
+    assert env[C.HOROVOD_HIERARCHICAL_ALLREDUCE] == "1"
+    assert env[C.HOROVOD_HIERARCHICAL_ALLGATHER] == "0"
+    assert env[C.HOROVOD_AUTOTUNE_WARMUP_SAMPLES] == "5"
+    assert env[C.HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE] == "7"
+    assert env[C.HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES] == "11"
+    assert env[C.HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE] == "0.7"
+    assert env[C.HOROVOD_STALL_CHECK_DISABLE] == "1"
+    assert env[C.HOROVOD_STALL_CHECK_TIME_SECONDS] == "30"
+    assert env[C.HOROVOD_STALL_SHUTDOWN_TIME_SECONDS] == "90"
+    assert env[C.HOROVOD_LOG_HIDE_TIME] == "1"
+
+
+def test_hostfile_parsing(tmp_path):
+    f = tmp_path / "hosts"
+    f.write_text("# comment\nh1 slots=4\nh2:2\nh3\n")
+    from horovod_tpu.runner.launch import parse_hostfile
+    assert parse_hostfile(str(f)) == "h1:4,h2:2,h3:1"
+    bad = tmp_path / "bad"
+    bad.write_text("h1 slots=x\n")
+    with pytest.raises(HorovodTpuError):
+        parse_hostfile(str(bad))
+
+
+def test_config_file_merge_cli_wins(tmp_path):
+    from horovod_tpu.runner.launch import apply_config_file
+
+    f = tmp_path / "cfg.yaml"
+    f.write_text("fusion-threshold-mb: 32\ncache-capacity: 7\n"
+                 "num-proc: 8\nhierarchical-allreduce: true\n")
+    parser = build_parser()
+    # every CLI spelling must beat the config file: --flag=value form,
+    # short form -np, plain --flag value form
+    argv = ["-np", "4", "--config-file", str(f),
+            "--fusion-threshold-mb=64", "x"]
+    args = apply_config_file(str(f), parser, argv)
+    assert args.fusion_threshold_mb == 64  # --flag=value beats config
+    assert args.num_proc == 4              # short form beats config
+    assert args.cache_capacity == 7        # config file fills the gap
+    # dest-differs-from-spelling keys resolve (hier_allreduce dest)
+    assert args.hier_allreduce is True
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("no-such-flag: 1\n")
+    with pytest.raises(HorovodTpuError):
+        apply_config_file(str(bad), build_parser(), argv)
+
+
+def test_config_file_negated_flag_semantics(tmp_path):
+    """`stall-check: true` must ENABLE checking (through the store_false
+    no_stall_check action) — naive dest mapping inverted these."""
+    from horovod_tpu.runner.launch import apply_config_file
+
+    f = tmp_path / "cfg.yaml"
+    f.write_text("stall-check: true\nno-hierarchical-allreduce: true\n"
+                 "log-with-timestamp: true\n")
+    args = apply_config_file(str(f), build_parser(), ["-np", "1", "x"])
+    assert args.no_stall_check is False      # checking stays ON
+    assert args.hier_allreduce is False      # hierarchical forced OFF
+    assert args.log_hide_timestamp is False  # timestamps stay shown
+    env = args_to_env(args)
+    from horovod_tpu.common import config as C
+    assert env[C.HOROVOD_STALL_CHECK_DISABLE] == "0"
+    assert env[C.HOROVOD_HIERARCHICAL_ALLREDUCE] == "0"
+    assert env[C.HOROVOD_LOG_HIDE_TIME] == "0"
+
+
+def test_cli_hosts_beats_config_hostfile(tmp_path):
+    """-H on the command line wins over a config-file hostfile instead
+    of tripping the pass-one-not-both guard."""
+    from unittest import mock
+
+    from horovod_tpu.runner import launch as launch_mod
+
+    hf = tmp_path / "hosts"
+    hf.write_text("confighost:4\n")
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(f"hostfile: {hf}\n")
+    seen = {}
+
+    def fake_launch_static(np, hosts, *a, **kw):
+        seen["hosts"] = hosts
+        return 0
+
+    with mock.patch.object(launch_mod, "launch_static",
+                           fake_launch_static):
+        rc = launch_mod.run_commandline(
+            ["--config-file", str(cfg), "-H", "clihost:2", "--", "true"])
+    assert rc == 0
+    assert seen["hosts"] == "clihost:2"
+
+
+def test_ssh_options_in_remote_command():
+    from horovod_tpu.runner.launch import make_worker_cmd
+
+    slot = hosts_mod.SlotInfo(hostname="remotehost", rank=1, size=2,
+                              local_rank=0, local_size=1, cross_rank=1,
+                              cross_size=2)
+    cmd, _ = make_worker_cmd(slot, ["python", "t.py"], {},
+                             ssh_port=2222, ssh_identity_file="/k.pem")
+    assert cmd[0] == "ssh"
+    assert "-p" in cmd and cmd[cmd.index("-p") + 1] == "2222"
+    assert "-i" in cmd and cmd[cmd.index("-i") + 1] == "/k.pem"
+
+
+def test_output_filename_writes_per_rank_logs(tmp_path):
+    from horovod_tpu.runner.launch import launch_static
+
+    rc = launch_static(
+        2, "localhost:2",
+        [sys.executable, "-c", "import os;print('hello from',"
+                               "os.environ['HOROVOD_RANK'])"],
+        {}, output_dir=str(tmp_path), prefix_timestamp=True)
+    assert rc == 0
+    for r in (0, 1):
+        content = (tmp_path / f"rank.{r}" / "stdout").read_text()
+        assert f"hello from {r}" in content
+
+
+def test_version_flag(capsys):
+    from horovod_tpu.runner.launch import run_commandline
+
+    with pytest.raises(SystemExit) as ei:
+        run_commandline(["--version"])
+    assert ei.value.code == 0
+    assert "horovod-tpu" in capsys.readouterr().out

@@ -274,3 +274,36 @@ def test_lr_schedule_smooth_and_reference_kwargs(hvd):
     cb.on_train_batch_end(1)  # epoch 1.5 -> 0.5**1.5
     np.testing.assert_allclose(float(model.optimizer.learning_rate),
                                0.5 ** 1.5, rtol=1e-5)
+
+
+def test_tf_jit_compile_pinned_error(hvd):
+    """`tf.function(jit_compile=True)` around a collective fails with TF's
+    unsupported-op (EagerPyFunc) error: the graph bridge re-enters the
+    eager engine via py_function, which TF-XLA cannot compile. Pinned here
+    so the failure mode is a contract, not a surprise; the migration path
+    is documented in docs/migration.md ("TF-XLA training steps"). The
+    reference compiles collectives under TF-XLA via paired async custom
+    calls (tensorflow/xla_mpi_ops.cc:176-218) — an intentionally
+    unreplicated design: this framework's XLA-native path is the jax
+    frontend, where the collective IS an XLA op inside the jitted step.
+    """
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    @tf.function(jit_compile=True)
+    def step(x):
+        return tfvd.allreduce(x, op=tfvd.Sum, name="xla_pin")
+
+    with pytest.raises(Exception) as ei:
+        step(tf.constant([1.0, 2.0]))
+    msg = str(ei.value)
+    assert "EagerPyFunc" in msg or "unsupported operations" in msg
+    # plain tf.function (no jit_compile) with the same collective works
+    @tf.function
+    def step_ok(x):
+        return tfvd.allreduce(x, op=tfvd.Sum, name="xla_pin_ok")
+
+    out = step_ok(tf.constant([1.0, 2.0]))
+    np.testing.assert_allclose(out.numpy(),
+                               np.array([1.0, 2.0]) * hvd.size())

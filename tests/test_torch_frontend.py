@@ -342,3 +342,29 @@ def test_sparse_allreduce_async_api(hvd):
     assert out.is_sparse
     k = thvd.size()
     torch.testing.assert_close(out.to_dense()[0], torch.tensor([1.0, 2.0]) * k)
+
+
+def test_torch_bfloat16_roundtrip(hvd):
+    """bf16 tensors cross the boundary via DLPack (numpy has no bfloat16 —
+    the numpy bridge raises on them), preserving dtype end to end."""
+    import horovod_tpu.frontends.torch as thvd
+
+    # shape (5,): avoid the emulated-world-size leading dim, which the
+    # engine interprets as an already-stacked per-rank input
+    t = torch.arange(5, dtype=torch.float32).to(torch.bfloat16)
+    out = thvd.allreduce(t, op=thvd.Sum, name="bf16rt")
+    assert out.dtype == torch.bfloat16
+    assert out.shape == t.shape
+    torch.testing.assert_close(
+        out.float(), t.float() * thvd.size(), rtol=0.02, atol=0.02)
+
+
+def test_torch_dlpack_zero_copy_ingest(hvd):
+    """The torch→engine bridge hands over a DLPack view, not a copy, for
+    contiguous CPU tensors (the migration path's per-step boundary cost)."""
+    from horovod_tpu.frontends.torch import _to_np
+
+    t = torch.arange(6, dtype=torch.float32)
+    a = _to_np(t)
+    t[0] = 42.0  # shared memory: the view sees the write
+    assert float(np.asarray(a)[0]) == 42.0
