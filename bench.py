@@ -261,13 +261,8 @@ def bench_flash_attention(S=8192, iters=10):
         np.asarray(out[0][0, 0, 0])
         # slope over iteration count: cancels the fixed tunnel round-trip
         # (~20 ms/iter inflation on a 10-iter single-sync loop — half the
-        # flash kernel's own runtime); clamped to the amortized upper
-        # bound if jitter swamps the slope
-        tk, t2k = run(n_iters), run(2 * n_iters)
-        dt = (t2k - tk) / n_iters
-        if dt <= 0:
-            dt = t2k / (2 * n_iters)
-        return dt * 1e3
+        # flash kernel's own runtime)
+        return _slope_ms(run, n_iters)
 
     flash_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)  # noqa: E731
     t_flash = timed(flash_fn, (q, k, v), iters)
@@ -344,6 +339,24 @@ def bench_transformer(on_cpu, steps, warmup):
     }
 
 
+def _slope_ms(run, k, reps=2):
+    """The ONE slope-with-clamp implementation every eager-path bench
+    shares: `run(n)` executes n pipelined calls with one sync and
+    returns seconds; the marginal per-call ms is the best positive
+    slope between k- and 2k-call runs, falling back to the amortized
+    per-call time (an upper bound, never negative) if jitter swamped
+    every slope sample."""
+    best = float("inf")
+    fallback = float("inf")
+    for _ in range(reps):
+        tk, t2k = run(k), run(2 * k)
+        slope = (t2k - tk) / k
+        if slope > 0:
+            best = min(best, slope)
+        fallback = min(fallback, t2k / (2 * k))
+    return (best if best != float("inf") else fallback) * 1e3
+
+
 def _eager_marginal(fn, k=5, reps=2):
     """Marginal per-call ms of an eager-path op: time k calls vs 2k calls
     (one sync each) and take the slope. Eager dispatches pipeline through
@@ -364,17 +377,7 @@ def _eager_marginal(fn, k=5, reps=2):
 
     run(1)  # warm (compile outside the timed region)
     run(1)  # second warm call: first post-compile execs run slow
-    best = float("inf")
-    fallback = float("inf")
-    for _ in range(reps):
-        tk, t2k = run(k), run(2 * k)
-        slope = (t2k - tk) / k
-        if slope > 0:
-            best = min(best, slope)
-        fallback = min(fallback, t2k / (2 * k))
-    # never a negative marginal: fall back to amortized per-call time
-    # (upper bound) if jitter swamped every slope sample
-    return (best if best != float("inf") else fallback) * 1e3
+    return _slope_ms(run, k, reps)
 
 
 # --------------------------------------------------------------------------
@@ -452,14 +455,10 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
             return time.perf_counter() - t0
 
         run(1)
-        # slope over step count cancels the fixed tunnel round-trip
-        # (see _scan_timed); eager steps pipeline, so the marginal cost
-        # is the real per-step cost of the eager migration path. Clamp:
-        # if jitter swamps the slope, report the amortized upper bound.
-        tk, t2k = run(steps), run(2 * steps)
-        dt = (t2k - tk) / steps
-        if dt <= 0:
-            dt = t2k / (2 * steps)
+        # slope over step count cancels the fixed tunnel round-trip;
+        # eager steps pipeline, so the marginal cost is the real
+        # per-step cost of the eager migration path
+        dt = _slope_ms(run, steps) / 1e3
         out[f"{name}_samples_per_sec"] = round(batch / dt, 2)
         out[f"{name}_step_ms"] = round(dt * 1e3, 2)
     out["config"] = f"L{cfg.n_layers} D{cfg.d_model} H{cfg.n_heads} " \

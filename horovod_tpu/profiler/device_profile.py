@@ -1,0 +1,142 @@
+"""Per-op device-time profiling for a training step — "where do the
+milliseconds go", answered from a real device trace.
+
+The reference's timeline (`timeline.cc`, docs/timeline.rst) records
+host-side spans per collective; on TPU the interesting time lives
+INSIDE the compiled program, invisible to host spans. This module runs
+a step under `jax.profiler.trace`, parses the xplane protobuf the TPU
+runtime emits, and aggregates the "XLA Ops" stream into per-op and
+per-category tables (the tool that located ResNet-50's BN-backward HBM
+wall, docs/benchmarks.md).
+
+    from horovod_tpu.profiler.device_profile import profile_step
+    prof = profile_step(lambda: step(state))     # runs it reps times
+    print(prof.as_markdown())
+
+TPU-only at runtime (the CPU backend emits no per-op device plane);
+the xplane aggregation itself is platform-independent and unit-tested
+against synthetic traces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import re
+import tempfile
+from typing import Callable, Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS: List[Tuple[str, str]] = [
+    # (regex on op name, category) — first match wins
+    (r"select.and.scatter|select_and_scatter", "maxpool backward"),
+    (r"reduce.window|reduce_window", "pool forward"),
+    (r"all.reduce|all.gather|reduce.scatter|all.to.all|collective",
+     "collective"),
+    (r"conv", "convolution"),
+    (r"dot|matmul", "matmul"),
+    (r"multiply_reduce|reduce_fusion", "reduce fusion (stats/grads)"),
+    (r"copy|transpose|bitcast", "layout/copy"),
+    (r"fusion", "fused elementwise/compute"),
+]
+
+
+def classify(name: str,
+             buckets: Optional[List[Tuple[str, str]]] = None) -> str:
+    low = name.lower()
+    for pat, cat in (buckets or _DEFAULT_BUCKETS):
+        if re.search(pat, low):
+            return cat
+    return "other"
+
+
+@dataclasses.dataclass
+class DeviceProfile:
+    per_op: Dict[str, float]        # op name -> ms per step
+    per_category: Dict[str, float]  # category -> ms per step
+    total_ms: float
+    reps: int
+
+    def top_ops(self, n: int = 15) -> List[Tuple[str, float]]:
+        return sorted(self.per_op.items(), key=lambda kv: -kv[1])[:n]
+
+    def as_markdown(self, top: int = 15) -> str:
+        lines = [f"device ops total: {self.total_ms:.2f} ms/step "
+                 f"(mean of {self.reps})", "",
+                 "| category | ms/step | share |", "|---|---|---|"]
+        for cat, d in sorted(self.per_category.items(),
+                             key=lambda kv: -kv[1]):
+            share = d / self.total_ms if self.total_ms else 0.0
+            lines.append(f"| {cat} | {d:.2f} | {share:.1%} |")
+        lines += ["", "| op | ms/step |", "|---|---|"]
+        for name, d in self.top_ops(top):
+            lines.append(f"| `{name[:70]}` | {d:.2f} |")
+        return "\n".join(lines)
+
+
+def aggregate_xspace(xspace, reps: int = 1,
+                     buckets=None,
+                     device_substr: str = "/device:TPU") -> DeviceProfile:
+    """Aggregate an xplane XSpace's per-op device events.
+
+    Uses the "XLA Ops" line of every plane whose name contains
+    `device_substr` (one event per executed HLO op; the trace.json
+    export nests module/op spans and double-counts)."""
+    per_op: Dict[str, float] = {}
+    per_cat: Dict[str, float] = {}
+    total = 0.0
+    for plane in xspace.planes:
+        if device_substr not in plane.name:
+            continue
+        meta = plane.event_metadata
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for e in line.events:
+                name = meta[e.metadata_id].name
+                d = e.duration_ps / 1e9 / reps  # ps -> ms per step
+                per_op[name] = per_op.get(name, 0.0) + d
+                cat = classify(name, buckets)
+                per_cat[cat] = per_cat.get(cat, 0.0) + d
+                total += d
+    return DeviceProfile(per_op=per_op, per_category=per_cat,
+                         total_ms=total, reps=reps)
+
+
+def load_xspace(trace_dir: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb",
+                             recursive=True))
+    if not paths:
+        raise FileNotFoundError(
+            f"no xplane.pb under {trace_dir} — did the trace run?")
+    xs = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as fh:
+        xs.ParseFromString(fh.read())
+    return xs
+
+
+def profile_step(run_once: Callable[[], object], reps: int = 3,
+                 warmup: int = 1, buckets=None) -> DeviceProfile:
+    """Trace `run_once` (called `reps` times) and aggregate device ops.
+
+    `run_once` must block on its own completion (return a value the
+    caller has synced, or sync internally); compile before calling —
+    warmup executions here only drain post-compile slowness."""
+    import jax
+
+    for _ in range(warmup):
+        out = run_once()
+    jax.block_until_ready(out)
+    tmpdir = tempfile.mkdtemp(prefix="hvd_devprof")
+    with jax.profiler.trace(tmpdir):
+        for _ in range(reps):
+            out = run_once()
+        jax.block_until_ready(out)
+    prof = aggregate_xspace(load_xspace(tmpdir), reps=reps,
+                            buckets=buckets)
+    if not prof.per_op:
+        raise RuntimeError(
+            "trace contains no per-op device events — the CPU backend "
+            "emits none; run on TPU (or pass the right device_substr)")
+    return prof

@@ -133,13 +133,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "'auto' = built-in SSH launcher, jsrun inside an "
                         "LSF allocation; 'mpi' forces mpirun")
     # Reference controller aliases (horovodrun --gloo/--mpi/--jsrun): the
-    # built-in rendezvous launcher is the gloo analog.
-    p.add_argument("--gloo", dest="use_gloo", action="store_true",
-                   help="alias for --launcher default")
-    p.add_argument("--mpi", dest="use_mpi", action="store_true",
-                   help="alias for --launcher mpi")
-    p.add_argument("--jsrun", dest="use_jsrun", action="store_true",
-                   help="alias for --launcher jsrun")
+    # built-in rendezvous launcher is the gloo analog. Mutually
+    # exclusive — the reference errors on conflicting controller flags.
+    ctrl = p.add_mutually_exclusive_group()
+    ctrl.add_argument("--gloo", dest="use_gloo", action="store_true",
+                      help="alias for --launcher default")
+    ctrl.add_argument("--mpi", dest="use_mpi", action="store_true",
+                      help="alias for --launcher mpi")
+    ctrl.add_argument("--jsrun", dest="use_jsrun", action="store_true",
+                      help="alias for --launcher jsrun")
     p.add_argument("--mpi-args", default=None,
                    help="extra args passed through to mpirun "
                         "(reference: --mpi-args '--map-by ppr:4:socket')")
@@ -267,10 +269,21 @@ def parse_hostfile(path: str) -> str:
             line = raw.split("#", 1)[0].strip()
             if not line:
                 continue
-            m = re.match(r"^(\S+?)(?::(\d+)| +slots=(\d+))?$", line)
-            if not m:
-                raise HorovodTpuError(f"malformed hostfile line: {raw!r}")
-            host, c1, c2 = m.groups()
+            mb = re.match(r"^\[([^\]]+)\](?::(\d+)| +slots=(\d+))?$", line)
+            if mb:  # bracketed IPv6: [::1]:4 / [::1] slots=4
+                host, c1, c2 = mb.groups()
+            elif line.count(":") > 1:
+                # bare IPv6 literal: the whole token is the host (a
+                # :N suffix would be ambiguous — require brackets)
+                host, c1, c2 = line.split()[0], None, None
+                if " slots=" in line:
+                    c2 = line.rsplit("slots=", 1)[1]
+            else:
+                m = re.match(r"^(\S+?)(?::(\d+)| +slots=(\d+))?$", line)
+                if not m:
+                    raise HorovodTpuError(
+                        f"malformed hostfile line: {raw!r}")
+                host, c1, c2 = m.groups()
             spec.append(f"{host}:{c1 or c2 or 1}")
     if not spec:
         raise HorovodTpuError(f"hostfile {path} is empty")
@@ -557,13 +570,18 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             args.hosts = None
     if args.check_build:
         return check_build()
-    # reference controller aliases → --launcher
-    if args.use_mpi:
-        args.launcher = "mpi"
-    elif args.use_jsrun:
-        args.launcher = "jsrun"
-    elif args.use_gloo:
-        args.launcher = "default"
+    # reference controller aliases → --launcher (exclusive group keeps
+    # --mpi --gloo out; an alias may not contradict an explicit
+    # --launcher either)
+    alias = ("mpi" if args.use_mpi else "jsrun" if args.use_jsrun
+             else "default" if args.use_gloo else None)
+    if alias is not None:
+        if args.launcher not in ("auto", alias):
+            print(f"horovodrun-tpu: --launcher {args.launcher} "
+                  f"contradicts the --{alias if alias != 'default' else 'gloo'} "
+                  f"controller flag", file=sys.stderr)
+            return 2
+        args.launcher = alias
     if args.hostfile:
         if args.hosts:
             print("horovodrun-tpu: pass -H or --hostfile, not both",
@@ -609,6 +627,23 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     # the default; mpi/jsrun cover clusters where those are the only
     # sanctioned placers. The data plane is XLA regardless.
     launcher = getattr(args, "launcher", "auto")
+    if launcher in ("mpi", "jsrun") or (launcher == "auto"
+                                        and args.hosts is None
+                                        and _prefer_jsrun()):
+        # flags only the built-in launcher implements must not be
+        # silently dropped when another placer runs the workers
+        dropped = [f for f, v in (
+            ("--output-filename", args.output_filename),
+            ("--ssh-port", args.ssh_port),
+            ("--ssh-identity-file", args.ssh_identity_file),
+            ("--prefix-output-with-timestamp", args.prefix_timestamp),
+        ) if v]
+        if dropped:
+            print(f"horovodrun-tpu: {', '.join(dropped)} only apply to "
+                  f"the built-in launcher; ignored under "
+                  f"{'mpirun' if launcher == 'mpi' else 'jsrun'} "
+                  f"(use the placer's own redirection/ssh options)",
+                  file=sys.stderr)
     if launcher == "mpi":
         import shlex as _shlex
 

@@ -1,5 +1,5 @@
 """Per-op HLO cost table for the ResNet-50 train step, from a real
-device-side profiler trace (jax.profiler → xplane → trace.json).
+device-side profiler trace (profiler/device_profile.py).
 
 Answers "where do the ~46 ms go" with measured per-fusion durations
 instead of roofline guesses. Output: markdown table for
@@ -7,9 +7,7 @@ docs/benchmarks.md.
 
 Usage: PYTHONPATH=. python scripts/trace_resnet.py [batch]
 """
-import glob
 import sys
-import tempfile
 
 import jax
 import jax.numpy as jnp
@@ -17,6 +15,7 @@ import numpy as np
 import optax
 
 from horovod_tpu.models import resnet
+from horovod_tpu.profiler.device_profile import profile_step
 
 
 def build_step(batch, dtype=jnp.bfloat16):
@@ -41,78 +40,22 @@ def build_step(batch, dtype=jnp.bfloat16):
     return step, (params, stats, opt_state)
 
 
-def classify(name):
-    """Bucket a fusion/op name into a readable category."""
-    n = name.lower()
-    if "select-and-scatter" in n or "select_and_scatter" in n:
-        return "maxpool backward (SelectAndScatter)"
-    if "reduce-window" in n or "reduce_window" in n:
-        return "maxpool forward"
-    if "convolution" in n or "conv" in n:
-        return "conv (+fused elementwise)"
-    if "dot" in n:
-        return "matmul (fc)"
-    if "all-reduce" in n or "all_reduce" in n:
-        return "collective"
-    if "copy" in n or "transpose" in n or "bitcast" in n:
-        return "layout/copy"
-    if "reduce" in n:
-        return "reduce (BN stats/loss)"
-    if "scatter" in n:
-        return "scatter"
-    return "elementwise/other"
-
-
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     step, state = build_step(batch)
-    out = step(*state)
+    out = step(*state)  # compile
     jax.block_until_ready(out)
-    tmpdir = tempfile.mkdtemp(prefix="rn50trace")
-    reps = 3
-    with jax.profiler.trace(tmpdir):
-        s = state
-        for _ in range(reps):
-            s = step(*s[:3])
-        jax.block_until_ready(s)
-        float(np.asarray(s[-1]))
-    # Parse the xplane proto: the /device:TPU planes carry an "XLA Ops"
-    # line with one event per executed HLO op (the trace.json export
-    # nests module/op spans and double-counts).
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
-    f = sorted(glob.glob(f"{tmpdir}/**/*.xplane.pb", recursive=True))[-1]
-    xs = xplane_pb2.XSpace()
-    with open(f, "rb") as fh:
-        xs.ParseFromString(fh.read())
-    per_op = {}
-    per_cat = {}
-    total = 0.0
-    for plane in xs.planes:
-        if "/device:TPU" not in plane.name:
-            continue
-        meta = plane.event_metadata
-        for line in plane.lines:
-            if line.name != "XLA Ops":
-                continue
-            for e in line.events:
-                name = meta[e.metadata_id].name
-                d = e.duration_ps / 1e9 / reps  # ps -> ms, per step
-                per_op[name] = per_op.get(name, 0.0) + d
-                cat = classify(name)
-                per_cat[cat] = per_cat.get(cat, 0.0) + d
-                total += d
-    print(f"\nResNet-50 B={batch} bf16 train step — device ops "
-          f"(mean of {reps} steps), total {total:.1f} ms\n")
-    print("| category | ms/step | share |")
-    print("|---|---|---|")
-    for cat, d in sorted(per_cat.items(), key=lambda kv: -kv[1]):
-        print(f"| {cat} | {d:.2f} | {d / total:.1%} |")
-    print("\nTop 15 individual ops:\n")
-    print("| op | ms/step |")
-    print("|---|---|")
-    for name, d in sorted(per_op.items(), key=lambda kv: -kv[1])[:15]:
-        print(f"| `{name[:70]}` | {d:.2f} |")
+    holder = {"s": state}
+
+    def run_once():
+        s = step(*holder["s"][:3])
+        holder["s"] = s
+        return s
+
+    prof = profile_step(run_once, reps=3, warmup=1)
+    print(f"\nResNet-50 B={batch} bf16 train step\n")
+    print(prof.as_markdown())
 
 
 if __name__ == "__main__":

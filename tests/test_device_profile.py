@@ -1,0 +1,72 @@
+"""Device-profile aggregation (profiler/device_profile.py) against a
+synthetic xplane — the parsing/aggregation must be right without TPU
+hardware; the e2e path (jax.profiler → xplane → table) runs on TPU via
+scripts/trace_resnet.py."""
+
+import pytest
+
+pytest.importorskip("tensorflow")
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E402
+
+from horovod_tpu.profiler.device_profile import (  # noqa: E402
+    aggregate_xspace, classify)
+
+
+def _make_xspace():
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+    plane.event_metadata[1].id = 1
+    plane.event_metadata[1].name = "%convolution_fusion.1"
+    plane.event_metadata[2].id = 2
+    plane.event_metadata[2].name = "%select_and_scatter.9"
+    plane.event_metadata[3].id = 3
+    plane.event_metadata[3].name = "%copy-done.5"
+    line = plane.lines.add()
+    line.name = "XLA Ops"
+    for mid, dur_ms, n in ((1, 2.0, 3), (2, 0.5, 3), (3, 0.1, 6)):
+        for _ in range(n):
+            e = line.events.add()
+            e.metadata_id = mid
+            e.duration_ps = int(dur_ms * 1e9)
+    # a host plane that must be ignored
+    host = xs.planes.add()
+    host.name = "/host:CPU"
+    hl = host.lines.add()
+    hl.name = "XLA Ops"
+    he = hl.events.add()
+    he.metadata_id = 1
+    he.duration_ps = int(99e9)
+    host.event_metadata[1].id = 1
+    host.event_metadata[1].name = "host_noise"
+    return xs
+
+
+def test_aggregate_per_op_and_category():
+    prof = aggregate_xspace(_make_xspace(), reps=3)
+    # per step: conv 2.0, sas 0.5, copies 0.1*6/3 = 0.2
+    assert prof.per_op["%convolution_fusion.1"] == pytest.approx(2.0)
+    assert prof.per_op["%select_and_scatter.9"] == pytest.approx(0.5)
+    assert prof.per_op["%copy-done.5"] == pytest.approx(0.2)
+    assert prof.total_ms == pytest.approx(2.7)
+    assert prof.per_category["convolution"] == pytest.approx(2.0)
+    assert prof.per_category["maxpool backward"] == pytest.approx(0.5)
+    assert prof.per_category["layout/copy"] == pytest.approx(0.2)
+    # host plane excluded
+    assert "host_noise" not in prof.per_op
+
+
+def test_markdown_and_top_ops():
+    prof = aggregate_xspace(_make_xspace(), reps=3)
+    md = prof.as_markdown(top=2)
+    assert "| convolution | 2.00 |" in md
+    assert md.count("| `%") == 2  # top=2 individual rows
+    assert prof.top_ops(1)[0][0] == "%convolution_fusion.1"
+
+
+def test_classify_buckets():
+    assert classify("%multiply_reduce_fusion.4") == \
+        "reduce fusion (stats/grads)"
+    assert classify("%all-reduce.1") == "collective"
+    assert classify("%weird_thing") == "other"

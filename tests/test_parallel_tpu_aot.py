@@ -1,0 +1,70 @@
+"""The stretch parallelism paths (tp/sp/ring, pp/ep/MoE) compiled by
+the REAL TPU compiler — not just the virtual CPU mesh the rest of the
+suite (and the driver dryrun) uses.
+
+AOT compile-only v5e:2x4 topology (see test_overlap_hlo.py): validates
+that the shardings lower through the actual TPU backend — layout
+assignment, collective lowering, pipelining — and that the expected
+collective structure is present: ring attention produces
+collective-permutes, MoE expert dispatch produces all-to-alls, the
+pipeline loop a while op. Skips where the TPU compile-only client is
+unavailable.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+def _v5e_devices():
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x4")
+    except Exception as e:  # pragma: no cover - CI without libtpu
+        pytest.skip(f"TPU compile-only client unavailable: {e}")
+    return list(topo.devices)
+
+
+def _compile(spec, cfg, seq, batch):
+    mesh = build_mesh(spec, devices=_v5e_devices())
+    tfm.validate_cfg_for_mesh(cfg, mesh)
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    step = tfm.build_train_step(cfg, mesh, opt)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    lower = step.lower if hasattr(step, "lower") else \
+        jax.jit(step).lower
+    return lower(params, opt_state, tokens, tokens).compile().as_text()
+
+
+def test_ring_tp_sp_train_step_lowers_on_tpu():
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                d_ff=64, n_layers=2, max_seq=64,
+                                attn="ring")
+    txt = _compile(MeshSpec(dp=2, sp=2, tp=2), cfg, seq=32, batch=8)
+    # ring attention rotates k/v around the sp axis
+    assert txt.count("collective-permute") >= 4, \
+        "ring attention lost its collective-permutes on TPU"
+    # tp + dp gradient reduction
+    assert "all-reduce" in txt
+
+
+def test_pp_ep_moe_train_step_lowers_on_tpu():
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                d_ff=64, n_layers=2, max_seq=64,
+                                attn="local", num_experts=4,
+                                microbatches=2)
+    txt = _compile(MeshSpec(dp=2, pp=2, ep=2), cfg, seq=32, batch=8)
+    # MoE expert dispatch/return rides all-to-all over the ep axis
+    assert "all-to-all" in txt, "MoE dispatch lost its all-to-alls"
+    # pipeline microbatch loop
+    assert "while(" in txt
+    assert "all-reduce" in txt

@@ -303,3 +303,43 @@ def test_version_flag(capsys):
         run_commandline(["--version"])
     assert ei.value.code == 0
     assert "horovod-tpu" in capsys.readouterr().out
+
+
+def test_hostfile_ipv6_literals(tmp_path):
+    from horovod_tpu.runner.launch import parse_hostfile
+
+    f = tmp_path / "hosts"
+    f.write_text("[::1]:4\n::1\nfe80::2 slots=2\n")
+    # always emits an explicit :N suffix so parse_hosts' rsplit(':', 1)
+    # recovers the IPv6 host intact
+    assert parse_hostfile(str(f)) == "::1:4,::1:1,fe80::2:2"
+    parsed = hosts_mod.parse_hosts(parse_hostfile(str(f)))
+    assert [(h.hostname, h.slots) for h in parsed] == \
+        [("::1", 4), ("::1", 1), ("fe80::2", 2)]
+
+
+def test_controller_alias_conflicts():
+    from horovod_tpu.runner.launch import run_commandline
+
+    # exclusive group: --mpi --gloo is a parse error
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["-np", "1", "--mpi", "--gloo", "x"])
+    # alias contradicting an explicit --launcher is a diagnostic exit
+    rc = run_commandline(["-np", "1", "--launcher", "mpi", "--gloo",
+                          "--", "true"])
+    assert rc == 2
+
+
+def test_placer_only_flags_warn_on_mpi(capsys):
+    from unittest import mock
+
+    from horovod_tpu.runner import launch as launch_mod
+
+    with mock.patch("horovod_tpu.runner.mpi_run.mpi_run",
+                    return_value=0) as mr:
+        rc = launch_mod.run_commandline(
+            ["-np", "1", "--mpi", "--output-filename", "/tmp/x",
+             "--", "true"])
+    assert rc == 0 and mr.called
+    err = capsys.readouterr().err
+    assert "--output-filename" in err and "ignored" in err
