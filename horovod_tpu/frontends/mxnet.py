@@ -35,6 +35,9 @@ from horovod_tpu.core.join import join  # noqa: F401
 Average = T.ReduceOp.AVERAGE
 Sum = T.ReduceOp.SUM
 Adasum = T.ReduceOp.ADASUM
+Min = T.ReduceOp.MIN
+Max = T.ReduceOp.MAX
+Product = T.ReduceOp.PRODUCT
 
 # One serialized dispatch queue across frontends (torch.py owns it).
 _run_serialized = _torch_front._run_serialized

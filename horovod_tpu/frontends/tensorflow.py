@@ -50,6 +50,9 @@ from horovod_tpu.ops import collectives as C
 Average = T.ReduceOp.AVERAGE
 Sum = T.ReduceOp.SUM
 Adasum = T.ReduceOp.ADASUM
+Min = T.ReduceOp.MIN
+Max = T.ReduceOp.MAX
+Product = T.ReduceOp.PRODUCT
 
 
 def _tf():
@@ -190,6 +193,98 @@ def grouped_allreduce(tensors, average: Optional[bool] = None, name=None,
     return [_like(o, t, keep_shape=True) for o, t in zip(outs, tensors)]
 
 
+def grouped_allgather(tensors, name=None,
+                      process_set: Optional[ProcessSet] = None) -> List[Any]:
+    """Reference: tensorflow/mpi_ops.py grouped_allgather. Works eagerly
+    and inside tf.function (py_function bridge; output shapes are
+    data-dependent on world size, so they stay unknown in-graph)."""
+    if _in_graph() and tensors:
+        def _eager(*ts):
+            outs = C.grouped_allgather([t.numpy() for t in ts],
+                                       name=name,
+                                       process_set=process_set)
+            return [_like(o, t) for o, t in zip(outs, ts)]
+
+        outs = _py_collective(_eager, list(tensors),
+                              [t.dtype for t in tensors])
+        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    outs = C.grouped_allgather([_to_np(t) for t in tensors], name=name,
+                               process_set=process_set)
+    return [_like(o, t) for o, t in zip(outs, tensors)]
+
+
+def grouped_reducescatter(tensors, op=None,
+                          process_set: Optional[ProcessSet] = None,
+                          **kw) -> List[Any]:
+    """Reference: tensorflow/mpi_ops.py grouped_reducescatter. Works
+    eagerly and inside tf.function (py_function bridge)."""
+    rop = op if op is not None else Average
+    if _in_graph() and tensors:
+        def _eager(*ts):
+            outs = C.grouped_reducescatter([t.numpy() for t in ts],
+                                           op=rop,
+                                           process_set=process_set, **kw)
+            return [_like(o, t) for o, t in zip(outs, ts)]
+
+        outs = _py_collective(_eager, list(tensors),
+                              [t.dtype for t in tensors])
+        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    outs = C.grouped_reducescatter(
+        [_to_np(t) for t in tensors], op=rop,
+        process_set=process_set, **kw)
+    return [_like(o, t) for o, t in zip(outs, tensors)]
+
+
+# -- topology-as-tensor ops (reference: mpi_ops.py:576-659 — graph-time
+# ops whose VALUE is evaluated at run time; here topology is fixed per
+# init, so constants carry the same contract) ------------------------------
+
+def size_op(process_set_id: int = 0, name=None):
+    tf = _tf()
+    from horovod_tpu.core.process_sets import _table
+    k = _table().get(process_set_id).size() if process_set_id else size()
+    return tf.constant(k, dtype=tf.int32, name=name)
+
+
+def process_set_included_op(process_set_id: int = 0, name=None):
+    tf = _tf()
+    from horovod_tpu.core.process_sets import _table
+    inc = rank() in (_table().get(process_set_id).ranks or []) \
+        if process_set_id else True
+    return tf.constant(int(inc), dtype=tf.int32, name=name)
+
+
+def local_size_op(name=None):
+    return _tf().constant(local_size(), dtype=_tf().int32, name=name)
+
+
+def rank_op(name=None):
+    return _tf().constant(rank(), dtype=_tf().int32, name=name)
+
+
+def local_rank_op(name=None):
+    return _tf().constant(local_rank(), dtype=_tf().int32, name=name)
+
+
+def broadcast_(variables, root_rank: int, name=None,
+               process_set: Optional[ProcessSet] = None):
+    """In-place broadcast of tf.Variables (reference: mpi_ops.py:359)."""
+    for v in variables:
+        v.assign(broadcast(v, root_rank, name=name,
+                           process_set=process_set))
+    return variables
+
+
+def broadcast_object_fn(root_rank: int = 0, session=None, name=None,
+                        process_set: Optional[ProcessSet] = None):
+    """Reference: functions.py:144 — returns a callable that broadcasts
+    an arbitrary object (session is a TF1 relic, accepted and unused)."""
+    def _fn(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set=process_set)
+    return _fn
+
+
 def broadcast(tensor, root_rank: int, name=None,
               process_set: Optional[ProcessSet] = None):
     if _in_graph():
@@ -278,9 +373,11 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
         v.assign(broadcast(v, root_rank))
 
 
-def broadcast_object(obj, root_rank: int = 0, name=None):
+def broadcast_object(obj, root_rank: int = 0, name=None,
+                     process_set: Optional[ProcessSet] = None):
     from horovod_tpu.optim.functions import broadcast_object as _bo
-    return _bo(obj, root_rank=root_rank, name=name)
+    return _bo(obj, root_rank=root_rank, name=name,
+               process_set=process_set)
 
 
 def _make_allreduce_grads_fn(op, gradient_predivide_factor: float,
@@ -370,6 +467,41 @@ def _make_keras3_distributed(optimizer, compression, op,
                 "gradient_accumulation_steps-configured optimizer, not both")
         cfg["gradient_accumulation_steps"] = backward_passes_per_step
     return _DistKeras.from_config(cfg)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None, legacy_opts=False):
+    """Load a saved Keras model whose optimizer was a
+    DistributedOptimizer, re-wrapping it so retraining keeps reducing
+    gradients (reference: tensorflow/keras/__init__.py:234 load_model).
+
+    The dynamic subclass serializes under `Distributed<Base>`; this
+    registers a factory for that name for every optimizer in
+    `keras.optimizers` (plus any `custom_optimizers`), rebuilding the
+    base optimizer from its config and wrapping it. `legacy_opts` is a
+    TF-2 relic, accepted and ignored (Keras 3 has one optimizer
+    namespace)."""
+    import keras
+
+    comp = compression or Compression.none
+
+    def wrap_factory(base_cls):
+        class _Loader:
+            @staticmethod
+            def from_config(config, custom_objects=None):
+                config.pop("gradient_accumulation_steps_is_dist", None)
+                base = base_cls.from_config(config)
+                return DistributedOptimizer(base, compression=comp)
+        _Loader.__name__ = "Distributed" + base_cls.__name__
+        return _Loader
+
+    objs = dict(custom_objects or {})
+    bases = [c for c in vars(keras.optimizers).values()
+             if isinstance(c, type)
+             and issubclass(c, keras.optimizers.Optimizer)]
+    for c in bases + list(custom_optimizers or []):
+        objs.setdefault("Distributed" + c.__name__, wrap_factory(c))
+    return keras.models.load_model(filepath, custom_objects=objs)
 
 
 def DistributedOptimizer(optimizer, compression=None, op=Average,

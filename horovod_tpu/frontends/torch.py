@@ -40,6 +40,9 @@ from horovod_tpu.ops import collectives as C
 Average = T.ReduceOp.AVERAGE
 Sum = T.ReduceOp.SUM
 Adasum = T.ReduceOp.ADASUM
+Min = T.ReduceOp.MIN
+Max = T.ReduceOp.MAX
+Product = T.ReduceOp.PRODUCT
 
 
 def _torch():
@@ -245,6 +248,29 @@ def grouped_allreduce(tensors, **kw):
     return [_like(o, t, keep_shape=True) for o, t in zip(outs, tensors)]
 
 
+def grouped_allreduce_(tensors, **kw):
+    """In-place grouped variant (reference: grouped_allreduce_)."""
+    for t, r in zip(tensors, grouped_allreduce(tensors, **kw)):
+        t.copy_(r)
+    return tensors
+
+
+def grouped_allgather(tensors, name=None,
+                      process_set: Optional[ProcessSet] = None):
+    outs = _run_serialized(C.grouped_allgather,
+                           [_to_np(t) for t in tensors], name=name,
+                           process_set=process_set)
+    return [_like(o, t) for o, t in zip(outs, tensors)]
+
+
+def grouped_reducescatter(tensors, op=Average,
+                          process_set: Optional[ProcessSet] = None, **kw):
+    outs = _run_serialized(C.grouped_reducescatter,
+                           [_to_np(t) for t in tensors], op=op,
+                           process_set=process_set, **kw)
+    return [_like(o, t) for o, t in zip(outs, tensors)]
+
+
 def broadcast(tensor, root_rank: int, name=None,
               process_set: Optional[ProcessSet] = None):
     out = _run_serialized(C.broadcast, _to_np(tensor),
@@ -369,13 +395,90 @@ def allgather_async(tensor, name=None,
     return _Handle(fut, tensor)
 
 
+def reducescatter_async(tensor, op=Average, name=None,
+                        process_set: Optional[ProcessSet] = None, **kw):
+    arr = _to_np(tensor)
+    fut = _submit_named(name, C.reducescatter, arr, op=op,
+                        process_set=process_set, **kw)
+    return _Handle(fut, tensor)
+
+
+class _AlltoallHandle(_Handle):
+    """alltoall's synchronize returns (tensor, received_splits)
+    (reference: mpi_ops.py alltoall_async)."""
+
+
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set: Optional[ProcessSet] = None):
+    arr = _to_np(tensor)
+    fut = _submit_named(name, C.alltoall, arr, splits=splits, name=name,
+                        process_set=process_set)
+    return _AlltoallHandle(fut, tensor)
+
+
+class _GroupHandle:
+    """An in-flight grouped collective: one future, N tensors
+    (reference: grouped_*_async returns one handle for the group)."""
+
+    def __init__(self, future, refs, targets=None, same_shape=False):
+        self.future = future
+        self.refs = refs
+        self.targets = targets
+        self.same_shape = same_shape
+
+    def done(self) -> bool:
+        return self.future.done()
+
+
+def grouped_allreduce_async(tensors, name=None, **kw):
+    arrs = [_to_np(t) for t in tensors]
+    fut = _submit_named(name, C.grouped_allreduce, arrs, name=name, **kw)
+    return _GroupHandle(fut, list(tensors), same_shape=True)
+
+
+def grouped_allreduce_async_(tensors, **kw):
+    h = grouped_allreduce_async(tensors, **kw)
+    h.targets = list(tensors)
+    return h
+
+
+def grouped_allgather_async(tensors, name=None,
+                            process_set: Optional[ProcessSet] = None):
+    arrs = [_to_np(t) for t in tensors]
+    fut = _submit_named(name, C.grouped_allgather, arrs, name=name,
+                        process_set=process_set)
+    return _GroupHandle(fut, list(tensors))
+
+
+def grouped_reducescatter_async(tensors, op=Average, name=None,
+                                process_set: Optional[ProcessSet] = None,
+                                **kw):
+    arrs = [_to_np(t) for t in tensors]
+    fut = _submit_named(name, C.grouped_reducescatter, arrs, op=op,
+                        name=name, process_set=process_set, **kw)
+    return _GroupHandle(fut, list(tensors))
+
+
 def synchronize(handle):
     """Wait for an async handle and return its result (reference:
     mpi_ops.py:1269). Non-handle values pass through (sync-API results)."""
+    torch = _torch()
+    if isinstance(handle, _GroupHandle):
+        res = handle.future.result()
+        outs = [_like(r, ref, keep_shape=handle.same_shape)
+                for r, ref in zip(res, handle.refs)]
+        if handle.targets is not None:
+            for t, o in zip(handle.targets, outs):
+                t.copy_(o)
+            return handle.targets
+        return outs
+    if isinstance(handle, _AlltoallHandle):
+        out, recv = handle.future.result()
+        return _like(out, handle.ref), torch.from_numpy(
+            np.ascontiguousarray(np.asarray(recv)).astype(np.int64))
     if not isinstance(handle, _Handle):
         return handle
     res = handle.future.result()
-    torch = _torch()
     if isinstance(res, torch.Tensor):
         out = res  # already a torch tensor (sparse path)
     else:
@@ -389,7 +492,9 @@ def synchronize(handle):
 def poll(handle) -> bool:
     """True once the collective has completed (reference: poll, the handle
     is safe to synchronize without blocking)."""
-    return handle.done() if isinstance(handle, _Handle) else True
+    if isinstance(handle, (_Handle, _GroupHandle)):
+        return handle.done()
+    return True
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:

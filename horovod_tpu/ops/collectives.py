@@ -973,6 +973,16 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
     ps = _resolve_ps(process_set)
     g, stacked = _to_global(tensor, ps)
     k = ps.size()
+    if g.ndim < 2:
+        # The stacked-input rule read a 1-D length-k tensor as k per-rank
+        # SCALARS, which alltoall cannot split. The caller almost
+        # certainly meant the classic one-element-per-peer alltoall —
+        # re-lift as a replicated (k,) vector.
+        g, stacked = _to_global(np.asarray(tensor)[None], ps)
+        g = jnp.squeeze(g, axis=1) if g.ndim == 3 else g
+        if g.ndim < 2:
+            raise HorovodTpuError(
+                "alltoall needs at least one dimension to split per rank")
     d0 = int(g.shape[1])
     if splits is None:
         if d0 % k:

@@ -126,8 +126,7 @@ def profile_step(run_once: Callable[[], object], reps: int = 3,
     import jax
 
     for _ in range(warmup):
-        out = run_once()
-    jax.block_until_ready(out)
+        jax.block_until_ready(run_once())
     tmpdir = tempfile.mkdtemp(prefix="hvd_devprof")
     with jax.profiler.trace(tmpdir):
         for _ in range(reps):

@@ -274,10 +274,13 @@ def parse_hostfile(path: str) -> str:
                 host, c1, c2 = mb.groups()
             elif line.count(":") > 1:
                 # bare IPv6 literal: the whole token is the host (a
-                # :N suffix would be ambiguous — require brackets)
-                host, c1, c2 = line.split()[0], None, None
-                if " slots=" in line:
-                    c2 = line.rsplit("slots=", 1)[1]
+                # :N suffix would be ambiguous — require brackets);
+                # only an optional ` slots=N` may follow
+                m6 = re.match(r"^(\S+)( +slots=(\d+))?$", line)
+                if not m6:
+                    raise HorovodTpuError(
+                        f"malformed hostfile line: {raw!r}")
+                host, c1, c2 = m6.group(1), None, m6.group(3)
             else:
                 m = re.match(r"^(\S+?)(?::(\d+)| +slots=(\d+))?$", line)
                 if not m:

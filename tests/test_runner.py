@@ -343,3 +343,12 @@ def test_placer_only_flags_warn_on_mpi(capsys):
     assert rc == 0 and mr.called
     err = capsys.readouterr().err
     assert "--output-filename" in err and "ignored" in err
+
+
+def test_hostfile_rejects_ipv6_trailing_garbage(tmp_path):
+    from horovod_tpu.runner.launch import parse_hostfile
+
+    bad = tmp_path / "hosts"
+    bad.write_text("fe80::2 junk\n")
+    with pytest.raises(HorovodTpuError):
+        parse_hostfile(str(bad))

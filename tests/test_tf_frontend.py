@@ -307,3 +307,92 @@ def test_tf_jit_compile_pinned_error(hvd):
     out = step_ok(tf.constant([1.0, 2.0]))
     np.testing.assert_allclose(out.numpy(),
                                np.array([1.0, 2.0]) * hvd.size())
+
+
+def test_tf_min_max_product_exports(hvd):
+    """Reference exports Min/Max/Product on the TF surface too
+    (tensorflow/mpi_ops.py:85-87)."""
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    t = tf.constant([2.0, 5.0])
+    out = tfvd.allreduce(t, op=tfvd.Product, name="tfpr")
+    np.testing.assert_allclose(out.numpy(),
+                               np.array([2.0, 5.0]) ** hvd.size())
+    out2 = tfvd.allreduce(t, op=tfvd.Max, name="tfmx")
+    np.testing.assert_allclose(out2.numpy(), t.numpy())
+
+
+def test_tf_api_sweep_round4(hvd):
+    """Round-4 TF surface sweep vs reference mpi_ops.py/functions.py:
+    grouped allgather/reducescatter, topology *_op tensors, broadcast_
+    over Variables, broadcast_object_fn."""
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    k = hvd.size()
+    outs = tfvd.grouped_allgather([tf.ones((2, 3)), tf.zeros((1, 5))])
+    assert outs[0].shape == (2 * k, 3) and outs[1].shape == (k, 5)
+
+    outs = tfvd.grouped_reducescatter([tf.ones((k * 2, 3))],
+                                      op=tfvd.Sum)
+    np.testing.assert_allclose(outs[0].numpy(),
+                               np.full((2, 3), float(k)))
+
+    assert int(tfvd.size_op()) == k
+    assert int(tfvd.rank_op()) == hvd.rank()
+    assert int(tfvd.local_rank_op()) == hvd.local_rank()
+    assert int(tfvd.local_size_op()) == hvd.local_size()
+    assert int(tfvd.process_set_included_op()) == 1
+
+    v = tf.Variable([1.0, 2.0])
+    got = tfvd.broadcast_([v], root_rank=0)
+    assert got[0] is v
+    np.testing.assert_allclose(v.numpy(), [1.0, 2.0])
+
+    fn = tfvd.broadcast_object_fn(root_rank=0)
+    assert fn({"a": 1}) == {"a": 1}
+
+
+def test_tf_keras_load_model_rewraps_optimizer(hvd, tmp_path):
+    """hvd.load_model reloads a model saved with a DistributedOptimizer
+    and keeps it distributed for retraining (reference:
+    tensorflow/keras/__init__.py:234)."""
+    import keras
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    m = keras.Sequential([keras.layers.Input((4,)), keras.layers.Dense(2)])
+    m.compile(optimizer=tfvd.DistributedOptimizer(
+        keras.optimizers.SGD(0.1)), loss="mse")
+    m.fit(np.ones((8, 4)), np.ones((8, 2)), epochs=1, verbose=0)
+    path = str(tmp_path / "m.keras")
+    m.save(path)
+
+    m2 = tfvd.load_model(path)
+    assert type(m2.optimizer).__name__ == "DistributedSGD"
+    assert float(m2.optimizer.learning_rate) == pytest.approx(0.1)
+    m2.fit(np.ones((8, 4)), np.ones((8, 2)), epochs=1, verbose=0)
+
+
+def test_tf_grouped_ops_inside_tf_function(hvd):
+    """grouped_allgather/grouped_reducescatter must ride the py_function
+    bridge like every other collective (parity row 24: 'eager AND inside
+    tf.function')."""
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    k = hvd.size()
+
+    @tf.function
+    def f(x, y):
+        ag = tfvd.grouped_allgather([x])
+        rs = tfvd.grouped_reducescatter([y], op=tfvd.Sum)
+        return ag[0], rs[0]
+
+    ag, rs = f(tf.ones((2, 3)), tf.ones((k * 2, 3)))
+    assert ag.shape == (2 * k, 3)
+    np.testing.assert_allclose(rs.numpy(), np.full((2, 3), float(k)))

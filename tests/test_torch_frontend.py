@@ -368,3 +368,78 @@ def test_torch_dlpack_zero_copy_ingest(hvd):
     a = _to_np(t)
     t[0] = 42.0  # shared memory: the view sees the write
     assert float(np.asarray(a)[0]) == 42.0
+
+
+def test_torch_min_max_product_ops(hvd):
+    """Reference exports hvd.Min/Max/Product (torch/mpi_ops.py:80-82) and
+    reduces with them; single-controller semantics: every emulated rank
+    contributes the same tensor, so min=max=input and product=x^size."""
+    import horovod_tpu.frontends.torch as thvd
+
+    t = torch.tensor([1.0, 2.0, 3.0])
+    out_min = thvd.allreduce(t, op=thvd.Min, name="mn")
+    out_max = thvd.allreduce(t, op=thvd.Max, name="mx")
+    out_prod = thvd.allreduce(t, op=thvd.Product, name="pr")
+    torch.testing.assert_close(out_min, t)
+    torch.testing.assert_close(out_max, t)
+    torch.testing.assert_close(out_prod, t ** thvd.size())
+
+
+def test_torch_grouped_and_async_variants(hvd):
+    """Round-4 API sweep vs reference torch surface: grouped allgather/
+    reducescatter (+async), grouped in-place, alltoall_async,
+    reducescatter_async (reference: torch/mpi_ops.py grouped_* and
+    *_async families)."""
+    import horovod_tpu.frontends.torch as thvd
+
+    k = thvd.size()
+    ts = [torch.arange(4, dtype=torch.float32),
+          torch.ones(2, 3)]
+
+    # grouped in-place: tensors mutate to the reduced values
+    clones = [t.clone() for t in ts]
+    got = thvd.grouped_allreduce_(clones, op=thvd.Sum)
+    assert got is clones
+    torch.testing.assert_close(clones[0], ts[0] * k)
+
+    # grouped allgather: first axis grows by k
+    outs = thvd.grouped_allgather([torch.ones(2, 3), torch.zeros(1, 5)])
+    assert outs[0].shape == (2 * k, 3) and outs[1].shape == (k, 5)
+
+    # grouped reducescatter: rows divided across ranks (shapes chosen to
+    # avoid the leading-dim==world-size stacked-input interpretation)
+    rs_in = [torch.ones(k * 2, 3), torch.ones(k * 3, 4)]
+    outs = thvd.grouped_reducescatter(rs_in, op=thvd.Sum)
+    assert outs[0].shape == (2, 3) and outs[1].shape == (3, 4)
+    torch.testing.assert_close(outs[0], torch.full((2, 3), float(k)))
+
+    # async grouped + poll/synchronize
+    h = thvd.grouped_allreduce_async(ts, op=thvd.Sum, name="ga0")
+    outs = thvd.synchronize(h)
+    assert thvd.poll(h)
+    torch.testing.assert_close(outs[0], ts[0] * k)
+
+    h2 = thvd.grouped_allgather_async([torch.ones(1, 2)])
+    assert thvd.synchronize(h2)[0].shape == (k, 2)
+
+    h3 = thvd.grouped_reducescatter_async([torch.ones(k * 2, 2)],
+                                          op=thvd.Sum)
+    torch.testing.assert_close(thvd.synchronize(h3)[0],
+                               torch.full((2, 2), float(k)))
+
+    # async in-place grouped
+    ips = [torch.ones(3)]
+    h4 = thvd.grouped_allreduce_async_(ips, op=thvd.Sum)
+    got4 = thvd.synchronize(h4)
+    assert all(a is b for a, b in zip(got4, ips))  # same tensor objects
+    torch.testing.assert_close(ips[0], torch.full((3,), float(k)))
+
+    # reducescatter_async
+    h5 = thvd.reducescatter_async(torch.ones(k * 2, 2), op=thvd.Sum)
+    torch.testing.assert_close(thvd.synchronize(h5),
+                               torch.full((2, 2), float(k)))
+
+    # alltoall_async returns (tensor, received_splits)
+    h6 = thvd.alltoall_async(torch.arange(k, dtype=torch.float32))
+    out, recv = thvd.synchronize(h6)
+    assert recv.dtype == torch.int64 and recv.shape == (k,)
