@@ -373,6 +373,15 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
         v.assign(broadcast(v, root_rank))
 
 
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """Reference: keras/__init__.py:195 — TF1-style global-variables
+    broadcast; in TF2/Keras-3 the graph-collection of globals is empty,
+    so this syncs whatever tf.compat.v1 still tracks (use
+    broadcast_variables(model.variables) in new code)."""
+    tf = _tf()
+    broadcast_variables(tf.compat.v1.global_variables(), root_rank)
+
+
 def broadcast_object(obj, root_rank: int = 0, name=None,
                      process_set: Optional[ProcessSet] = None):
     from horovod_tpu.optim.functions import broadcast_object as _bo
@@ -409,15 +418,34 @@ def _make_allreduce_grads_fn(op, gradient_predivide_factor: float,
 
 class DistributedGradientTape:
     """Reference: tensorflow/__init__.py:1125 — wraps tf.GradientTape so
-    gradient() returns cross-rank (grouped, fused) reduced gradients."""
+    gradient() returns cross-rank (grouped, fused) reduced gradients.
+
+    Variables registered via `register_local_source` keep their LOCAL
+    gradients (never allreduced); with `scale_local_gradients` they are
+    divided by the set size so their effective step matches the averaged
+    global ones (reference: register_local_source + pull/3695)."""
 
     def __init__(self, gradtape, compression=None, op=Average,
                  gradient_predivide_factor: float = 1.0,
-                 process_set: Optional[ProcessSet] = None):
+                 process_set: Optional[ProcessSet] = None,
+                 scale_local_gradients: bool = True):
         self.tape = gradtape
+        self.scale_local_gradients = scale_local_gradients
+        self._process_set = process_set
+        self._local_sources = set()
         self._allreduce_grads = _make_allreduce_grads_fn(
             op, gradient_predivide_factor,
             compression or Compression.none, process_set)
+
+    def register_local_source(self, var) -> None:
+        """Mark `var`'s gradient as rank-local (reference:
+        tensorflow/__init__.py register_local_source)."""
+        self._local_sources.add(var.ref() if hasattr(var, "ref")
+                                else id(var))
+
+    def _is_local(self, var) -> bool:
+        key = var.ref() if hasattr(var, "ref") else id(var)
+        return key in self._local_sources
 
     def __enter__(self):
         return self.tape.__enter__()
@@ -431,7 +459,23 @@ class DistributedGradientTape:
     def gradient(self, target, sources, output_gradients=None):
         grads = self.tape.gradient(target, sources, output_gradients)
         single = not isinstance(grads, (list, tuple))
-        out = self._allreduce_grads([grads] if single else list(grads))
+        glist = [grads] if single else list(grads)
+        slist = [sources] if single else list(sources)
+        if not self._local_sources:
+            out = self._allreduce_grads(glist)
+            return out[0] if single else out
+        k = (self._process_set.size() if self._process_set is not None
+             else size())
+        reduce_idx = [i for i, s in enumerate(slist)
+                      if not self._is_local(s)]
+        reduced = self._allreduce_grads([glist[i] for i in reduce_idx])
+        out = list(glist)
+        for i, g in zip(reduce_idx, reduced):
+            out[i] = g
+        if self.scale_local_gradients:
+            for i, s in enumerate(slist):
+                if self._is_local(s) and out[i] is not None:
+                    out[i] = _scale_grad(out[i], 1.0 / float(k))
         return out[0] if single else out
 
 
@@ -523,6 +567,114 @@ def DistributedOptimizer(optimizer, compression=None, op=Average,
     return _EagerDistributedOptimizer(
         optimizer, compression, op, gradient_predivide_factor,
         backward_passes_per_step, process_set)
+
+
+def _local_layer_vars(local_layers):
+    if local_layers is None:
+        return []
+    if not isinstance(local_layers, (list, tuple, set)):
+        local_layers = [local_layers]
+    return [v for layer in local_layers for v in layer.trainable_weights]
+
+
+def _scale_grad(g, factor: float):
+    """Scale a (possibly IndexedSlices) gradient without densifying —
+    `slices / k` round-trips through convert_to_tensor and materializes
+    the full dense shape (the reference scales .values, pull/3695)."""
+    tf = _tf()
+    if isinstance(g, tf.IndexedSlices):
+        return tf.IndexedSlices(g.values * factor, g.indices,
+                                g.dense_shape)
+    return g * factor
+
+
+def PartialDistributedOptimizer(optimizer, compression=None, op=Average,
+                                gradient_predivide_factor: float = 1.0,
+                                backward_passes_per_step: int = 1,
+                                process_set: Optional[ProcessSet] = None,
+                                local_layers=None,
+                                scale_local_gradients: bool = True,
+                                **_legacy):
+    """DistributedOptimizer that keeps the gradients of `local_layers`
+    rank-local — never allreduced, optionally divided by the set size
+    (reference: keras/__init__.py:116 PartialDistributedOptimizer +
+    pull/3695 scaling semantics). Extra legacy kwargs (device_dense,
+    sparse_as_dense, ...) are accepted and ignored like the other
+    wrappers."""
+    local_vars = _local_layer_vars(local_layers)
+    if not local_vars:
+        return DistributedOptimizer(
+            optimizer, compression=compression, op=op,
+            gradient_predivide_factor=gradient_predivide_factor,
+            backward_passes_per_step=backward_passes_per_step,
+            process_set=process_set)
+    import keras
+
+    if not isinstance(optimizer, keras.optimizers.Optimizer):
+        raise ValueError(
+            "PartialDistributedOptimizer requires a keras optimizer")
+    allreduce_grads = _make_allreduce_grads_fn(
+        op, gradient_predivide_factor, compression or Compression.none,
+        process_set)
+    local_ids = {id(v) for v in local_vars}
+    k_fn = (process_set.size if process_set is not None else size)
+    base_cls = optimizer.__class__
+
+    class _PartialDistKeras(base_cls):
+        def apply(self, grads, trainable_variables=None):
+            tvars = trainable_variables
+            if tvars is None:
+                # Keras 3's own apply() fallback list — self.variables
+                # is the (longer, misordered) OPTIMIZER-state list
+                tvars = getattr(self, "_trainable_variables", None)
+                if not tvars:
+                    raise ValueError(
+                        "apply(grads) without trainable_variables "
+                        "requires a built optimizer")
+            grads = list(grads)
+            reduce_idx = [i for i, v in enumerate(tvars)
+                          if id(v) not in local_ids]
+            reduced = allreduce_grads([grads[i] for i in reduce_idx])
+            out = list(grads)
+            for i, g in zip(reduce_idx, reduced):
+                out[i] = g
+            if scale_local_gradients:
+                k = float(k_fn())
+                for i, v in enumerate(tvars):
+                    if id(v) in local_ids and out[i] is not None:
+                        out[i] = _scale_grad(out[i], 1.0 / k)
+            return super().apply(out, trainable_variables)
+
+    _PartialDistKeras.__name__ = "PartialDistributed" + base_cls.__name__
+    _PartialDistKeras.__qualname__ = _PartialDistKeras.__name__
+    cfg = optimizer.get_config()
+    if backward_passes_per_step > 1:
+        if cfg.get("gradient_accumulation_steps"):
+            raise ValueError(
+                "pass either backward_passes_per_step or a "
+                "gradient_accumulation_steps-configured optimizer, "
+                "not both")
+        cfg["gradient_accumulation_steps"] = backward_passes_per_step
+    return _PartialDistKeras.from_config(cfg)
+
+
+def PartialDistributedGradientTape(gradtape, compression=None, op=Average,
+                                   gradient_predivide_factor: float = 1.0,
+                                   process_set: Optional[ProcessSet] = None,
+                                   local_layers=None,
+                                   scale_local_gradients: bool = True,
+                                   **_legacy):
+    """Reference: tensorflow/__init__.py:1205 — a DistributedGradientTape
+    with every `local_layers` trainable weight registered as a local
+    source."""
+    tape = DistributedGradientTape(
+        gradtape, compression=compression, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set,
+        scale_local_gradients=scale_local_gradients)
+    for v in _local_layer_vars(local_layers):
+        tape.register_local_source(v)
+    return tape
 
 
 class _EagerDistributedOptimizer:
