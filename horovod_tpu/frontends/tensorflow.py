@@ -433,6 +433,7 @@ class DistributedGradientTape:
         self.scale_local_gradients = scale_local_gradients
         self._process_set = process_set
         self._local_sources = set()
+        self._local_layers: List[Any] = []
         self._allreduce_grads = _make_allreduce_grads_fn(
             op, gradient_predivide_factor,
             compression or Compression.none, process_set)
@@ -443,9 +444,17 @@ class DistributedGradientTape:
         self._local_sources.add(var.ref() if hasattr(var, "ref")
                                 else id(var))
 
+    def register_local_layer(self, layer) -> None:
+        """Mark a whole layer's trainable weights rank-local, resolved
+        LAZILY at gradient() time (the layer may build later)."""
+        self._local_layers.append(layer)
+
     def _is_local(self, var) -> bool:
         key = var.ref() if hasattr(var, "ref") else id(var)
-        return key in self._local_sources
+        if key in self._local_sources:
+            return True
+        return any(var is v for layer in self._local_layers
+                   for v in layer.trainable_weights)
 
     def __enter__(self):
         return self.tape.__enter__()
@@ -461,21 +470,14 @@ class DistributedGradientTape:
         single = not isinstance(grads, (list, tuple))
         glist = [grads] if single else list(grads)
         slist = [sources] if single else list(sources)
-        if not self._local_sources:
+        if not self._local_sources and not self._local_layers:
             out = self._allreduce_grads(glist)
             return out[0] if single else out
         k = (self._process_set.size() if self._process_set is not None
              else size())
-        reduce_idx = [i for i, s in enumerate(slist)
-                      if not self._is_local(s)]
-        reduced = self._allreduce_grads([glist[i] for i in reduce_idx])
-        out = list(glist)
-        for i, g in zip(reduce_idx, reduced):
-            out[i] = g
-        if self.scale_local_gradients:
-            for i, s in enumerate(slist):
-                if self._is_local(s) and out[i] is not None:
-                    out[i] = _scale_grad(out[i], 1.0 / float(k))
+        out = _partial_reduce(glist, slist, self._is_local,
+                              self._allreduce_grads,
+                              self.scale_local_gradients, float(k))
         return out[0] if single else out
 
 
@@ -545,6 +547,13 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
              and issubclass(c, keras.optimizers.Optimizer)]
     for c in bases + list(custom_optimizers or []):
         objs.setdefault("Distributed" + c.__name__, wrap_factory(c))
+        # A PartialDistributed* save also reloads — as a PLAIN
+        # distributed optimizer, because local_layers are live layer
+        # objects that cannot serialize; re-wrap with
+        # PartialDistributedOptimizer(..., local_layers=...) after load
+        # to restore rank-local gradients.
+        objs.setdefault("PartialDistributed" + c.__name__,
+                        wrap_factory(c))
     return keras.models.load_model(filepath, custom_objects=objs)
 
 
@@ -569,12 +578,33 @@ def DistributedOptimizer(optimizer, compression=None, op=Average,
         backward_passes_per_step, process_set)
 
 
-def _local_layer_vars(local_layers):
+def _local_layer_list(local_layers):
     if local_layers is None:
         return []
     if not isinstance(local_layers, (list, tuple, set)):
         local_layers = [local_layers]
-    return [v for layer in local_layers for v in layer.trainable_weights]
+    return list(local_layers)
+
+
+def _local_layer_vars(local_layers):
+    return [v for layer in _local_layer_list(local_layers)
+            for v in layer.trainable_weights]
+
+
+def _partial_reduce(grads, sources, is_local, allreduce_grads,
+                    scale_local: bool, k: float):
+    """Shared partition/splice/scale for the Partial wrappers: allreduce
+    only non-local gradients, splice back, scale local ones by 1/k."""
+    reduce_idx = [i for i, s in enumerate(sources) if not is_local(s)]
+    reduced = allreduce_grads([grads[i] for i in reduce_idx])
+    out = list(grads)
+    for i, g in zip(reduce_idx, reduced):
+        out[i] = g
+    if scale_local:
+        for i, s in enumerate(sources):
+            if is_local(s) and out[i] is not None:
+                out[i] = _scale_grad(out[i], 1.0 / k)
+    return out
 
 
 def _scale_grad(g, factor: float):
@@ -601,8 +631,8 @@ def PartialDistributedOptimizer(optimizer, compression=None, op=Average,
     pull/3695 scaling semantics). Extra legacy kwargs (device_dense,
     sparse_as_dense, ...) are accepted and ignored like the other
     wrappers."""
-    local_vars = _local_layer_vars(local_layers)
-    if not local_vars:
+    layers = _local_layer_list(local_layers)
+    if not layers:
         return DistributedOptimizer(
             optimizer, compression=compression, op=op,
             gradient_predivide_factor=gradient_predivide_factor,
@@ -616,7 +646,6 @@ def PartialDistributedOptimizer(optimizer, compression=None, op=Average,
     allreduce_grads = _make_allreduce_grads_fn(
         op, gradient_predivide_factor, compression or Compression.none,
         process_set)
-    local_ids = {id(v) for v in local_vars}
     k_fn = (process_set.size if process_set is not None else size)
     base_cls = optimizer.__class__
 
@@ -631,18 +660,17 @@ def PartialDistributedOptimizer(optimizer, compression=None, op=Average,
                     raise ValueError(
                         "apply(grads) without trainable_variables "
                         "requires a built optimizer")
-            grads = list(grads)
-            reduce_idx = [i for i, v in enumerate(tvars)
-                          if id(v) not in local_ids]
-            reduced = allreduce_grads([grads[i] for i in reduce_idx])
-            out = list(grads)
-            for i, g in zip(reduce_idx, reduced):
-                out[i] = g
-            if scale_local_gradients:
-                k = float(k_fn())
-                for i, v in enumerate(tvars):
-                    if id(v) in local_ids and out[i] is not None:
-                        out[i] = _scale_grad(out[i], 1.0 / k)
+            # resolve local vars LAZILY: layers may build after the
+            # optimizer is constructed, and holding the layer list (not
+            # bare ids) keeps the variables alive so identity is stable
+            local_vars = _local_layer_vars(layers)
+
+            def is_local(v):
+                return any(v is lv for lv in local_vars)
+
+            out = _partial_reduce(list(grads), list(tvars), is_local,
+                                  allreduce_grads,
+                                  scale_local_gradients, float(k_fn()))
             return super().apply(out, trainable_variables)
 
     _PartialDistKeras.__name__ = "PartialDistributed" + base_cls.__name__
@@ -672,8 +700,10 @@ def PartialDistributedGradientTape(gradtape, compression=None, op=Average,
         gradient_predivide_factor=gradient_predivide_factor,
         process_set=process_set,
         scale_local_gradients=scale_local_gradients)
-    for v in _local_layer_vars(local_layers):
-        tape.register_local_source(v)
+    for layer in _local_layer_list(local_layers):
+        # lazily resolved: unbuilt layers contribute their weights once
+        # built instead of silently registering nothing
+        tape.register_local_layer(layer)
     return tape
 
 

@@ -30,19 +30,134 @@ def _torch():
     return torch
 
 
+class StateHandler:
+    """Save/restore/sync strategy for one stateful object (reference:
+    torch/elastic/state.py:71). Register new types with
+    set_handler_registry."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class ModelStateHandler(StateHandler):
+    # no snapshot in __init__: State.__init__ commits immediately, and a
+    # second deep copy of a big module would be pure waste
+    _saved = None
+
+    def save(self):
+        torch = _torch()
+        self._saved = {
+            k: v.detach().cpu().clone() if isinstance(v, torch.Tensor)
+            else copy.deepcopy(v)
+            for k, v in self.value.state_dict().items()}
+
+    def restore(self):
+        if self._saved is not None:
+            self.value.load_state_dict(copy.deepcopy(self._saved))
+
+    def sync(self):
+        from horovod_tpu.frontends.torch import broadcast_parameters
+        broadcast_parameters(self.value.state_dict(), root_rank=0)
+
+
+class OptimizerStateHandler(StateHandler):
+    _saved = None
+
+    def save(self):
+        self._saved = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        if self._saved is not None:
+            self.value.load_state_dict(copy.deepcopy(self._saved))
+
+    def sync(self):
+        from horovod_tpu.frontends.torch import broadcast_optimizer_state
+        broadcast_optimizer_state(self.value, root_rank=0)
+
+
+class SamplerStateHandler(StateHandler):
+    _saved = None
+
+    def save(self):
+        self._saved = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        if self._saved is not None:
+            self.value.load_state_dict(copy.deepcopy(self._saved))
+
+    def sync(self):
+        # the sampler's own sync merges processed indices across the
+        # (possibly changed) world and re-shards the remainder
+        self.value.sync()
+
+
+def _default_registry():
+    torch = _torch()
+    return [
+        (torch.nn.Module, ModelStateHandler),
+        (torch.optim.Optimizer, OptimizerStateHandler),
+        (ElasticSampler, SamplerStateHandler),
+    ]
+
+
+_handler_registry: Optional[List] = None
+
+
+def get_handler_registry():
+    global _handler_registry
+    if _handler_registry is None:
+        _handler_registry = _default_registry()
+    return _handler_registry
+
+
+def set_handler_registry(registry) -> None:
+    global _handler_registry
+    _handler_registry = list(registry)
+
+
+def _get_handler(value) -> Optional[StateHandler]:
+    for typ, cls in get_handler_registry():
+        if isinstance(value, typ):
+            return cls(value)
+    return None
+
+
 class TorchState(ObjectState):
     """In-memory checkpoint of a torch model + optimizer (reference:
     torch/elastic/state.py:27-110). commit() snapshots state dicts;
     restore() rolls back; sync() broadcasts rank 0's weights and optimizer
-    state so rejoining workers pick up the survivors' progress."""
+    state so rejoining workers pick up the survivors' progress.
+
+    Any extra kwarg whose value matches the handler registry (samplers,
+    additional modules/optimizers, user-registered types) is managed by
+    its handler; plain values fall through to ObjectState."""
 
     def __init__(self, model=None, optimizer=None, **kwargs):
         self.model = model
         self.optimizer = optimizer
         self._saved_model: Optional[Dict[str, Any]] = None
         self._saved_opt: Optional[Dict[str, Any]] = None
-        super().__init__(**kwargs)
+        self._handlers: Dict[str, StateHandler] = {}
+        plain = {}
+        for k, v in kwargs.items():
+            h = _get_handler(v)
+            if h is not None:
+                self._handlers[k] = h
+                setattr(self, k, v)
+            else:
+                plain[k] = v
+        super().__init__(**plain)
         self._known_attrs -= {"model", "optimizer"}
+        self._known_attrs -= set(self._handlers)
 
     def save(self) -> None:
         torch = _torch()
@@ -53,6 +168,8 @@ class TorchState(ObjectState):
                 for k, v in self.model.state_dict().items()}
         if self.optimizer is not None:
             self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
+        for h in self._handlers.values():
+            h.save()
         super().save()
 
     def restore(self) -> None:
@@ -60,6 +177,8 @@ class TorchState(ObjectState):
             self.model.load_state_dict(copy.deepcopy(self._saved_model))
         if self.optimizer is not None and self._saved_opt is not None:
             self.optimizer.load_state_dict(copy.deepcopy(self._saved_opt))
+        for h in self._handlers.values():
+            h.restore()
         super().restore()
 
     def sync(self) -> None:
@@ -69,6 +188,8 @@ class TorchState(ObjectState):
             broadcast_parameters(self.model.state_dict(), root_rank=0)
         if self.optimizer is not None:
             broadcast_optimizer_state(self.optimizer, root_rank=0)
+        for h in self._handlers.values():
+            h.sync()
         super().sync()
 
 

@@ -473,3 +473,35 @@ def test_partial_local_scaling_keeps_indexed_slices(hvd):
     assert isinstance(g, tf.IndexedSlices), "local grad was densified"
     np.testing.assert_allclose(g.values.numpy(),
                                np.ones((2, 4)) / k)
+
+
+def test_partial_optimizer_unbuilt_layer_resolves_lazily(hvd):
+    """local_layers passed BEFORE the layer builds must still be treated
+    as local at apply time (review finding: silent degrade to full
+    allreduce)."""
+    import keras
+    import tensorflow as tf
+
+    import horovod_tpu.frontends.tensorflow as tfvd
+
+    k = hvd.size()
+    layer = keras.layers.Dense(1, use_bias=False,
+                               kernel_initializer="ones")
+    # NOT built yet when the optimizer wraps it
+    opt = tfvd.PartialDistributedOptimizer(
+        keras.optimizers.SGD(1.0), local_layers=[layer])
+    assert type(opt).__name__ == "PartialDistributedSGD"
+    layer.build((None, 1))  # builds after wrapping
+    w = layer.trainable_weights[0]
+    opt.apply([tf.ones_like(w)], [w])
+    # local semantics: grad scaled by 1/k -> w = 1 - 1/k
+    np.testing.assert_allclose(w.numpy(), [[1.0 - 1.0 / k]], rtol=1e-6)
+
+    # same laziness through the tape wrapper
+    layer2 = keras.layers.Dense(1, use_bias=False,
+                                kernel_initializer="ones")
+    with tf.GradientTape() as t:
+        pass
+    dtape = tfvd.PartialDistributedGradientTape(t, local_layers=[layer2])
+    layer2.build((None, 1))
+    assert dtape._is_local(layer2.trainable_weights[0])
