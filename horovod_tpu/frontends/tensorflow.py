@@ -249,8 +249,10 @@ def size_op(process_set_id: int = 0, name=None):
 def process_set_included_op(process_set_id: int = 0, name=None):
     tf = _tf()
     from horovod_tpu.core.process_sets import _table
-    inc = rank() in (_table().get(process_set_id).ranks or []) \
-        if process_set_id else True
+    # ProcessSet.included() handles both ranks=None (global membership →
+    # always in) and multi-slot processes (intersects ALL local slot ranks,
+    # not just the first).
+    inc = _table().get(process_set_id).included() if process_set_id else True
     return tf.constant(int(inc), dtype=tf.int32, name=name)
 
 

@@ -105,6 +105,16 @@ def _to_np(t):
     return np.asarray(t)
 
 
+def _np_snapshot(t):
+    """Owned copy for ASYNC submission. _to_np is zero-copy — it aliases
+    the live torch buffer — so an async collective could read torn data if
+    the caller mutates the tensor (e.g. an optimizer step) before the
+    background executor drains. Sync paths keep the zero-copy fast path;
+    async paths must snapshot here, on the caller thread."""
+    arr = _to_np(t)
+    return np.array(arr)  # always an owned, contiguous copy
+
+
 def _like(arr, ref, keep_shape: bool = False):
     torch = _torch()
 
@@ -116,14 +126,21 @@ def _like(arr, ref, keep_shape: bool = False):
 
         try:
             cpu = jax.device_put(arr, jax.local_devices(backend="cpu")[0])
-            out = torch.utils.dlpack.from_dlpack(cpu)
+            # .clone(): the DLPack view aliases an immutable jax buffer —
+            # user in-place ops on a collective OUTPUT must be defined
+            out = torch.utils.dlpack.from_dlpack(cpu).clone()
         except Exception:
             # from_numpy would raise on the ml_dtypes bf16 view too —
             # upcast for the host hop; .to(ref.dtype) restores bf16 below
             out = torch.from_numpy(
                 np.ascontiguousarray(np.asarray(arr).astype(np.float32)))
     if out is None:
-        out = torch.from_numpy(np.ascontiguousarray(np.asarray(arr)))
+        a = np.ascontiguousarray(np.asarray(arr))
+        if not a.flags.writeable:
+            # from_numpy over a read-only array makes in-place ops on the
+            # returned tensor UB (torch warns) — materialize a writable copy
+            a = a.copy()
+        out = torch.from_numpy(a)
     if isinstance(ref, torch.Tensor):
         out = out.to(dtype=ref.dtype, device=ref.device)
         if keep_shape and out.shape != ref.shape:
@@ -359,7 +376,7 @@ def allreduce_async(tensor, average: Optional[bool] = None, name=None,
                     op=None, prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
                     process_set: Optional[ProcessSet] = None):
-    arr = _to_np(tensor)  # snapshot on the caller thread
+    arr = _np_snapshot(tensor)  # owned copy on the caller thread
     fut = _submit_named(name, C.allreduce, arr, average=average, name=name,
                         op=op, prescale_factor=prescale_factor,
                         postscale_factor=postscale_factor,
@@ -375,7 +392,7 @@ def allreduce_async_(tensor, **kw):
 
 def broadcast_async(tensor, root_rank: int, name=None,
                     process_set: Optional[ProcessSet] = None):
-    arr = _to_np(tensor)
+    arr = _np_snapshot(tensor)
     fut = _submit_named(name, C.broadcast, arr, root_rank=root_rank,
                         name=name, process_set=process_set)
     return _Handle(fut, tensor, same_shape=True)
@@ -389,7 +406,7 @@ def broadcast_async_(tensor, root_rank: int, **kw):
 
 def allgather_async(tensor, name=None,
                     process_set: Optional[ProcessSet] = None):
-    arr = _to_np(tensor)
+    arr = _np_snapshot(tensor)
     fut = _submit_named(name, C.allgather, arr, name=name,
                         process_set=process_set)
     return _Handle(fut, tensor)
@@ -397,7 +414,7 @@ def allgather_async(tensor, name=None,
 
 def reducescatter_async(tensor, op=Average, name=None,
                         process_set: Optional[ProcessSet] = None, **kw):
-    arr = _to_np(tensor)
+    arr = _np_snapshot(tensor)
     fut = _submit_named(name, C.reducescatter, arr, op=op,
                         process_set=process_set, **kw)
     return _Handle(fut, tensor)
@@ -410,7 +427,7 @@ class _AlltoallHandle(_Handle):
 
 def alltoall_async(tensor, splits=None, name=None,
                    process_set: Optional[ProcessSet] = None):
-    arr = _to_np(tensor)
+    arr = _np_snapshot(tensor)
     fut = _submit_named(name, C.alltoall, arr, splits=splits, name=name,
                         process_set=process_set)
     return _AlltoallHandle(fut, tensor)
@@ -431,7 +448,7 @@ class _GroupHandle:
 
 
 def grouped_allreduce_async(tensors, name=None, **kw):
-    arrs = [_to_np(t) for t in tensors]
+    arrs = [_np_snapshot(t) for t in tensors]
     fut = _submit_named(name, C.grouped_allreduce, arrs, name=name, **kw)
     return _GroupHandle(fut, list(tensors), same_shape=True)
 
@@ -444,7 +461,7 @@ def grouped_allreduce_async_(tensors, **kw):
 
 def grouped_allgather_async(tensors, name=None,
                             process_set: Optional[ProcessSet] = None):
-    arrs = [_to_np(t) for t in tensors]
+    arrs = [_np_snapshot(t) for t in tensors]
     fut = _submit_named(name, C.grouped_allgather, arrs, name=name,
                         process_set=process_set)
     return _GroupHandle(fut, list(tensors))
@@ -453,7 +470,7 @@ def grouped_allgather_async(tensors, name=None,
 def grouped_reducescatter_async(tensors, op=Average, name=None,
                                 process_set: Optional[ProcessSet] = None,
                                 **kw):
-    arrs = [_to_np(t) for t in tensors]
+    arrs = [_np_snapshot(t) for t in tensors]
     fut = _submit_named(name, C.grouped_reducescatter, arrs, op=op,
                         name=name, process_set=process_set, **kw)
     return _GroupHandle(fut, list(tensors))

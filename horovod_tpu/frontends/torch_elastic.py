@@ -38,6 +38,14 @@ class StateHandler:
     def __init__(self, value):
         self.value = value
 
+    def set_value(self, value):
+        """Rebind the handler to a new object and snapshot it (reference:
+        torch/elastic/state.py:66-69). Called by TorchState.__setattr__ so
+        `state.sampler = new_sampler` keeps commit/restore/sync pointed at
+        the live object instead of the original one."""
+        self.value = value
+        self.save()
+
     def save(self):
         raise NotImplementedError
 
@@ -142,52 +150,65 @@ class TorchState(ObjectState):
     its handler; plain values fall through to ObjectState."""
 
     def __init__(self, model=None, optimizer=None, **kwargs):
+        # model/optimizer go through the SAME handler mechanism as extra
+        # kwargs (reference: torch/elastic/state.py:27-44) so __setattr__
+        # rebinds them too when the user swaps the object mid-training.
+        self._handlers: Dict[str, StateHandler] = {}
         self.model = model
         self.optimizer = optimizer
-        self._saved_model: Optional[Dict[str, Any]] = None
-        self._saved_opt: Optional[Dict[str, Any]] = None
-        self._handlers: Dict[str, StateHandler] = {}
+        if model is not None:
+            self._handlers["model"] = ModelStateHandler(model)
+        if optimizer is not None:
+            self._handlers["optimizer"] = OptimizerStateHandler(optimizer)
         plain = {}
         for k, v in kwargs.items():
             h = _get_handler(v)
             if h is not None:
-                self._handlers[k] = h
+                # set the attribute BEFORE registering the handler so the
+                # initial assignment doesn't trigger a redundant save()
                 setattr(self, k, v)
+                self._handlers[k] = h
             else:
                 plain[k] = v
         super().__init__(**plain)
         self._known_attrs -= {"model", "optimizer"}
         self._known_attrs -= set(self._handlers)
 
+    def __setattr__(self, name, value):
+        # Route reassignment of handler-managed attributes through the
+        # handler (rebind + save) so commit/restore/sync track the NEW
+        # object — reference torch/elastic/state.py:66-69. `.get` via
+        # __dict__ keeps __init__'s pre-_handlers assignments plain.
+        handlers = self.__dict__.get("_handlers")
+        if handlers is not None:
+            if name in handlers:
+                if value is None:
+                    del handlers[name]  # mirrors init: None -> unmanaged
+                else:
+                    handlers[name].set_value(value)
+            elif name in ("model", "optimizer") and value is not None:
+                # model/optimizer assigned after construction (TorchState()
+                # then state.model = net, or reassignment after = None)
+                # must become managed — the pre-handler code read them live
+                # in save/restore/sync and this must not regress.
+                cls = (ModelStateHandler if name == "model"
+                       else OptimizerStateHandler)
+                h = cls(value)
+                h.save()
+                handlers[name] = h
+        object.__setattr__(self, name, value)
+
     def save(self) -> None:
-        torch = _torch()
-        if self.model is not None:
-            self._saved_model = {
-                k: v.detach().cpu().clone() if isinstance(v, torch.Tensor)
-                else copy.deepcopy(v)
-                for k, v in self.model.state_dict().items()}
-        if self.optimizer is not None:
-            self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
         for h in self._handlers.values():
             h.save()
         super().save()
 
     def restore(self) -> None:
-        if self.model is not None and self._saved_model is not None:
-            self.model.load_state_dict(copy.deepcopy(self._saved_model))
-        if self.optimizer is not None and self._saved_opt is not None:
-            self.optimizer.load_state_dict(copy.deepcopy(self._saved_opt))
         for h in self._handlers.values():
             h.restore()
         super().restore()
 
     def sync(self) -> None:
-        from horovod_tpu.frontends.torch import (broadcast_optimizer_state,
-                                                 broadcast_parameters)
-        if self.model is not None:
-            broadcast_parameters(self.model.state_dict(), root_rank=0)
-        if self.optimizer is not None:
-            broadcast_optimizer_state(self.optimizer, root_rank=0)
         for h in self._handlers.values():
             h.sync()
         super().sync()

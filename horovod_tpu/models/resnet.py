@@ -136,6 +136,12 @@ def init(key: jax.Array, depth: int = 50, num_classes: int = 1000,
     return params, stats
 
 
+# Indirection for the stem maxpool so profiling scripts can substitute the
+# pooling op (avg/skip A/Bs) without monkeypatching the shared jax.lax
+# module process-wide (scripts/profile_resnet.py).
+_reduce_window = lax.reduce_window
+
+
 def apply(params, stats, x: jax.Array, depth: int = 50, train: bool = True,
           axis_name=None) -> Tuple[jax.Array, Dict]:
     """x: (N, H, W, 3) NHWC. Returns (logits, new_batch_stats)."""
@@ -147,8 +153,8 @@ def apply(params, stats, x: jax.Array, depth: int = 50, train: bool = True,
         h = _conv(x, params["stem"]["conv"], stride=2)
     h, new_stats["stem"] = bn(h, params["stem"]["bn"], stats["stem"])
     h = jax.nn.relu(h)
-    h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
-                          "SAME")
+    h = _reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                       "SAME")
     blocks = STAGE_BLOCKS[depth]
     for s, n in enumerate(blocks):
         for b in range(n):

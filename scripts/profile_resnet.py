@@ -58,7 +58,9 @@ def make_step(batch, fwd_only=False, dtype=jnp.bfloat16):
 def main():
     import horovod_tpu.models.resnet as rn
     batches = [int(b) for b in sys.argv[1:]] or [128, 256]
-    orig_rw = rn.lax.reduce_window
+    # Patch the resnet module's own _reduce_window hook — NOT
+    # jax.lax.reduce_window, which is shared process-wide.
+    orig_rw = rn._reduce_window
     for b in batches:
         for label, patch in (
                 ("maxpool  ", None),
@@ -68,11 +70,11 @@ def main():
             if patch == "avg":
                 # init must be a CONCRETE scalar or reduce_window takes
                 # the generic (non-differentiable) variadic path
-                rn.lax.reduce_window = lambda x, init, op, wd, ws, pad: \
+                rn._reduce_window = lambda x, init, op, wd, ws, pad: \
                     orig_rw(x, np.zeros((), x.dtype)[()], lax.add, wd, ws,
                             pad) / 9.0
             elif patch == "skip":
-                rn.lax.reduce_window = \
+                rn._reduce_window = \
                     lambda x, init, op, wd, ws, pad: x[:, ::2, ::2, :]
             try:
                 body, state = make_step(b)
@@ -86,7 +88,7 @@ def main():
                     print(f"B={b} {label} fwd:  {t*1e3:6.1f} ms "
                           f"(fwd MFU {b/t*4.1e9/PEAK:.1%})", flush=True)
             finally:
-                rn.lax.reduce_window = orig_rw
+                rn._reduce_window = orig_rw
 
 
 if __name__ == "__main__":

@@ -284,8 +284,10 @@ def scenario_autotune_sync(hvd, rank, size):
     cfg = raw_state().config
     cfg.autotune = True
     pm = ParameterManager(cfg)
+    # +4 windows of slack: 2 playoff windows (argmax-vs-default re-measure
+    # before freezing) plus recompile-discard steps after knob changes.
     for _ in range(pm.steps_per_sample *
-                   (cfg.autotune_warmup_samples + cfg.autotune_bayes_opt_max_samples + 2)):
+                   (cfg.autotune_warmup_samples + cfg.autotune_bayes_opt_max_samples + 6)):
         pm.record(1 << 20, 0.01)
         pm.update()
         if pm.frozen:

@@ -70,6 +70,66 @@ def test_elastic_sampler_reshard_and_resume(hvd):
     assert len(s2) == per_rank
 
 
+def test_torch_state_setattr_rebinds_handler(hvd):
+    """Reference parity (torch/elastic/state.py:66-69): reassigning a
+    handler-managed attribute (state.sampler = new_sampler) must rebind
+    the registered handler to the NEW object — commit/restore/sync on the
+    stale object would silently diverge from what training uses."""
+    import horovod_tpu.frontends.torch_elastic as te
+
+    old = te.ElasticSampler(list(range(12)), shuffle=False)
+    state = te.TorchState(model=torch.nn.Linear(2, 2), sampler=old)
+    assert state._handlers["sampler"].value is old
+
+    new = te.ElasticSampler(list(range(24)), shuffle=False)
+    state.sampler = new
+    assert state.sampler is new
+    assert state._handlers["sampler"].value is new  # handler rebound
+
+    # set_value snapshots on rebind: restore() rolls the NEW object back
+    # to its state at assignment time.
+    new.record_batch(0, 4)
+    assert new.processed_indices
+    state.restore()
+    assert new.processed_indices == []
+
+    # commit/restore after rebinding track the new object, not the old
+    # (batch size 1: shard length is world-size dependent).
+    first = new.indices[0]
+    new.record_batch(0, 1)
+    state.commit()
+    new.record_batch(1, 1)
+    assert len(new.processed_indices) == 2
+    state.restore()
+    assert new.processed_indices == [first]
+
+    # model/optimizer ride the same handler mechanism: swapping the module
+    # mid-training must rebind + snapshot, so restore() rolls back the NEW
+    # module (not load the old module's state dict into it).
+    new_model = torch.nn.Linear(4, 4)
+    state.model = new_model
+    assert state._handlers["model"].value is new_model
+    w0 = new_model.weight.detach().clone()
+    with torch.no_grad():
+        new_model.weight.add_(1.0)
+    state.restore()
+    assert torch.allclose(new_model.weight, w0)
+
+    # A model assigned AFTER construction (none at init) becomes managed
+    # too — the pre-handler code read self.model live and this must not
+    # regress into a silently-untracked module.
+    late_state = te.TorchState(epoch=0)
+    late = torch.nn.Linear(2, 2)
+    late_state.model = late
+    assert "model" in late_state._handlers
+    lw0 = late.weight.detach().clone()
+    late_state.commit()
+    with torch.no_grad():
+        late.weight.add_(1.0)
+    late_state.restore()
+    assert torch.allclose(late.weight, lw0)
+
+
 def test_tf_keras_state_commit_restore(hvd):
     tf = pytest.importorskip("tensorflow")
     import keras
