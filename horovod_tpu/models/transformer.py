@@ -58,6 +58,12 @@ class TransformerConfig:
     # otherwise stack L-deep in HBM) — the standard TPU FLOPs-for-memory
     # trade (jax.checkpoint; HBM is the usual bottleneck).
     remat: bool = False
+    # What the checkpoint saves: "dots" keeps non-batch matmul outputs
+    # (projections/FFN — small, expensive to recompute) and recomputes
+    # batched dots; "full" saves nothing (maximum recompute, minimum HBM).
+    # A/B'd on v5e in docs/benchmarks.md — "dots" wins at the flagship
+    # config.
+    remat_policy: str = "dots"
 
     @property
     def head_dim(self) -> int:
@@ -227,12 +233,21 @@ def _forward_local(params, tokens, cfg: TransformerConfig) -> jax.Array:
         def body(a, lp):
             return _layer(a, lp, cfg), None
         if cfg.remat:
-            # Save projection/FFN matmul outputs (small, expensive to
-            # recompute); recompute batched-dot products — exactly the
-            # (B,H,S,S) attention matrices that blow up HBM.
-            body = jax.checkpoint(
-                body, prevent_cse=False,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            # "dots": save projection/FFN matmul outputs (small, expensive
+            # to recompute); recompute batched-dot products — exactly the
+            # (B,H,S,S) attention matrices that blow up HBM. "full": save
+            # nothing, recompute the whole layer in backward.
+            policies = {
+                "dots":
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                "full": None,
+            }
+            if cfg.remat_policy not in policies:
+                raise HorovodTpuError(
+                    f"remat_policy={cfg.remat_policy!r}: choose from "
+                    f"{sorted(policies)} (remat=False turns remat off)")
+            body = jax.checkpoint(body, prevent_cse=False,
+                                  policy=policies[cfg.remat_policy])
         out, _ = lax.scan(body, act, stage_params)
         return out
 

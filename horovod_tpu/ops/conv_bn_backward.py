@@ -1,0 +1,228 @@
+"""Fused 1x1-conv + BatchNorm backward as a Pallas TPU kernel.
+
+THE ResNet-50 step-time lever (round-4 trace, docs/benchmarks.md): the
+BN-backward reduction family is ~33% of the step and sits at its own HBM
+roofline because XLA materializes the BN-input gradient `dy` between the
+BN-backward elementwise pass and the conv backward that consumes it:
+
+    XLA schedule per 1x1-conv+BN site (all full HBM streams):
+      pass A   read dz, y            -> dbeta, dgamma   (reductions)
+      pass B   read dz, y            -> WRITE dy
+      conv dx  read dy (+w)          -> write dx
+      conv dW  read dy, x_in         -> write dW
+
+`dy` is written once and read twice — three full streams of the largest
+activation family in the network (the 4*width conv3 outputs alone are
+~1.4 GB/step at B=128). This kernel fuses pass B INTO both consumer
+matmuls: each (block_m, C) tile of dy is formed in registers from
+(dz, y, stats, pass-A sums) and immediately fed to the MXU for
+dx = dy @ w.T and the dW accumulation — dy never exists in HBM:
+
+    fused:
+      pass A   read dz, y            -> dbeta, dgamma   (XLA, unchanged)
+      kernel   read dz, y, x_in      -> write dx, dW    (one pass)
+
+Pass A stays in XLA: its reductions must COMPLETE before any dy tile can
+be formed (two-phase dependency), and XLA already runs it at the
+streaming roofline. Only 1x1 convs qualify (their backward-input is a
+matmul the MXU eats directly); 3x3 sites keep XLA's conv custom-calls.
+
+The dW accumulator rides in VMEM scratch across the sequential TPU grid;
+dx tiles stream out. bf16 in, f32 accumulation, bf16 out — matching what
+XLA does for the unfused sequence.
+
+No reference counterpart (the reference wraps cuDNN's fused
+BatchNormBackwardEx, torch/mxnet do the fusion below it); this is the
+TPU-native equivalent of that fusion, one level deeper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block_m(m: int, c: int, cin: int, vmem_budget=7 * 2**20) -> int:
+    """Largest row block that divides m, keeps the working set (streamed
+    tiles double-buffered + the persistent dW accumulator) inside VMEM,
+    and stays a multiple of the 8-row sublane."""
+    fixed = cin * c * (4 + 2)  # f32 accumulator + bf16 weights
+    for bm in (1024, 512, 448, 256, 128, 64, 32, 16, 8):
+        if m % bm:
+            continue
+        streamed = 2 * bm * (2 * c + 2 * cin) * 2  # dz,y,x_in,dx bf16 x2
+        if fixed + streamed + bm * c * 4 <= vmem_budget:
+            return bm
+    return 8
+
+
+def _bwd_kernel(dz_ref, y_ref, x_ref, w_ref, g_ref, mean_ref, inv_ref,
+                a_ref, b_ref, dx_ref, dw_ref, dw_acc_ref):
+    """One (block_m, C) row tile: form dy in registers, feed both MXU
+    contractions, accumulate dW across the sequential grid.
+
+    dy = g*dz - A - B*xhat — the full train-mode BN backward (gradients
+    through batch mean/var, plus any cotangents on the aux stats outputs)
+    pre-folded into per-channel vectors by the wrapper:
+      g = gamma*inv,  A = g*dbeta/M - dmean/M,
+      B = g*dgamma/M - 2*dvar/(M*inv)."""
+    dz = dz_ref[:].astype(jnp.float32)          # (bm, C)
+    y = y_ref[:].astype(jnp.float32)            # (bm, C)
+    xhat = (y - mean_ref[:]) * inv_ref[:]       # (bm, C), stats bcast (1, C)
+    dy = (g_ref[:] * dz - a_ref[:] - b_ref[:] * xhat).astype(dz_ref.dtype)
+    dx_ref[:] = jax.lax.dot_general(
+        dy, w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dx_ref.dtype)
+    part = jax.lax.dot_general(                 # x_in^T @ dy -> (Cin, C)
+        x_ref[:], dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_acc_ref[:] = part
+
+    @pl.when(pl.program_id(0) > 0)
+    def _acc():
+        dw_acc_ref[:] += part
+
+    @pl.when(pl.program_id(0) == pl.num_programs(0) - 1)
+    def _emit():
+        dw_ref[:] = dw_acc_ref[:]
+
+
+def conv1x1_bn_bwd_fused(dz: jax.Array, y: jax.Array, x_in: jax.Array,
+                         w: jax.Array, scale: jax.Array, mean: jax.Array,
+                         inv: jax.Array, dbeta: jax.Array,
+                         dgamma: jax.Array, dmean=None,
+                         dvar=None) -> Tuple[jax.Array, jax.Array]:
+    """dx, dw for a 1x1 conv followed by train-mode BN, given the
+    upstream gradient dz w.r.t. the BN OUTPUT and pass A's sums.
+
+    dz, y: (M, C) rows (flattened N*H*W); x_in: (M, Cin); w: (Cin, C);
+    scale/mean/inv/dbeta/dgamma: (C,) f32. dmean/dvar: optional (C,) f32
+    cotangents on the batch-stat outputs (exactly folded into the
+    per-channel vectors — see _bwd_kernel). Returns dx (M, Cin) in
+    x_in.dtype and dw (Cin, C) f32.
+    """
+    m, c = dz.shape
+    cin = x_in.shape[1]
+    minv = 1.0 / m
+    g = scale.astype(jnp.float32) * inv
+    a_vec = g * dbeta * minv
+    b_vec = g * dgamma * minv
+    if dmean is not None:
+        a_vec = a_vec - dmean * minv
+    if dvar is not None:
+        b_vec = b_vec - 2.0 * dvar * minv / inv
+    # Pad rows to a sublane multiple: padded x_in rows are ZERO, so their
+    # (nonzero) dy never reaches dW (0^T @ dy) and their dx rows are
+    # sliced off below. minv stays 1/m — the real row count.
+    m_pad = -m % 8
+    if m_pad:
+        pad = lambda a: jnp.pad(a, ((0, m_pad), (0, 0)))  # noqa: E731
+        dz, y, x_in = pad(dz), pad(y), pad(x_in)
+    mp = m + m_pad
+    bm = _pick_block_m(mp, c, cin)
+    row = lambda v: v.reshape(1, c).astype(jnp.float32)  # noqa: E731
+    dx, dw = pl.pallas_call(
+        _bwd_kernel,
+        grid=(mp // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, c), lambda i: (i, 0)),       # dz
+            pl.BlockSpec((bm, c), lambda i: (i, 0)),       # y
+            pl.BlockSpec((bm, cin), lambda i: (i, 0)),     # x_in
+            pl.BlockSpec((cin, c), lambda i: (0, 0)),      # w
+            pl.BlockSpec((1, c), lambda i: (0, 0)),        # g
+            pl.BlockSpec((1, c), lambda i: (0, 0)),        # mean
+            pl.BlockSpec((1, c), lambda i: (0, 0)),        # inv
+            pl.BlockSpec((1, c), lambda i: (0, 0)),        # A
+            pl.BlockSpec((1, c), lambda i: (0, 0)),        # B
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, cin), lambda i: (i, 0)),     # dx
+            pl.BlockSpec((cin, c), lambda i: (0, 0)),      # dw
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, cin), x_in.dtype),
+            jax.ShapeDtypeStruct((cin, c), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((cin, c), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),  # sequential: dW accum
+        interpret=_interpret(),
+    )(dz, y, x_in, w, row(g), row(mean), row(inv), row(a_vec), row(b_vec))
+    return (dx[:m] if m_pad else dx), dw
+
+
+# --------------------------------------------------------------------------
+# custom_vjp wrapper: the model-facing fused op
+# --------------------------------------------------------------------------
+
+def _bn_sums(dz, y, mean, inv):
+    """Pass A (XLA): dbeta = sum(dz), dgamma = sum(dz * xhat) — one fused
+    read of dz+y, already at the streaming roofline."""
+    dzf = dz.astype(jnp.float32)
+    xhat = (y.astype(jnp.float32) - mean) * inv
+    return jnp.sum(dzf, axis=0), jnp.sum(dzf * xhat, axis=0)
+
+
+def _fwd_math(x, w, scale, bias, eps):
+    y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    mean = jnp.mean(y, axis=0, dtype=jnp.float32)
+    meansq = jnp.mean(jnp.square(y.astype(jnp.float32)), axis=0)
+    var = meansq - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + eps)
+    z = ((y.astype(jnp.float32) - mean) * inv).astype(x.dtype) * scale + bias
+    return z, (y, mean, var, inv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def conv1x1_bn(x, w, scale, bias, eps=1e-5):
+    """z = batch_norm(x @ w) over flattened rows, train mode — forward in
+    plain XLA, backward through the fused Pallas kernel. Returns
+    (z, (batch_mean, batch_var)); the aux stats feed running-stat updates
+    exactly like models/resnet.batch_norm does."""
+    z, (y, mean, var, inv) = _fwd_math(x, w, scale, bias, eps)
+    return z, (mean, var)
+
+
+def _conv1x1_bn_fwd(x, w, scale, bias, eps):
+    z, (y, mean, var, inv) = _fwd_math(x, w, scale, bias, eps)
+    return (z, (mean, var)), (x, w, scale, y, mean, inv)
+
+
+def _conv1x1_bn_bwd(eps, res, cts):
+    x, w, scale, y, mean, inv = res
+    dz, (dmean, dvar) = cts
+    dbeta, dgamma = _bn_sums(dz, y, mean, inv)
+    # dmean/dvar cotangents (zero in normal training — optax treats batch
+    # stats as state — but exact when a loss does use the aux stats) fold
+    # into the kernel's per-channel vectors for free.
+    dx, dw = conv1x1_bn_bwd_fused(
+        dz, y, x, w, scale.astype(jnp.float32).ravel(), mean, inv,
+        dbeta, dgamma, dmean=dmean, dvar=dvar)
+    return (dx, dw.astype(w.dtype), dgamma.astype(scale.dtype),
+            dbeta.astype(scale.dtype))
+
+
+conv1x1_bn.defvjp(_conv1x1_bn_fwd, _conv1x1_bn_bwd)
+
+
+def conv1x1_bn_nhwc(x, w, scale, bias, eps=1e-5):
+    """NHWC convenience wrapper: x (N, H, W, Cin), w (1, 1, Cin, Cout) or
+    (Cin, Cout). Returns (z in NHWC, (mean, var))."""
+    n, h, wd, cin = x.shape
+    w2 = w.reshape(w.shape[-2], w.shape[-1])
+    z, stats = conv1x1_bn(x.reshape(n * h * wd, cin), w2, scale, bias, eps)
+    return z.reshape(n, h, wd, -1), stats

@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="SSH port for remote workers")
     p.add_argument("-i", "--ssh-identity-file", default=None,
                    help="SSH identity file for remote workers")
+    p.add_argument("--stage-dir", default=None, metavar="DIR",
+                   help="stage (rsync) the current working directory to "
+                        "DIR on every remote host before launch and run "
+                        "workers from there — for clusters without a "
+                        "shared filesystem (reference: task-service file "
+                        "staging, runner/common/service/task_service.py)")
     p.add_argument("--disable-cache", action="store_true",
                    help="disable the compiled-collective cache")
     p.add_argument("--fusion-threshold-mb", type=int, default=None,
@@ -361,21 +367,29 @@ def _worker_pythonpath(existing: Optional[str]) -> str:
     return os.pathsep.join(parts)
 
 
-def ssh_command_prefix(hostname: str,
-                       ssh_port: Optional[int] = None,
-                       ssh_identity_file: Optional[str] = None) -> List[str]:
+def _ssh_options(ssh_port: Optional[int] = None,
+                 ssh_identity_file: Optional[str] = None) -> List[str]:
+    """The one place SSH transport options are assembled (worker exec,
+    staging mkdir, and the rsync -e transport all share it)."""
     cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if ssh_port:
         cmd += ["-p", str(ssh_port)]
     if ssh_identity_file:
         cmd += ["-i", ssh_identity_file]
-    return cmd + [hostname]
+    return cmd
+
+
+def ssh_command_prefix(hostname: str,
+                       ssh_port: Optional[int] = None,
+                       ssh_identity_file: Optional[str] = None) -> List[str]:
+    return _ssh_options(ssh_port, ssh_identity_file) + [hostname]
 
 
 def make_worker_cmd(slot: hosts_mod.SlotInfo, command: List[str],
                     base_env: Dict[str, str],
                     ssh_port: Optional[int] = None,
                     ssh_identity_file: Optional[str] = None,
+                    remote_cwd: Optional[str] = None,
                     ) -> (List[str], Dict[str, str]):
     env = dict(os.environ)
     env.update(base_env)
@@ -389,13 +403,100 @@ def make_worker_cmd(slot: hosts_mod.SlotInfo, command: List[str],
     import shlex
     remote_env = {**base_env, **slot.to_env()}
     remote_env["PYTHONPATH"] = env["PYTHONPATH"]
+    cwd = remote_cwd or os.getcwd()
+    if remote_cwd:
+        # Staged launch (--stage-dir): the launcher's checkout path does
+        # not exist on the remote host; the staged dir itself must win
+        # imports (a source checkout stages horovod_tpu/ inside it).
+        remote_env["PYTHONPATH"] = \
+            remote_cwd + os.pathsep + env["PYTHONPATH"]
     env_str = " ".join(f"{k}={shlex.quote(str(v))}"
                        for k, v in remote_env.items())
-    remote = (f"cd {shlex.quote(os.getcwd())} && env {env_str} "
+    remote = (f"cd {shlex.quote(cwd)} && env {env_str} "
               + " ".join(shlex.quote(c) for c in command))
     return ssh_command_prefix(slot.hostname, ssh_port,
                               ssh_identity_file) + [remote], \
         dict(os.environ)
+
+
+def stage_to_hosts(remote_hosts: List[str], stage_dir: str,
+                   ssh_port: Optional[int] = None,
+                   ssh_identity_file: Optional[str] = None,
+                   src_dir: Optional[str] = None) -> None:
+    """Sync `src_dir` (default: cwd) to `stage_dir` on every remote host —
+    the launcher-side analog of the reference's task-service file staging
+    (runner/common/service/task_service.py syncs the working dir to each
+    task before exec; here the launcher pushes once per host over the
+    same SSH channel the workers use).
+
+    rsync when available (incremental re-stages are cheap), scp -r
+    otherwise. All hosts stage concurrently; any failure aborts the
+    launch with the failing host named.
+    """
+    import shlex
+    import shutil
+    import subprocess
+
+    src = os.path.abspath(src_dir or os.getcwd())
+    ssh_cmd = _ssh_options(ssh_port, ssh_identity_file)
+    use_rsync = shutil.which("rsync") is not None
+
+    def drain(procs, what):
+        """Wait on every spawned transfer; on any failure terminate the
+        rest (a failed launch must not leave background transfers
+        mutating stage dirs on surviving hosts) and raise with the
+        failing hosts named."""
+        failures = []
+        try:
+            for host, proc in procs:
+                _, err = proc.communicate()
+                if proc.returncode != 0:
+                    failures.append(f"{host}: {err.strip()}")
+        finally:
+            for _, proc in procs:
+                if proc.poll() is None:
+                    proc.terminate()
+                    proc.communicate()
+        if failures:
+            raise HorovodTpuError(
+                f"--stage-dir {what} failed on " + "; ".join(failures))
+
+    # mkdir -p first (all hosts concurrently): rsync/scp into a missing
+    # parent fails with an error naming the transport, not the problem.
+    drain([(host, subprocess.Popen(
+        ssh_cmd + [host, f"mkdir -p {shlex.quote(stage_dir)}"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        for host in remote_hosts], f"mkdir -p {stage_dir!r}")
+
+    if not use_rsync:
+        import sys as _sys
+        print("horovodrun-tpu: rsync not found; staging with scp -r — "
+              "files deleted locally will NOT be removed from previously "
+              "staged hosts (install rsync for exact re-stages)",
+              file=_sys.stderr)
+    procs = []
+    for host in remote_hosts:
+        # '[host]' bracketing: a bare IPv6 literal's colons would read as
+        # rsync daemon-module / scp path syntax
+        spec_host = f"[{host}]" if ":" in host else host
+        if use_rsync:
+            # -e carries the same port/identity options, shell-quoted —
+            # rsync word-splits the transport string honoring quotes;
+            # trailing / copies contents, --delete keeps re-stages exact
+            cmd = ["rsync", "-az", "--delete",
+                   "-e", " ".join(shlex.quote(c) for c in ssh_cmd),
+                   src + "/", f"{spec_host}:{stage_dir}/"]
+        else:
+            cmd = ["scp", "-o", "StrictHostKeyChecking=no", "-r"]
+            if ssh_port:
+                cmd += ["-P", str(ssh_port)]
+            if ssh_identity_file:
+                cmd += ["-i", ssh_identity_file]
+            cmd += [src + "/.", f"{spec_host}:{stage_dir}/"]
+        procs.append((host, subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)))
+    drain(procs, "sync")
 
 
 def _discover_coordinator_ip(remote_hosts: List[str],
@@ -428,7 +529,8 @@ def launch_static(np: int, host_spec: str, command: List[str],
                   ssh_port: Optional[int] = None,
                   ssh_identity_file: Optional[str] = None,
                   output_dir: Optional[str] = None,
-                  prefix_timestamp: bool = False) -> int:
+                  prefix_timestamp: bool = False,
+                  stage_dir: Optional[str] = None) -> int:
     """Spawn one worker per slot, wait, propagate failure (reference:
     launch.py _run_static + gloo_run.launch_gloo)."""
     host_list = hosts_mod.parse_hosts(host_spec)
@@ -444,6 +546,9 @@ def launch_static(np: int, host_spec: str, command: List[str],
     ip = coordinator_ip or _local_ip()
     remote_hosts = sorted({s.hostname for s in slots
                            if not _is_local(s.hostname)})
+    if stage_dir and remote_hosts:
+        stage_to_hosts(remote_hosts, stage_dir, ssh_port=ssh_port,
+                       ssh_identity_file=ssh_identity_file)
     if remote_hosts and coordinator_ip is None and \
             os.environ.get("HOROVOD_NIC_DISCOVERY", "1") == "1":
         # Multi-NIC launch hosts publish the wrong address silently;
@@ -492,7 +597,8 @@ def launch_static(np: int, host_spec: str, command: List[str],
         for slot in slots:
             cmd, env = make_worker_cmd(slot, command, base_env,
                                        ssh_port=ssh_port,
-                                       ssh_identity_file=ssh_identity_file)
+                                       ssh_identity_file=ssh_identity_file,
+                                       remote_cwd=stage_dir)
             logfile = None
             if output_dir:
                 d = os.path.join(output_dir, f"rank.{slot.rank}")
@@ -599,6 +705,13 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         return 2
 
     if args.host_discovery_script:
+        if args.stage_dir:
+            # elastic hosts arrive dynamically — staging them at launch
+            # time cannot cover later joiners, so the flag is static-only
+            print("horovodrun-tpu: --stage-dir only applies to static "
+                  "launches; ignored in elastic mode (hosts discovered "
+                  "later would never be staged — use a shared filesystem "
+                  "or image-baked code for elastic jobs)", file=sys.stderr)
         from horovod_tpu.elastic.driver import run_elastic
         return run_elastic(args, command, args_to_env(args))
 
@@ -640,6 +753,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             ("--ssh-port", args.ssh_port),
             ("--ssh-identity-file", args.ssh_identity_file),
             ("--prefix-output-with-timestamp", args.prefix_timestamp),
+            ("--stage-dir", args.stage_dir),
         ) if v]
         if dropped:
             print(f"horovodrun-tpu: {', '.join(dropped)} only apply to "
@@ -667,7 +781,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                          ssh_port=args.ssh_port,
                          ssh_identity_file=args.ssh_identity_file,
                          output_dir=args.output_filename,
-                         prefix_timestamp=args.prefix_timestamp)
+                         prefix_timestamp=args.prefix_timestamp,
+                         stage_dir=args.stage_dir)
 
 
 def _prefer_jsrun() -> bool:

@@ -1,0 +1,101 @@
+"""Layer-level A/B: fused Pallas conv1x1+BN backward vs the XLA sequence.
+
+Measures, per ResNet-50 layer site (B=128 shapes), the backward-path cost
+the fusion targets:
+
+  XLA:    dy = bn_bwd_elemwise(dz, y, sums)  [materialized]
+          dx = dy @ w.T ; dw = x^T @ dy
+  fused:  conv_bn_backward.conv1x1_bn_bwd_fused (dy never in HBM)
+
+Pass A (the dbeta/dgamma reductions) is identical in both and excluded.
+Slope timing over pipelined calls cancels the tunnel's fixed round trip
+(docs/benchmarks.md).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu.ops.conv_bn_backward import conv1x1_bn_bwd_fused
+
+# (name, M, Cin, C): conv1/conv3 sites of ResNet-50 at B=128, 224px
+SITES = [
+    ("s0.conv3 56x56 64->256", 128 * 56 * 56, 64, 256),
+    ("s0.conv1 56x56 256->64", 128 * 56 * 56, 256, 64),
+    ("s1.conv3 28x28 128->512", 128 * 28 * 28, 128, 512),
+    ("s1.conv1 28x28 512->128", 128 * 28 * 28, 512, 128),
+    ("s2.conv3 14x14 256->1024", 128 * 14 * 14, 256, 1024),
+    ("s2.conv1 14x14 1024->256", 128 * 14 * 14, 1024, 256),
+    ("s3.conv3 7x7 512->2048", 128 * 7 * 7, 512, 2048),
+]
+
+
+def _slope_ms(fn, args, k=6, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    float(jnp.sum(out[0].ravel()[:2].astype(jnp.float32)))
+
+    def run(n):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = fn(*args)
+        jax.block_until_ready(o)
+        float(jnp.sum(o[0].ravel()[:2].astype(jnp.float32)))
+        return time.perf_counter() - t0
+
+    run(2)
+    best, fb = float("inf"), float("inf")
+    for _ in range(reps):
+        tk, t2k = run(k), run(2 * k)
+        s = (t2k - tk) / k
+        if s > 0:
+            best = min(best, s)
+        fb = min(fb, t2k / (2 * k))
+    return (best if best != float("inf") else fb) * 1e3
+
+
+def xla_seq(dz, y, x, w, scale, mean, inv, db, dg):
+    m = dz.shape[0]
+    xhat = (y.astype(jnp.float32) - mean) * inv
+    dy = ((scale * inv) * (dz.astype(jnp.float32)
+                           - (db + xhat * dg) / m)).astype(dz.dtype)
+    dx = lax.dot_general(dy, w, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+    dw = lax.dot_general(x, dy, (((0,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    return dx, dw
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}")
+    total_xla, total_fused = 0.0, 0.0
+    for name, m, cin, c in SITES:
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        dz = jax.random.normal(ks[0], (m, c), jnp.bfloat16)
+        y = jax.random.normal(ks[1], (m, c), jnp.bfloat16)
+        x = jax.random.normal(ks[2], (m, cin), jnp.bfloat16)
+        w = jax.random.normal(ks[0], (cin, c), jnp.bfloat16) * 0.05
+        scale = jnp.ones((c,), jnp.float32)
+        mean = jnp.zeros((c,), jnp.float32)
+        inv = jnp.ones((c,), jnp.float32)
+        db = jnp.zeros((c,), jnp.float32)
+        dg = jnp.zeros((c,), jnp.float32)
+        args = (dz, y, x, w, scale, mean, inv, db, dg)
+
+        t_xla = _slope_ms(jax.jit(xla_seq), args)
+        t_fused = _slope_ms(jax.jit(conv1x1_bn_bwd_fused), args)
+        gb = (3 * m * c * 2 + 2 * m * cin * 2) / 2**30  # streams: see module doc
+        print(f"{name:28s} XLA {t_xla:7.2f} ms   fused {t_fused:7.2f} ms  "
+              f"({t_xla / t_fused:4.2f}x)  [~{gb:.2f} GB moved unfused]")
+        total_xla += t_xla
+        total_fused += t_fused
+    print(f"{'TOTAL (sites above)':28s} XLA {total_xla:7.2f} ms   "
+          f"fused {total_fused:7.2f} ms  ({total_xla / total_fused:4.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

@@ -88,17 +88,36 @@ def test_tf_keras_fit_example():
 
 
 def test_scaling_report():
-    """--scaling-report 1 vs N on the virtual CPU mesh: the harness
-    itself must run end to end and emit the JSON line (on a pod the same
-    flag measures real 1→N chip efficiency; BASELINE.md north star)."""
+    """--scaling-report 1 vs 8 on the virtual CPU mesh: the full harness
+    behind the reference's north-star metric (90% efficiency 1→N,
+    README.rst:102-108; BASELINE.md) runs end to end and emits a
+    schema-complete JSON line. On a pod the identical flag measures real
+    1→N chip efficiency — this rehearsal pins the harness so the pod run
+    is a parameter change, not new code."""
     import json
 
-    out = _run_example("synthetic_benchmark.py", "--scaling-report", "4",
+    out = _run_example("synthetic_benchmark.py", "--scaling-report", "8",
                        "--batch-size", "2", "--image-size", "32",
                        "--num-iters", "2", "--num-batches-per-iter", "2",
                        "--dtype", "float32")
     line = [ln for ln in out.splitlines()
             if ln.startswith("{")][-1]
     rec = json.loads(line)
-    assert rec["n"] == 4
-    assert rec["scaling_efficiency"] > 0
+    assert set(rec) == {"model", "per_rank_batch", "ips_1chip",
+                        "ips_per_chip_at_n", "n", "scaling_efficiency"}
+    assert rec["model"] == "resnet50" and rec["per_rank_batch"] == 2
+    assert rec["n"] == 8
+    assert rec["ips_1chip"] > 0 and rec["ips_per_chip_at_n"] > 0
+    # Sane-bounds check, not a perf gate: the 8 virtual CPU "chips" share
+    # one host's cores, so per-chip efficiency is far below a pod's —
+    # anything in (0, 1.5] proves the harness computes a real ratio
+    # (NaN/0/negative/>>1 all indicate a broken measurement).
+    eff = rec["scaling_efficiency"]
+    assert 0.0 < eff <= 1.5, rec
+    # consistency of the reported fields — eff is computed from UNROUNDED
+    # rates while ips_* are rounded to 1 decimal, so the tolerance must
+    # absorb the rounding error of both rates (±0.05 each)
+    ratio = rec["ips_per_chip_at_n"] / rec["ips_1chip"]
+    tol = eff * (0.05 / rec["ips_per_chip_at_n"]
+                 + 0.05 / rec["ips_1chip"]) + 1e-3
+    assert abs(eff - ratio) <= tol, rec

@@ -352,3 +352,81 @@ def test_hostfile_rejects_ipv6_trailing_garbage(tmp_path):
     bad.write_text("fe80::2 junk\n")
     with pytest.raises(HorovodTpuError):
         parse_hostfile(str(bad))
+
+
+# ------------------------------------------------------------- file staging
+
+def _stub_bin(tmp_path, name, log):
+    """Executable stub that appends its argv to `log` and exits 0."""
+    p = tmp_path / "bin" / name
+    p.parent.mkdir(exist_ok=True)
+    p.write_text(f"#!/bin/sh\necho \"{name} $@\" >> {log}\n")
+    p.chmod(0o755)
+    return p
+
+
+def test_stage_to_hosts_rsync(tmp_path, monkeypatch):
+    """--stage-dir pushes the working dir to each remote host over the
+    same SSH options the workers use (reference analog: task-service
+    file staging, runner/common/service/task_service.py)."""
+    from horovod_tpu.runner.launch import stage_to_hosts
+
+    log = tmp_path / "calls.log"
+    for name in ("ssh", "rsync"):
+        _stub_bin(tmp_path, name, log)
+    monkeypatch.setenv("PATH", f"{tmp_path / 'bin'}:{os.environ['PATH']}")
+    src = tmp_path / "proj"
+    src.mkdir()
+    (src / "train.py").write_text("pass\n")
+
+    stage_to_hosts(["h1", "h2"], "/scratch/job", ssh_port=2222,
+                   ssh_identity_file="/k.pem", src_dir=str(src))
+    calls = log.read_text().splitlines()
+    ssh_calls = [c for c in calls if c.startswith("ssh ")]
+    rsync_calls = [c for c in calls if c.startswith("rsync ")]
+    # mkdir -p on every host, with the ssh options (concurrent: match
+    # by content, not log order)
+    assert len(ssh_calls) == 2
+    for host in ("h1", "h2"):
+        call = next(c for c in ssh_calls if f" {host} " in c)
+        assert "mkdir -p /scratch/job" in call
+        assert "-p 2222" in call and "-i /k.pem" in call
+    # one rsync per host: contents of src -> host:stage_dir (the two
+    # transfers run concurrently, so match by content, not log order)
+    assert len(rsync_calls) == 2
+    for host in ("h1", "h2"):
+        call = next(c for c in rsync_calls if f"{host}:/scratch/job/" in c)
+        assert f"{src}/ " in call
+        assert "--delete" in call
+        assert "-p 2222" in call and "-i /k.pem" in call  # via -e
+
+
+def test_stage_to_hosts_failure_names_host(tmp_path, monkeypatch):
+    from horovod_tpu.runner.launch import stage_to_hosts
+
+    log = tmp_path / "calls.log"
+    _stub_bin(tmp_path, "ssh", log)
+    rsync = tmp_path / "bin" / "rsync"
+    rsync.write_text("#!/bin/sh\necho 'connection refused' >&2\nexit 12\n")
+    rsync.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path / 'bin'}:{os.environ['PATH']}")
+    with pytest.raises(HorovodTpuError, match="badhost.*connection refused"):
+        stage_to_hosts(["badhost"], "/scratch/job", src_dir=str(tmp_path))
+
+
+def test_stage_dir_changes_remote_cwd_and_pythonpath():
+    """Workers launched with --stage-dir cd into the staged dir (not the
+    launcher's cwd, which does not exist remotely) and import from it."""
+    from horovod_tpu.runner.launch import make_worker_cmd
+
+    slot = hosts_mod.SlotInfo(hostname="remotehost", rank=1, size=2,
+                              local_rank=0, local_size=1, cross_rank=1,
+                              cross_size=2)
+    cmd, _ = make_worker_cmd(slot, ["python", "t.py"], {},
+                             remote_cwd="/scratch/job")
+    remote = cmd[-1]
+    assert remote.startswith("cd /scratch/job && ")
+    assert "PYTHONPATH=/scratch/job:" in remote
+    # without staging the remote cd targets the launcher's own cwd
+    cmd2, _ = make_worker_cmd(slot, ["python", "t.py"], {})
+    assert cmd2[-1].startswith(f"cd {os.getcwd()} && ")
