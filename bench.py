@@ -517,7 +517,8 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
 
 # ResNet-50-like gradient set: a few conv bodies + many small BN/bias
 # grads (~26 MB total, 126 tensors). Small tensors are the regime where
-# bucketing matters: at 1 MB the set compiles to ~25 psums, at 64 MB to 1.
+# bucketing matters: the set compiles to 8/5/2/1 psums at 1/4/16/64 MB
+# (pinned by tests/test_bench_timing.py).
 _EAGER_SIZES = [(1000, 512), (512,)] + [(512, 512, 3, 3)] * 2 + \
     [(256, 256, 3, 3)] * 2 + [(128, 128, 3, 3)] * 2 + \
     [(512,)] * 60 + [(256,)] * 60
@@ -525,7 +526,17 @@ _EAGER_SIZES = [(1000, 512), (512,)] + [(512, 512, 3, 3)] * 2 + \
 
 def _eager_cpu_mesh_child():
     """Child-process body (bench.py --eager-cpu-mesh): fusion sweep +
-    autotune on the 8-device CPU mesh; prints one JSON line."""
+    autotune on the 8-device CPU mesh; prints one JSON line. Requires
+    the bench_eager_cpu_mesh environment — a direct invocation without
+    it would silently measure the tunneled TPU and label it a CPU mesh,
+    so enforce it here rather than trust the caller."""
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 2 or \
+            not os.environ.get("HOROVOD_NO_REPLICATED_FAST"):
+        raise SystemExit(
+            "--eager-cpu-mesh needs JAX_PLATFORMS=cpu, "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 and "
+            "HOROVOD_NO_REPLICATED_FAST=1 (run through bench.py's "
+            "bench_eager_cpu_mesh wrapper)")
     hvd.init()
     from horovod_tpu.core.autotune import ParameterManager
     from horovod_tpu.ops.collectives import clear_compiled_cache
@@ -533,7 +544,8 @@ def _eager_cpu_mesh_child():
     tensors = [jnp.ones(s, jnp.float32) for s in _EAGER_SIZES]
     nbytes = sum(int(np.prod(s)) * 4 for s in _EAGER_SIZES)
     cfg = topology.raw_state().config
-    result = {"platform": "8-device virtual CPU mesh (subprocess)",
+    result = {"platform": f"{len(jax.devices())}-device virtual CPU mesh "
+                          "(subprocess)",
               "workload": f"grouped_allreduce of {len(_EAGER_SIZES)} "
                           f"tensors, {nbytes / 2**20:.1f} MB total"}
 

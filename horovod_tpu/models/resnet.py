@@ -142,10 +142,49 @@ def init(key: jax.Array, depth: int = 50, num_classes: int = 1000,
 _reduce_window = lax.reduce_window
 
 
+def _fuse_conv_bn() -> bool:
+    """Fused 1x1-conv+BN backward (ops/conv_bn_backward.py): the dy
+    tensor between BN backward and the conv backward never touches HBM.
+    Wins 1.2-1.9x at the layer level but LOSES end-to-end (80.9 vs
+    45.2 ms/step measured r05): the custom_vjp boundary de-fuses relu/
+    mask/stat-reduce passes XLA otherwise folds into neighbors, and
+    forces {3,0,2,1}<->{3,2,1,0} layout copies against the 3x3 convs'
+    preferred layouts — docs/benchmarks.md has the full trace autopsy.
+    Default OFF everywhere; HOROVOD_FUSE_CONV_BN=1 opts in (kernel A/B:
+    scripts/bn_conv_bwd_ab.py)."""
+    import os
+    return os.environ.get("HOROVOD_FUSE_CONV_BN") in ("1", "true", "True")
+
+
+def _fused_site_profitable(w) -> bool:
+    """Where the fused backward wins on v5e (scripts/bn_conv_bwd_ab.py,
+    docs/benchmarks.md): the high-resolution conv3/conv1 sites. At
+    cin/cout >= 2048 (stage 4) the resident f32 dW accumulator squeezes
+    the kernel's row blocks and XLA wins — keep those unfused."""
+    cin, cout = w.shape[-2], w.shape[-1]
+    return cin <= 1024 and cout <= 1024
+
+
+def _fused_conv_bn_site(x, w, p, stats, axis_name, momentum=0.9, eps=1e-5):
+    """conv1x1 + train-mode BN through the fused-backward op, emitting
+    the same (out, new_stats) contract as _conv + batch_norm."""
+    from horovod_tpu.ops.conv_bn_backward import conv1x1_bn_nhwc
+
+    z, (mean, var) = conv1x1_bn_nhwc(x, w, p["scale"], p["bias"], eps,
+                                     axis_name)
+    new_stats = {"mean": stats["mean"] * momentum + mean * (1 - momentum),
+                 "var": stats["var"] * momentum + var * (1 - momentum)}
+    return z, new_stats
+
+
 def apply(params, stats, x: jax.Array, depth: int = 50, train: bool = True,
           axis_name=None) -> Tuple[jax.Array, Dict]:
     """x: (N, H, W, 3) NHWC. Returns (logits, new_batch_stats)."""
     bn = functools.partial(batch_norm, train=train, axis_name=axis_name)
+    # Train-mode 1x1-conv+BN pairs ride the fused-backward op on TPU
+    # (_fuse_conv_bn); eval mode and 3x3 sites keep the unfused path.
+    fuse = train and _fuse_conv_bn()
+    cbn = functools.partial(_fused_conv_bn_site, axis_name=axis_name)
     new_stats: Dict[str, Any] = {}
     if x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
         h = _stem_conv_s2d(x, params["stem"]["conv"])
@@ -162,17 +201,28 @@ def apply(params, stats, x: jax.Array, depth: int = 50, train: bool = True,
             blk, st = params[name], stats[name]
             stride = 2 if (b == 0 and s > 0) else 1
             ns = {}
-            y = _conv(h, blk["conv1"])
-            y, ns["bn1"] = bn(y, blk["bn1"], st["bn1"])
+            if fuse and _fused_site_profitable(blk["conv1"]):
+                y, ns["bn1"] = cbn(h, blk["conv1"], blk["bn1"], st["bn1"])
+            else:
+                y = _conv(h, blk["conv1"])
+                y, ns["bn1"] = bn(y, blk["bn1"], st["bn1"])
             y = jax.nn.relu(y)
             y = _conv(y, blk["conv2"], stride=stride)
             y, ns["bn2"] = bn(y, blk["bn2"], st["bn2"])
             y = jax.nn.relu(y)
-            y = _conv(y, blk["conv3"])
-            y, ns["bn3"] = bn(y, blk["bn3"], st["bn3"])
+            if fuse and _fused_site_profitable(blk["conv3"]):
+                y, ns["bn3"] = cbn(y, blk["conv3"], blk["bn3"], st["bn3"])
+            else:
+                y = _conv(y, blk["conv3"])
+                y, ns["bn3"] = bn(y, blk["bn3"], st["bn3"])
             if "proj" in blk:
-                sc = _conv(h, blk["proj"], stride=stride)
-                sc, ns["bnp"] = bn(sc, blk["bnp"], st["bnp"])
+                if fuse and stride == 1 and \
+                        _fused_site_profitable(blk["proj"]):
+                    sc, ns["bnp"] = cbn(h, blk["proj"], blk["bnp"],
+                                        st["bnp"])
+                else:
+                    sc = _conv(h, blk["proj"], stride=stride)
+                    sc, ns["bnp"] = bn(sc, blk["bnp"], st["bnp"])
             else:
                 sc = h
             h = jax.nn.relu(y + sc)

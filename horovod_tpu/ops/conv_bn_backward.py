@@ -27,9 +27,23 @@ be formed (two-phase dependency), and XLA already runs it at the
 streaming roofline. Only 1x1 convs qualify (their backward-input is a
 matmul the MXU eats directly); 3x3 sites keep XLA's conv custom-calls.
 
-The dW accumulator rides in VMEM scratch across the sequential TPU grid;
-dx tiles stream out. bf16 in, f32 accumulation, bf16 out — matching what
-XLA does for the unfused sequence.
+The dW accumulator rides as a constant-index f32 output block, resident
+in VMEM across the sequential (row x C-block) grid; dx tiles stream out.
+bf16 in, f32 accumulation, bf16 out — matching what XLA does for the
+unfused sequence.
+
+MEASURED OUTCOME (r05, v5e, scripts/bn_conv_bwd_ab.py +
+docs/benchmarks.md): the kernel WINS at the layer level — 1.47-1.90x at
+the dominant high-resolution conv3 sites, parity at conv1 — but LOSES
+integrated into the ResNet-50 train step (80.9 vs 45.2 ms), because the
+custom_vjp boundary de-fuses the surrounding graph: relu and its mask
+become standalone full-size passes, the BN stat reduces detach from
+their neighbors, and XLA inserts {3,0,2,1}<->{3,2,1,0} layout copies
+between the flat (M, C) kernel operands and the 3x3 convs' preferred
+batch-minor layouts (~tens of ms of copies in the trace). The model
+integration therefore defaults OFF (models/resnet.py _fuse_conv_bn);
+closing the gap would need relu/residual-add absorbed into the op
+boundary AND layout-custom pallas outputs.
 
 No reference counterpart (the reference wraps cuDNN's fused
 BatchNormBackwardEx, torch/mxnet do the fusion below it); this is the
@@ -51,71 +65,94 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pick_block_m(m: int, c: int, cin: int, vmem_budget=7 * 2**20) -> int:
+def _pick_block_m(m: int, bc: int, cin: int, c_full: int,
+                  vmem_budget=9 * 2**20) -> int:
     """Largest row block that divides m, keeps the working set (streamed
-    tiles double-buffered + the persistent dW accumulator) inside VMEM,
-    and stays a multiple of the 8-row sublane."""
-    fixed = cin * c * (4 + 2)  # f32 accumulator + bf16 weights
+    tiles double-buffered + the resident f32 dW output accumulator of
+    the FULL (Cin, C)) inside VMEM, and stays a multiple of the 8-row
+    sublane."""
+    fixed = cin * c_full * 4  # resident f32 dW accumulator (output block)
     for bm in (1024, 512, 448, 256, 128, 64, 32, 16, 8):
         if m % bm:
             continue
-        streamed = 2 * bm * (2 * c + 2 * cin) * 2  # dz,y,x_in,dx bf16 x2
-        if fixed + streamed + bm * c * 4 <= vmem_budget:
+        streamed = 2 * (2 * bm * bc * 2 + bm * cin * 2 + cin * bc * 2
+                        + bm * cin * 2)  # dz,y,x_in,w,dx x2 buffers
+        if fixed + streamed + bm * bc * 4 + bm * cin * 4 <= vmem_budget:
             return bm
     return 8
 
 
 def _bwd_kernel(dz_ref, y_ref, x_ref, w_ref, g_ref, mean_ref, inv_ref,
-                a_ref, b_ref, dx_ref, dw_ref, dw_acc_ref):
-    """One (block_m, C) row tile: form dy in registers, feed both MXU
-    contractions, accumulate dW across the sequential grid.
+                a_ref, b_ref, dx_ref, dw_ref, dx_acc_ref):
+    """One (bm, bc) tile of a (rows x C-blocks) grid: form dy in
+    registers, feed both MXU contractions.
 
     dy = g*dz - A - B*xhat — the full train-mode BN backward (gradients
     through batch mean/var, plus any cotangents on the aux stats outputs)
     pre-folded into per-channel vectors by the wrapper:
       g = gamma*inv,  A = g*dbeta/M - dmean/M,
-      B = g*dgamma/M - 2*dvar/(M*inv)."""
-    dz = dz_ref[:].astype(jnp.float32)          # (bm, C)
-    y = y_ref[:].astype(jnp.float32)            # (bm, C)
-    xhat = (y - mean_ref[:]) * inv_ref[:]       # (bm, C), stats bcast (1, C)
+      B = g*dgamma/M - 2*dvar/(M*inv).
+
+    Grid is (row blocks, C blocks), C innermost. dx accumulates over the
+    inner C loop in f32 scratch and is emitted once per row block; dW
+    rides a CONSTANT-index f32 output block — resident in VMEM for the
+    whole sequential grid (copy-out only at grid end), accumulated at
+    the (0, j*bc) column slice each step."""
+    i, j = pl.program_id(0), pl.program_id(1)
+    nc = pl.num_programs(1)
+    bc = dz_ref.shape[1]
+    dz = dz_ref[:].astype(jnp.float32)          # (bm, bc)
+    y = y_ref[:].astype(jnp.float32)            # (bm, bc)
+    xhat = (y - mean_ref[:]) * inv_ref[:]       # stats bcast (1, bc)
     dy = (g_ref[:] * dz - a_ref[:] - b_ref[:] * xhat).astype(dz_ref.dtype)
-    dx_ref[:] = jax.lax.dot_general(
+    part_dx = jax.lax.dot_general(              # dy @ w_blk^T -> (bm, Cin)
         dy, w_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(dx_ref.dtype)
-    part = jax.lax.dot_general(                 # x_in^T @ dy -> (Cin, C)
-        x_ref[:], dy, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        dw_acc_ref[:] = part
+    @pl.when(j == 0)
+    def _dx_init():
+        dx_acc_ref[:] = part_dx
 
-    @pl.when(pl.program_id(0) > 0)
-    def _acc():
-        dw_acc_ref[:] += part
+    @pl.when(j > 0)
+    def _dx_acc():
+        dx_acc_ref[:] += part_dx
 
-    @pl.when(pl.program_id(0) == pl.num_programs(0) - 1)
-    def _emit():
-        dw_ref[:] = dw_acc_ref[:]
+    @pl.when(j == nc - 1)
+    def _dx_emit():
+        dx_ref[:] = dx_acc_ref[:].astype(dx_ref.dtype)
+
+    part_dw = jax.lax.dot_general(              # x^T @ dy -> (Cin, bc)
+        x_ref[:], dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    col = pl.ds(pl.multiple_of(j * bc, 128), bc)
+
+    @pl.when(i == 0)
+    def _dw_init():  # uninitialized VMEM may hold NaN bits: store, not 0*
+        dw_ref[:, col] = part_dw
+
+    @pl.when(i > 0)
+    def _dw_acc():
+        dw_ref[:, col] = dw_ref[:, col] + part_dw
 
 
 def conv1x1_bn_bwd_fused(dz: jax.Array, y: jax.Array, x_in: jax.Array,
                          w: jax.Array, scale: jax.Array, mean: jax.Array,
                          inv: jax.Array, dbeta: jax.Array,
-                         dgamma: jax.Array, dmean=None,
-                         dvar=None) -> Tuple[jax.Array, jax.Array]:
+                         dgamma: jax.Array, dmean=None, dvar=None,
+                         count=None) -> Tuple[jax.Array, jax.Array]:
     """dx, dw for a 1x1 conv followed by train-mode BN, given the
     upstream gradient dz w.r.t. the BN OUTPUT and pass A's sums.
 
     dz, y: (M, C) rows (flattened N*H*W); x_in: (M, Cin); w: (Cin, C);
     scale/mean/inv/dbeta/dgamma: (C,) f32. dmean/dvar: optional (C,) f32
     cotangents on the batch-stat outputs (exactly folded into the
-    per-channel vectors — see _bwd_kernel). Returns dx (M, Cin) in
-    x_in.dtype and dw (Cin, C) f32.
+    per-channel vectors — see _bwd_kernel). count: total rows behind the
+    batch stats (M * axis_size under sync-BN; defaults to M). Returns
+    dx (M, Cin) in x_in.dtype and dw (Cin, C) f32.
     """
     m, c = dz.shape
     cin = x_in.shape[1]
-    minv = 1.0 / m
+    minv = 1.0 / (count if count is not None else m)
     g = scale.astype(jnp.float32) * inv
     a_vec = g * dbeta * minv
     b_vec = g * dgamma * minv
@@ -131,33 +168,48 @@ def conv1x1_bn_bwd_fused(dz: jax.Array, y: jax.Array, x_in: jax.Array,
         pad = lambda a: jnp.pad(a, ((0, m_pad), (0, 0)))  # noqa: E731
         dz, y, x_in = pad(dz), pad(y), pad(x_in)
     mp = m + m_pad
-    bm = _pick_block_m(mp, c, cin)
+    # C blocks: cap the per-step tile at 512 lanes so the resident f32
+    # dW block (not per-C-block scratch) is the only Cin*C-sized buffer
+    # and row blocks stay large at the wide sites (Cin=512, C=2048 used
+    # to collapse to 16-row blocks).
+    if c <= 512:
+        bc = c
+    else:  # largest dividing block <= 512, lane-aligned (c % 128 == 0
+        # holds for all model channel counts; 768 -> bc=256, 2048 -> 512)
+        bc = next((b for b in (512, 384, 256, 128) if c % b == 0), None)
+        if bc is None:
+            raise ValueError(
+                f"conv1x1_bn_bwd_fused: C={c} > 512 must be divisible by "
+                f"a 128-multiple block (got C % 128 == {c % 128})")
+    bm = _pick_block_m(mp, bc, cin, c)
     row = lambda v: v.reshape(1, c).astype(jnp.float32)  # noqa: E731
     dx, dw = pl.pallas_call(
         _bwd_kernel,
-        grid=(mp // bm,),
+        grid=(mp // bm, c // bc),
         in_specs=[
-            pl.BlockSpec((bm, c), lambda i: (i, 0)),       # dz
-            pl.BlockSpec((bm, c), lambda i: (i, 0)),       # y
-            pl.BlockSpec((bm, cin), lambda i: (i, 0)),     # x_in
-            pl.BlockSpec((cin, c), lambda i: (0, 0)),      # w
-            pl.BlockSpec((1, c), lambda i: (0, 0)),        # g
-            pl.BlockSpec((1, c), lambda i: (0, 0)),        # mean
-            pl.BlockSpec((1, c), lambda i: (0, 0)),        # inv
-            pl.BlockSpec((1, c), lambda i: (0, 0)),        # A
-            pl.BlockSpec((1, c), lambda i: (0, 0)),        # B
+            pl.BlockSpec((bm, bc), lambda i, j: (i, j)),     # dz
+            pl.BlockSpec((bm, bc), lambda i, j: (i, j)),     # y
+            pl.BlockSpec((bm, cin), lambda i, j: (i, 0)),    # x_in
+            pl.BlockSpec((cin, bc), lambda i, j: (0, j)),    # w
+            pl.BlockSpec((1, bc), lambda i, j: (0, j)),      # g
+            pl.BlockSpec((1, bc), lambda i, j: (0, j)),      # mean
+            pl.BlockSpec((1, bc), lambda i, j: (0, j)),      # inv
+            pl.BlockSpec((1, bc), lambda i, j: (0, j)),      # A
+            pl.BlockSpec((1, bc), lambda i, j: (0, j)),      # B
         ],
         out_specs=[
-            pl.BlockSpec((bm, cin), lambda i: (i, 0)),     # dx
-            pl.BlockSpec((cin, c), lambda i: (0, 0)),      # dw
+            pl.BlockSpec((bm, cin), lambda i, j: (i, 0)),    # dx
+            # constant index: the f32 dW accumulator stays resident in
+            # VMEM across the whole sequential grid, one copy-out at end
+            pl.BlockSpec((cin, c), lambda i, j: (0, 0)),     # dw
         ],
         out_shape=[
             jax.ShapeDtypeStruct((mp, cin), x_in.dtype),
             jax.ShapeDtypeStruct((cin, c), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((cin, c), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bm, cin), jnp.float32)],  # dx accum
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),  # sequential: dW accum
+            dimension_semantics=("arbitrary", "arbitrary")),  # sequential
         interpret=_interpret(),
     )(dz, y, x_in, w, row(g), row(mean), row(inv), row(a_vec), row(b_vec))
     return (dx[:m] if m_pad else dx), dw
@@ -175,43 +227,66 @@ def _bn_sums(dz, y, mean, inv):
     return jnp.sum(dzf, axis=0), jnp.sum(dzf * xhat, axis=0)
 
 
-def _fwd_math(x, w, scale, bias, eps):
+def _axis_size(axis_name) -> int:
+    return 1 if axis_name is None else jax.lax.axis_size(axis_name)
+
+
+def _pmean(v, axis_name):
+    return v if axis_name is None else jax.lax.pmean(v, axis_name)
+
+
+def _fwd_math(x, w, scale, bias, eps, axis_name):
     y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     y = y.astype(x.dtype)
-    mean = jnp.mean(y, axis=0, dtype=jnp.float32)
-    meansq = jnp.mean(jnp.square(y.astype(jnp.float32)), axis=0)
+    # With axis_name: cross-replica (sync) batch stats, the fused analog
+    # of models/resnet.batch_norm's pmean'd stats.
+    mean = _pmean(jnp.mean(y, axis=0, dtype=jnp.float32), axis_name)
+    meansq = _pmean(jnp.mean(jnp.square(y.astype(jnp.float32)), axis=0),
+                    axis_name)
     var = meansq - jnp.square(mean)
     inv = jax.lax.rsqrt(var + eps)
     z = ((y.astype(jnp.float32) - mean) * inv).astype(x.dtype) * scale + bias
     return z, (y, mean, var, inv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def conv1x1_bn(x, w, scale, bias, eps=1e-5):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def conv1x1_bn(x, w, scale, bias, eps=1e-5, axis_name=None):
     """z = batch_norm(x @ w) over flattened rows, train mode — forward in
-    plain XLA, backward through the fused Pallas kernel. Returns
+    plain XLA, backward through the fused Pallas kernel. With
+    `axis_name`, batch stats are synced across that mesh axis (sync-BN
+    semantics matching models/resnet.batch_norm). Returns
     (z, (batch_mean, batch_var)); the aux stats feed running-stat updates
-    exactly like models/resnet.batch_norm does."""
-    z, (y, mean, var, inv) = _fwd_math(x, w, scale, bias, eps)
+    exactly like models/resnet.batch_norm does. Param/input grads are the
+    per-rank partials — the framework's gradient psum completes them,
+    same as the unfused autodiff path."""
+    z, (y, mean, var, inv) = _fwd_math(x, w, scale, bias, eps, axis_name)
     return z, (mean, var)
 
 
-def _conv1x1_bn_fwd(x, w, scale, bias, eps):
-    z, (y, mean, var, inv) = _fwd_math(x, w, scale, bias, eps)
+def _conv1x1_bn_fwd(x, w, scale, bias, eps, axis_name):
+    z, (y, mean, var, inv) = _fwd_math(x, w, scale, bias, eps, axis_name)
     return (z, (mean, var)), (x, w, scale, y, mean, inv)
 
 
-def _conv1x1_bn_bwd(eps, res, cts):
+def _conv1x1_bn_bwd(eps, axis_name, res, cts):
     x, w, scale, y, mean, inv = res
     dz, (dmean, dvar) = cts
     dbeta, dgamma = _bn_sums(dz, y, mean, inv)
-    # dmean/dvar cotangents (zero in normal training — optax treats batch
-    # stats as state — but exact when a loss does use the aux stats) fold
-    # into the kernel's per-channel vectors for free.
+    # Sync-BN backward needs the GLOBAL reductions and row count in the
+    # dy formula; the RETURNED dscale/dbias stay per-rank partials (the
+    # framework's later gradient psum makes them global, exactly like
+    # unfused autodiff). dmean/dvar cotangents (zero in normal training —
+    # optax treats batch stats as state — but exact when a loss does use
+    # the aux stats) fold into the kernel's per-channel vectors for free.
+    k = _axis_size(axis_name)
+    db_g = dbeta if axis_name is None else jax.lax.psum(dbeta, axis_name)
+    dg_g = dgamma if axis_name is None else jax.lax.psum(dgamma, axis_name)
+    dm_g = dmean if axis_name is None else jax.lax.psum(dmean, axis_name)
+    dv_g = dvar if axis_name is None else jax.lax.psum(dvar, axis_name)
     dx, dw = conv1x1_bn_bwd_fused(
         dz, y, x, w, scale.astype(jnp.float32).ravel(), mean, inv,
-        dbeta, dgamma, dmean=dmean, dvar=dvar)
+        db_g, dg_g, dmean=dm_g, dvar=dv_g, count=dz.shape[0] * k)
     return (dx, dw.astype(w.dtype), dgamma.astype(scale.dtype),
             dbeta.astype(scale.dtype))
 
@@ -219,10 +294,11 @@ def _conv1x1_bn_bwd(eps, res, cts):
 conv1x1_bn.defvjp(_conv1x1_bn_fwd, _conv1x1_bn_bwd)
 
 
-def conv1x1_bn_nhwc(x, w, scale, bias, eps=1e-5):
+def conv1x1_bn_nhwc(x, w, scale, bias, eps=1e-5, axis_name=None):
     """NHWC convenience wrapper: x (N, H, W, Cin), w (1, 1, Cin, Cout) or
     (Cin, Cout). Returns (z in NHWC, (mean, var))."""
     n, h, wd, cin = x.shape
     w2 = w.reshape(w.shape[-2], w.shape[-1])
-    z, stats = conv1x1_bn(x.reshape(n * h * wd, cin), w2, scale, bias, eps)
+    z, stats = conv1x1_bn(x.reshape(n * h * wd, cin), w2, scale, bias,
+                          eps, axis_name)
     return z.reshape(n, h, wd, -1), stats

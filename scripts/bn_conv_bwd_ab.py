@@ -3,12 +3,18 @@
 Measures, per ResNet-50 layer site (B=128 shapes), the backward-path cost
 the fusion targets:
 
-  XLA:    dy = bn_bwd_elemwise(dz, y, sums)  [materialized]
+  XLA:    dy = bn_bwd_elemwise(dz, y, sums)  [materialized in HBM]
           dx = dy @ w.T ; dw = x^T @ dy
-  fused:  conv_bn_backward.conv1x1_bn_bwd_fused (dy never in HBM)
+  fused:  conv_bn_backward.conv1x1_bn_bwd_fused (dy never leaves VMEM)
 
 Pass A (the dbeta/dgamma reductions) is identical in both and excluded.
-Slope timing over pipelined calls cancels the tunnel's fixed round trip
+
+Timing: chain=8 iterations inside one compiled lax.scan, with a
+dependency injected through the scale vector (scale + 1e-30*prev_out) so
+iterations cannot overlap or be elided — naive repeated calls with
+constant inputs measured FASTER than the HBM roofline allows (r05 first
+attempt: 0.18 ms for 0.33 GB = 1.8 TB/s, impossible), so those numbers
+were artifacts. Slope over scan calls cancels the tunnel round trip
 (docs/benchmarks.md).
 """
 
@@ -16,7 +22,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 from horovod_tpu.ops.conv_bn_backward import conv1x1_bn_bwd_fused
@@ -31,31 +36,9 @@ SITES = [
     ("s2.conv1 14x14 1024->256", 128 * 14 * 14, 1024, 256),
     ("s3.conv3 7x7 512->2048", 128 * 7 * 7, 512, 2048),
 ]
-
-
-def _slope_ms(fn, args, k=6, reps=3):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    float(jnp.sum(out[0].ravel()[:2].astype(jnp.float32)))
-
-    def run(n):
-        t0 = time.perf_counter()
-        o = None
-        for _ in range(n):
-            o = fn(*args)
-        jax.block_until_ready(o)
-        float(jnp.sum(o[0].ravel()[:2].astype(jnp.float32)))
-        return time.perf_counter() - t0
-
-    run(2)
-    best, fb = float("inf"), float("inf")
-    for _ in range(reps):
-        tk, t2k = run(k), run(2 * k)
-        s = (t2k - tk) / k
-        if s > 0:
-            best = min(best, s)
-        fb = min(fb, t2k / (2 * k))
-    return (best if best != float("inf") else fb) * 1e3
+CHAIN = 64  # long chains: 8-iter chains left per-call compute (~4 ms)
+# inside tunnel jitter (~±100 ms) and slopes came out physically
+# impossible; 64 iters x ~0.5-2 ms is unambiguous signal
 
 
 def xla_seq(dz, y, x, w, scale, mean, inv, db, dg):
@@ -70,27 +53,79 @@ def xla_seq(dz, y, x, w, scale, mean, inv, db, dg):
     return dx, dw
 
 
+def _chain_ms(fn, args):
+    """ms per call of fn(*args) with a scan-chained dependency: each
+    iteration's scale is perturbed by the previous dw, forcing strict
+    sequential execution on device."""
+    scale = args[4]
+
+    @jax.jit
+    def prog(s0, dz, y, x, w, mean, inv, db, dg):
+        # big operands are jit ARGUMENTS: closure-captured arrays embed
+        # as literals in the compile request (200 MB -> HTTP 413 through
+        # the remote-compile tunnel)
+        def body(carry, _):
+            s, prev = carry
+            dx, dw = fn(dz, y, x, w, s, mean, inv, db, dg)
+            # optimization_barrier: without it XLA slices the whole
+            # computation to the one column the scalar dep reads (r05
+            # first attempts measured 76 TB/s — dead-code elimination,
+            # not speed). The barrier forces FULL dx/dw materialization
+            # with zero extra memory traffic in both arms.
+            dxb, dwb = jax.lax.optimization_barrier((dx, dw))
+            dep = ((dxb[0, 0].astype(jnp.float32) + dwb[0, 0])
+                   * 1e-30).astype(s0.dtype)
+            return (s0 + dep, dep), ()
+
+        return lax.scan(body, (s0, jnp.zeros((), s0.dtype)), None,
+                        length=CHAIN)[0][1]
+
+    def sync(o):
+        jax.block_until_ready(o)
+        float(o)
+
+    pargs = (args[4], args[0], args[1], args[2], args[3], args[5],
+             args[6], args[7], args[8])
+
+    def run(n):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = prog(*pargs)
+        sync(o)
+        return time.perf_counter() - t0
+
+    sync(prog(*pargs))
+    run(1)
+    best, fb = float("inf"), float("inf")
+    for _ in range(3):
+        t1, t3 = run(1), run(3)
+        s = (t3 - t1) / (2 * CHAIN)
+        if s > 0:
+            best = min(best, s)
+        fb = min(fb, t3 / (3 * CHAIN))
+    return (best if best != float("inf") else fb) * 1e3
+
+
 def main():
     print(f"device: {jax.devices()[0].device_kind}")
     total_xla, total_fused = 0.0, 0.0
     for name, m, cin, c in SITES:
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
-        dz = jax.random.normal(ks[0], (m, c), jnp.bfloat16)
-        y = jax.random.normal(ks[1], (m, c), jnp.bfloat16)
-        x = jax.random.normal(ks[2], (m, cin), jnp.bfloat16)
-        w = jax.random.normal(ks[0], (cin, c), jnp.bfloat16) * 0.05
-        scale = jnp.ones((c,), jnp.float32)
-        mean = jnp.zeros((c,), jnp.float32)
-        inv = jnp.ones((c,), jnp.float32)
-        db = jnp.zeros((c,), jnp.float32)
-        dg = jnp.zeros((c,), jnp.float32)
-        args = (dz, y, x, w, scale, mean, inv, db, dg)
-
-        t_xla = _slope_ms(jax.jit(xla_seq), args)
-        t_fused = _slope_ms(jax.jit(conv1x1_bn_bwd_fused), args)
-        gb = (3 * m * c * 2 + 2 * m * cin * 2) / 2**30  # streams: see module doc
-        print(f"{name:28s} XLA {t_xla:7.2f} ms   fused {t_fused:7.2f} ms  "
-              f"({t_xla / t_fused:4.2f}x)  [~{gb:.2f} GB moved unfused]")
+        args = (jax.random.normal(ks[0], (m, c), jnp.bfloat16),
+                jax.random.normal(ks[1], (m, c), jnp.bfloat16),
+                jax.random.normal(ks[2], (m, cin), jnp.bfloat16),
+                jax.random.normal(ks[0], (cin, c), jnp.bfloat16) * 0.05,
+                jnp.ones((c,), jnp.float32), jnp.zeros((c,), jnp.float32),
+                jnp.ones((c,), jnp.float32), jnp.zeros((c,), jnp.float32),
+                jnp.zeros((c,), jnp.float32))
+        t_xla = _chain_ms(xla_seq, args)
+        t_fused = _chain_ms(conv1x1_bn_bwd_fused, args)
+        gb_unfused = (5 * m * c * 2 + 2 * m * cin * 2) / 2**30
+        gb_fused = (2 * m * c * 2 + 2 * m * cin * 2) / 2**30
+        print(f"{name:28s} XLA {t_xla:7.2f} ms ({gb_unfused / t_xla * 1e3:5.0f} GB/s)"
+              f"   fused {t_fused:7.2f} ms ({gb_fused / t_fused * 1e3:5.0f} GB/s)"
+              f"   {t_xla / t_fused:4.2f}x")
         total_xla += t_xla
         total_fused += t_fused
     print(f"{'TOTAL (sites above)':28s} XLA {total_xla:7.2f} ms   "

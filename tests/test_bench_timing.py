@@ -38,13 +38,16 @@ def test_scan_timed_positive_and_sane():
     assert 0 < sec < 1.0  # a 64x64 matmul step is micro/milliseconds
 
 
-def test_eager_marginal_positive():
-    a = jnp.ones((32, 32), jnp.float32)
-    f = jax.jit(lambda x: x @ x)
-    f(a)  # compile outside
+def test_eager_sizes_are_threshold_sensitive():
+    """The CPU-mesh fusion sweep (bench.py --eager-cpu-mesh) only proves
+    anything if its gradient set actually buckets differently across the
+    swept thresholds — pin that property."""
+    from horovod_tpu.ops.fusion import plan_buckets
 
-    ms = bench._eager_marginal(lambda: f(a), k=4, reps=2)
-    assert 0 < ms < 1000
+    metas = [(s, "float32") for s in bench._EAGER_SIZES]
+    counts = [len(plan_buckets(metas, mb * 1024 * 1024))
+              for mb in (1, 4, 16, 64)]
+    assert counts[0] > counts[1] > counts[2] >= counts[3] >= 1, counts
 
 
 def test_device_health_returns_contract_keys():

@@ -105,3 +105,92 @@ def test_bf16_path():
     gf = jax.grad(loss_f(conv1x1_bn), argnums=(0, 1))(x, w, scale, bias)
     for a, b in zip(gr, gf):
         _close(a.astype(jnp.float32), b.astype(jnp.float32), 2e-2)
+
+
+def test_resnet_fused_path_matches_unfused(monkeypatch):
+    """The model-level wire-up (models/resnet.py _fused_conv_bn_site):
+    loss, gradients, and running-stat updates are identical with the
+    fused backward on and off. Mini 2-block depth keeps interpret-mode
+    runtime testable."""
+    from horovod_tpu.models import resnet
+
+    resnet.STAGE_BLOCKS[8] = (1, 1)  # test-only mini depth
+    try:
+        params, stats = resnet.init(jax.random.PRNGKey(0), depth=8,
+                                    num_classes=10, dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3),
+                              jnp.float32)
+        yl = jax.random.randint(jax.random.PRNGKey(2), (2,), 0, 10)
+
+        def run(fuse):
+            monkeypatch.setenv("HOROVOD_FUSE_CONV_BN",
+                               "1" if fuse else "0")
+
+            def loss(p):
+                return resnet.loss_fn(p, stats, (x, yl), depth=8,
+                                      train=True)
+            (l, ns), g = jax.value_and_grad(loss, has_aux=True)(params)
+            return l, ns, g
+
+        l0, ns0, g0 = run(False)
+        l1, ns1, g1 = run(True)
+        assert abs(float(l0) - float(l1)) < 1e-5
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            _close(a, b, 1e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(ns0),
+                        jax.tree_util.tree_leaves(ns1)):
+            _close(a, b, 1e-4)
+    finally:
+        resnet.STAGE_BLOCKS.pop(8, None)
+
+
+def test_sync_bn_semantics_across_mesh():
+    """Under shard_map with axis_name, the fused op computes GLOBAL batch
+    stats and gradients whose psum equals the single-device oracle —
+    sync-BN semantics (models/resnet.batch_norm contract)."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("hvd",))
+    m, cin, c = 64, 8, 16
+    x, w, scale, bias = _mk(m, cin, c, seed=7)
+
+    def local(x_loc, w, scale, bias):
+        def loss(x_loc, w, scale, bias):
+            z, (mean, var) = conv1x1_bn(x_loc, w, scale, bias, 1e-5,
+                                        "hvd")
+            return jnp.sum(jnp.sin(z)), (mean, var)
+        (l, st), g = jax.value_and_grad(
+            loss, argnums=(0, 1, 2, 3), has_aux=True)(x_loc, w, scale,
+                                                      bias)
+        # param grads are per-rank partials; psum completes them (the
+        # framework's gradient reduction role)
+        gw = jax.lax.psum(g[1], "hvd")
+        gs = jax.lax.psum(g[2], "hvd")
+        gb = jax.lax.psum(g[3], "hvd")
+        return jax.lax.psum(l, "hvd"), st, g[0], gw, gs, gb
+
+    sharded = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P("hvd"), P(), P(), P()),
+        out_specs=(P(), P(), P("hvd"), P(), P(), P()),
+        check_vma=False))
+    l_sh, (mean_sh, var_sh), gx_sh, gw_sh, gs_sh, gb_sh = sharded(
+        x, w, scale, bias)
+
+    # single-device oracle: the same loss over the FULL batch
+    def oracle_loss(x, w, scale, bias):
+        z, st = _ref(x, w, scale, bias)
+        return jnp.sum(jnp.sin(z)), st
+    (l_o, (mean_o, var_o)), g_o = jax.value_and_grad(
+        oracle_loss, argnums=(0, 1, 2, 3), has_aux=True)(x, w, scale,
+                                                         bias)
+    assert abs(float(l_sh) - float(l_o)) < 1e-4
+    _close(mean_o, mean_sh, 1e-5)
+    _close(var_o, var_sh, 1e-5)
+    _close(g_o[0], gx_sh, 1e-4)
+    _close(g_o[1], gw_sh, 1e-4)
+    _close(g_o[2], gs_sh, 1e-4)
+    _close(g_o[3], gb_sh, 1e-4)
