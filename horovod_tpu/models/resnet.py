@@ -145,7 +145,8 @@ _reduce_window = lax.reduce_window
 def _fuse_conv_bn() -> bool:
     """Fused 1x1-conv+BN backward (ops/conv_bn_backward.py): the dy
     tensor between BN backward and the conv backward never touches HBM.
-    Wins 1.2-1.9x at the layer level but LOSES end-to-end (80.9 vs
+    Wins 1.5-1.9x at the dominant conv3 sites (parity at conv1) but
+    LOSES end-to-end (80.9 vs
     45.2 ms/step measured r05): the custom_vjp boundary de-fuses relu/
     mask/stat-reduce passes XLA otherwise folds into neighbors, and
     forces {3,0,2,1}<->{3,2,1,0} layout copies against the 3x3 convs'

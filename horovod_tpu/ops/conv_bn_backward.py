@@ -175,7 +175,7 @@ def conv1x1_bn_bwd_fused(dz: jax.Array, y: jax.Array, x_in: jax.Array,
     if c <= 512:
         bc = c
     else:  # largest dividing block <= 512, lane-aligned (c % 128 == 0
-        # holds for all model channel counts; 768 -> bc=256, 2048 -> 512)
+        # holds for all model channel counts; 768 -> bc=384, 2048 -> 512)
         bc = next((b for b in (512, 384, 256, 128) if c % b == 0), None)
         if bc is None:
             raise ValueError(

@@ -9,7 +9,7 @@ the fusion targets:
 
 Pass A (the dbeta/dgamma reductions) is identical in both and excluded.
 
-Timing: chain=8 iterations inside one compiled lax.scan, with a
+Timing: CHAIN iterations inside one compiled lax.scan, with a
 dependency injected through the scale vector (scale + 1e-30*prev_out) so
 iterations cannot overlap or be elided — naive repeated calls with
 constant inputs measured FASTER than the HBM roofline allows (r05 first
