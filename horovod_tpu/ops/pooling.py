@@ -1,0 +1,135 @@
+"""Max pooling with a fast backward (select-and-scatter replacement).
+
+XLA lowers the gradient of `lax.reduce_window(max)` to SelectAndScatter,
+which is notoriously slow on TPU — measured ~24% of the Inception V3
+train step (4 stride-2 3x3 pools; docs/benchmarks.md r05). This module
+keeps the FORWARD as the stock reduce_window (fast) and replaces only
+the backward with the standard one-hot formulation, expressed entirely
+in elementwise ops + static slices + interior-padded adds that XLA
+fuses freely:
+
+    for window offset k (iteration order):
+        m_k      = (x_shifted_k == y)            # max attained here?
+        chosen_k = m_k and not (m_0 or ... or m_{k-1})   # FIRST max
+        dx      += scatter_k(chosen_k * dy)      # interior-padded add
+
+The first-match tie-break replicates SelectAndScatter's GE-select
+semantics exactly, so gradients are bit-comparable to the stock VJP
+(tie cases pinned in tests/test_pooling.py).
+
+MEASURED OUTCOME (r05, v5e, scripts/maxpool_bwd_ab.py): the one-hot
+backward is 7-20x SLOWER than SelectAndScatter at every real pool site
+(68 vs 3.6 ms at Inception's 147x147x64 stem pool). The formulation is
+fusion-friendly HLO, but its building blocks — stride-2 `lax.slice`
+reads and interior-padded writes — are pathological for the TPU's
+(8, 128) tiled layouts (every strided row access breaks sublane tiles),
+and 9 window offsets multiply that cost. SelectAndScatter is slow; this
+is slower. The op therefore ships UNWIRED — models keep the stock
+reduce_window VJP — and stands as the measured record that the
+"obvious" XLA-level replacement loses (an input-centric Pallas kernel
+could theoretically hit ~2.5 streams, but the conv+BN experience —
+ops/conv_bn_backward.py — shows the boundary/layout costs of an opaque
+kernel in this position, and the remaining upside is a few ms/step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _out_dim(size: int, win: int, stride: int, pad_lo: int,
+             pad_hi: int) -> int:
+    return (size + pad_lo + pad_hi - win) // stride + 1
+
+
+def _resolve_padding(padding, h, w, wh, ww, sh, sw):
+    """'VALID'/'SAME' or explicit ((lo,hi),(lo,hi)) for the two spatial
+    dims."""
+    if isinstance(padding, str):
+        if padding.upper() == "VALID":
+            return (0, 0), (0, 0)
+        if padding.upper() == "SAME":
+            def same(size, win, stride):
+                out = -(-size // stride)
+                total = max((out - 1) * stride + win - size, 0)
+                return total // 2, total - total // 2
+            return same(h, wh, sh), same(w, ww, sw)
+        raise ValueError(f"padding {padding!r}")
+    (ph, pw) = padding
+    return tuple(ph), tuple(pw)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def max_pool(x: jax.Array, window: Sequence[int] = (3, 3),
+             strides: Sequence[int] = (2, 2),
+             padding="VALID") -> jax.Array:
+    """NHWC max pool over the two spatial dims; forward is the stock
+    reduce_window, backward the fast one-hot path."""
+    return _fwd_pool(x, window, strides, padding)
+
+
+def _fwd_pool(x, window, strides, padding):
+    wh, ww = window
+    sh, sw = strides
+    ph, pw = _resolve_padding(padding, x.shape[1], x.shape[2],
+                              wh, ww, sh, sw)
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min,
+        lax.max, (1, wh, ww, 1), (1, sh, sw, 1),
+        ((0, 0), ph, pw, (0, 0)))
+
+
+def _max_pool_fwd(x, window, strides, padding):
+    y = _fwd_pool(x, window, strides, padding)
+    return y, (x, y)
+
+
+def _max_pool_bwd(window, strides, padding, res, dy):
+    x, y = res
+    wh, ww = window
+    sh, sw = strides
+    n, h, w, c = x.shape
+    ph, pw = _resolve_padding(padding, h, w, wh, ww, sh, sw)
+    oh, ow = y.shape[1], y.shape[2]
+    # Work on the padded input so every window is full; slices below are
+    # all static. Padding value never equals a real max (-inf).
+    pad_val = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+               else jnp.iinfo(x.dtype).min)
+    hp = h + ph[0] + ph[1]
+    wp = w + pw[0] + pw[1]
+    xp = jnp.pad(x, ((0, 0), ph, pw, (0, 0)), constant_values=pad_val)
+    dxp = jnp.zeros((n, hp, wp, c), dy.dtype)
+    taken = None
+    dyf = dy
+    for a in range(wh):
+        for b in range(ww):
+            # window-offset (a, b) element of every window: shape (oh, ow)
+            xs = lax.slice(
+                xp, (0, a, b, 0),
+                (n, a + (oh - 1) * sh + 1, b + (ow - 1) * sw + 1, c),
+                (1, sh, sw, 1))
+            m = xs == y
+            chosen = m if taken is None else jnp.logical_and(
+                m, jnp.logical_not(taken))
+            taken = m if taken is None else jnp.logical_or(taken, m)
+            contrib = jnp.where(chosen, dyf, jnp.zeros((), dy.dtype))
+            # scatter to input positions (a + sh*i, b + sw*j): interior
+            # padding re-dilates the output grid onto the input grid
+            dxp = dxp + lax.pad(
+                contrib, jnp.zeros((), dy.dtype),
+                ((0, 0, 0),
+                 (a, hp - a - ((oh - 1) * sh + 1), sh - 1),
+                 (b, wp - b - ((ow - 1) * sw + 1), sw - 1),
+                 (0, 0, 0)))
+    dx = lax.slice(dxp, (0, ph[0], pw[0], 0),
+                   (n, ph[0] + h, pw[0] + w, c))
+    return (dx.astype(x.dtype),)
+
+
+max_pool.defvjp(_max_pool_fwd, _max_pool_bwd)

@@ -27,15 +27,25 @@ import tempfile
 from typing import Callable, Dict, List, Optional, Tuple
 
 _DEFAULT_BUCKETS: List[Tuple[str, str]] = [
-    # (regex on op name, category) — first match wins
+    # (regex on op name, category) — first match wins. The xplane gives
+    # only HLO op NAMES, and XLA names fusions after their root/producer
+    # ops, so this is a heuristic: an UNANCHORED copy|bitcast pattern
+    # once swallowed compute fusions like dynamic-slice_bitcast_fusion
+    # and mislabeled half an Inception step "layout/copy" (r05). Copies
+    # are matched only by anchored prefix; anything *_fusion with a
+    # layout-ish name falls through to the compute buckets. Category
+    # totals are indicative — the per-op table is the ground truth.
     (r"select.and.scatter|select_and_scatter", "maxpool backward"),
     (r"reduce.window|reduce_window", "pool forward"),
     (r"all.reduce|all.gather|reduce.scatter|all.to.all|collective",
      "collective"),
-    (r"conv", "convolution"),
+    (r"jvp|conv1x1_bn|flash|pallas", "pallas kernel"),
+    # before the conv bucket: r"conv" substring-matches "convert_*"
+    (r"multiply_reduce|reduce_fusion|convert_reduce",
+     "reduce fusion (stats/grads)"),
+    (r"conv(?!ert)|^%?custom.call", "convolution/custom-call"),
     (r"dot|matmul", "matmul"),
-    (r"multiply_reduce|reduce_fusion", "reduce fusion (stats/grads)"),
-    (r"copy|transpose|bitcast", "layout/copy"),
+    (r"^%?(copy|bitcast|transpose)\b", "layout/copy"),
     (r"fusion", "fused elementwise/compute"),
 ]
 

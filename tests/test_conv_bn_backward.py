@@ -6,6 +6,8 @@ Gradients are checked against jax.grad of the identical forward math —
 the ground truth XLA would compute unfused.
 """
 
+import re
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -194,3 +196,50 @@ def test_sync_bn_semantics_across_mesh():
     _close(g_o[1], gw_sh, 1e-4)
     _close(g_o[2], gs_sh, 1e-4)
     _close(g_o[3], gb_sh, 1e-4)
+
+
+def test_kernel_lowers_through_real_tpu_compiler(monkeypatch):
+    """Pin the opt-in path's Mosaic lowering: the fused backward compiles
+    for a real v5e topology (compile-only client, zero chips) at a
+    representative site AND at the VMEM-tightest site that OOM'd during
+    development (Cin=512, C=2048 — the resident f32 dW accumulator).
+    Skips where the TPU compile-only client is unavailable."""
+    # conftest pins the CPU backend, which flips the kernel to interpret
+    # mode — force the real Mosaic lowering for this TPU-target compile
+    from horovod_tpu.ops import conv_bn_backward as cbb
+    monkeypatch.setattr(cbb, "_interpret", lambda: False)
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v5e:2x2")
+    except Exception as e:  # pragma: no cover - CI without libtpu
+        pytest.skip(f"TPU compile-only client unavailable: {e}")
+    from horovod_tpu.ops.conv_bn_backward import conv1x1_bn_bwd_fused
+
+    dev = topo.devices[0]
+    sh = jax.sharding.SingleDeviceSharding(dev)
+    for m, cin, c in ((128 * 28 * 28, 128, 512), (6272, 512, 2048)):
+        def st(shape, dt=jnp.bfloat16):
+            return jax.ShapeDtypeStruct(shape, dt, sharding=sh)
+        vec = lambda: st((c,), jnp.float32)  # noqa: E731
+        try:
+            txt = jax.jit(conv1x1_bn_bwd_fused).lower(
+                st((m, c)), st((m, c)), st((m, cin)), st((cin, c)),
+                vec(), vec(), vec(), vec(), vec()).compile().as_text()
+        except Exception as e:
+            if "failed to legalize" in str(e):
+                # this image's LOCAL libtpu (compile-only client) lags
+                # the terminal's Mosaic pipeline and can't legalize the
+                # kernel's MLIR at all; the kernel compiles and runs
+                # through the real device path (scripts/bn_conv_bwd_ab).
+                # ONLY this toolchain-mismatch error skips — VMEM OOM or
+                # other real lowering failures must still fail the test.
+                pytest.skip(f"local Mosaic pipeline mismatch: "
+                            f"{str(e).splitlines()[0][:120]}")
+            raise
+        # the pallas kernel survives to the scheduled module as a
+        # custom-call named after the op (Mosaic lowering succeeded —
+        # VMEM budgets, dynamic column stores, and accumulators all
+        # passed the real TPU compiler)
+        assert re.search(r"conv1x1_bn_bwd_fused\S* = .* custom-call\(",
+                         txt), (m, cin, c)

@@ -50,7 +50,7 @@ def test_aggregate_per_op_and_category():
     assert prof.per_op["%select_and_scatter.9"] == pytest.approx(0.5)
     assert prof.per_op["%copy-done.5"] == pytest.approx(0.2)
     assert prof.total_ms == pytest.approx(2.7)
-    assert prof.per_category["convolution"] == pytest.approx(2.0)
+    assert prof.per_category["convolution/custom-call"] == pytest.approx(2.0)
     assert prof.per_category["maxpool backward"] == pytest.approx(0.5)
     assert prof.per_category["layout/copy"] == pytest.approx(0.2)
     # host plane excluded
@@ -60,7 +60,7 @@ def test_aggregate_per_op_and_category():
 def test_markdown_and_top_ops():
     prof = aggregate_xspace(_make_xspace(), reps=3)
     md = prof.as_markdown(top=2)
-    assert "| convolution | 2.00 |" in md
+    assert "| convolution/custom-call | 2.00 |" in md
     assert md.count("| `%") == 2  # top=2 individual rows
     assert prof.top_ops(1)[0][0] == "%convolution_fusion.1"
 
@@ -70,3 +70,17 @@ def test_classify_buckets():
         "reduce fusion (stats/grads)"
     assert classify("%all-reduce.1") == "collective"
     assert classify("%weird_thing") == "other"
+    # fusions NAMED after layout ops are compute, not copies (the
+    # unanchored pattern mislabeled half an Inception step in r05)
+    assert classify("%dynamic-slice_bitcast_fusion") == \
+        "fused elementwise/compute"
+    assert classify("%broadcast_maximum_fusion.2") == \
+        "fused elementwise/compute"
+    assert classify("%copy.563") == "layout/copy"
+    assert classify("%copy-done.5") == "layout/copy"
+    assert classify("%bitcast.601") == "layout/copy"
+    assert classify("%transpose.12") == "layout/copy"
+    # pallas custom-vjp kernels carry jvp/op names
+    assert classify("%transpose_jvp___.48") == "pallas kernel"
+    assert classify("%conv1x1_bn_bwd_fused.1") == "pallas kernel"
+    assert classify("%custom-call.62") == "convolution/custom-call"

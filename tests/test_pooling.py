@@ -1,0 +1,77 @@
+"""ops/pooling.max_pool vs the stock reduce_window autodiff.
+
+The one-hot backward must be EXACT against XLA's SelectAndScatter
+semantics — including first-match tie-breaking, which quantized inputs
+force constantly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.pooling import max_pool
+
+
+def _ref_pool(x, window, strides, padding):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, *window, 1), (1, *strides, 1),
+        padding if isinstance(padding, str)
+        else ((0, 0), *padding, (0, 0)))
+
+
+CASES = [
+    ((2, 15, 15, 4), (3, 3), (2, 2), "VALID"),
+    ((2, 16, 16, 4), (3, 3), (2, 2), "SAME"),
+    ((1, 8, 8, 3), (2, 2), (2, 2), "VALID"),
+    ((2, 9, 9, 2), (3, 3), (1, 1), "SAME"),
+    ((1, 10, 12, 2), (3, 2), (2, 3), "VALID"),
+]
+
+
+@pytest.mark.parametrize("shape,window,strides,padding", CASES)
+def test_forward_matches(shape, window, strides, padding):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+    np.testing.assert_allclose(
+        max_pool(x, window, strides, padding),
+        _ref_pool(x, window, strides, padding), rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("shape,window,strides,padding", CASES)
+@pytest.mark.parametrize("quantize", [False, True])
+def test_backward_matches_select_and_scatter(shape, window, strides,
+                                             padding, quantize):
+    x = jax.random.normal(jax.random.PRNGKey(1), shape, jnp.float32)
+    if quantize:  # force constant ties: first-match semantics must agree
+        x = jnp.round(x * 2) / 2
+    key = jax.random.PRNGKey(2)
+
+    def loss_fast(x):
+        y = max_pool(x, window, strides, padding)
+        return jnp.sum(y * jax.random.normal(key, y.shape))
+
+    def loss_ref(x):
+        y = _ref_pool(x, window, strides, padding)
+        return jnp.sum(y * jax.random.normal(key, y.shape))
+
+    g_fast = jax.grad(loss_fast)(x)
+    g_ref = jax.grad(loss_ref)(x)
+    # same positions chosen, same contributions; only the float ADD
+    # ORDER differs where overlapping windows feed one input position
+    np.testing.assert_allclose(g_fast, g_ref, rtol=0, atol=1e-6)
+
+
+def test_bf16_and_jit():
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 16, 8),
+                          jnp.bfloat16)
+
+    @jax.jit
+    def g(x):
+        return jax.grad(lambda x: jnp.sum(
+            max_pool(x).astype(jnp.float32)))(x)
+
+    g_ref = jax.grad(lambda x: jnp.sum(
+        _ref_pool(x, (3, 3), (2, 2), "VALID").astype(jnp.float32)))(x)
+    np.testing.assert_allclose(np.asarray(g(x), np.float32),
+                               np.asarray(g_ref, np.float32),
+                               rtol=0, atol=0)
