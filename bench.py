@@ -184,7 +184,10 @@ def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
     # CPU smoke: >=75px or reduction_b collapses spatial dims to 0x0
     # (global mean over zero elements = NaN)
     img = 80 if on_cpu else 299
-    b = 2 if on_cpu else 64
+    # B=128 is the measured v5e sweet spot: +42% over B=64 (r05 sweep
+    # 32/64/96/128/192/256/384 -> 1460/1477/1557/2091/1495/2005/1951
+    # img/s; docs/benchmarks.md)
+    b = 2 if on_cpu else 128
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
     batch = b * k
     params, stats = inception.init(jax.random.PRNGKey(0), dtype=dtype)
@@ -298,7 +301,9 @@ def bench_vgg16(mesh, k, steps=12, warmup=2):
     coverage via examples/synthetic_benchmark.py in test_examples)."""
     from horovod_tpu.models import vgg
 
-    img, b, dtype = 224, 64, jnp.bfloat16
+    # B=128: +23% over B=64 on v5e (r05 sweep 32/64/96/128/192/256 ->
+    # 1092/1202/1302/1481/1340/1487 img/s; plateau from 128)
+    img, b, dtype = 224, 128, jnp.bfloat16
     batch = b * k
     params = vgg.init(jax.random.PRNGKey(0), depth=16, dtype=dtype,
                       image_size=img)
