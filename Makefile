@@ -6,15 +6,18 @@
 #
 #   make test        everything (what CI runs)
 #   make test-fast   tier 1 only, minus the slow e2e suites
+#   make chaos       fault-injection suite: elastic jobs under injected
+#                    rendezvous outages / worker kills / flapping hosts
+#                    (tests marked `faults`; see docs/resilience.md)
 #   make native      build the native control-plane library
 #   make bench       one-line JSON benchmark (real accelerator if present)
 
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e entry native bench lint
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint
 
-test: test-unit test-multiprocess test-e2e entry
+test: test-unit test-multiprocess test-e2e chaos entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -29,6 +32,11 @@ test-multiprocess:
 
 test-e2e:
 	$(PYTEST) tests/test_elastic_e2e.py
+
+# Only the `faults`-marked e2e jobs: the fast resilience/fault unit tests
+# already run in test-unit, so `make test` doesn't run them twice.
+chaos:
+	$(PYTEST) tests/test_faults.py --run-faults -m faults
 
 entry:
 	$(PYTHON) __graft_entry__.py

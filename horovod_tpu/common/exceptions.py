@@ -49,3 +49,32 @@ class DuplicateNameError(HorovodTpuError):
 
 class StalledTensorError(HorovodTpuError):
     """Stall inspector forced shutdown (reference stall_inspector.cc)."""
+
+
+class RetryError(HorovodTpuError):
+    """A RetryPolicy exhausted its attempts or overall deadline.
+
+    `__cause__` carries the last underlying failure
+    (common/resilience.py).
+    """
+
+
+class CircuitOpenError(HorovodTpuError):
+    """A CircuitBreaker rejected the call without attempting it
+    (common/resilience.py)."""
+
+
+class ResetLimitExceededError(HorovodTpuError):
+    """The elastic driver hit --reset-limit: too many topology resets.
+
+    Reference: launch.py --reset-limit / driver reset accounting. Typed so
+    orchestrators can distinguish "job churned itself to death" from other
+    driver failures instead of matching a bare HorovodTpuError.
+    """
+
+
+class FaultInjectedError(HorovodTpuError):
+    """An error produced by the deterministic fault-injection harness
+    (horovod_tpu/testing/faults.py) for kinds with no natural exception
+    type (e.g. a discovery flap). Never raised in production paths —
+    the injector is inert unless HOROVOD_FAULT_SPEC is set."""

@@ -94,6 +94,21 @@ def _maybe_distributed_init(cfg: Config) -> None:
     jax.distributed (the TPU-native control plane over DCN).
     """
     if cfg.size is None or cfg.size <= 1:
+        # An earlier multi-process round set the gloo CPU collectives; a
+        # single-process re-init (elastic scale-down to 1) has no
+        # distributed client, and old jaxlib refuses to build a CPU
+        # backend with gloo + a None client. Reset to the default.
+        # (compat accessors: on jax 0.4.x the flag is invisible to
+        # jax.config attribute reads, only its xla_bridge holder works.)
+        from horovod_tpu.common.compat import (
+            cpu_collectives_implementation,
+            set_cpu_collectives_implementation)
+        try:
+            if (cpu_collectives_implementation() == "gloo"
+                    and jax._src.distributed.global_state.client is None):
+                set_cpu_collectives_implementation("none")
+        except Exception:
+            pass
         return
     try:
         already = jax._src.distributed.global_state.client is not None
@@ -126,10 +141,9 @@ def _maybe_distributed_init(cfg: Config) -> None:
                     "timed out waiting for rank 0 to publish the "
                     "jax.distributed coordinator address")
             coord = data.decode()
-    try:  # cross-process CPU collectives need the gloo impl; harmless
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    except Exception:
-        pass
+    # Cross-process CPU collectives need the gloo impl (no-op flagless).
+    from horovod_tpu.common.compat import set_cpu_collectives_implementation
+    set_cpu_collectives_implementation("gloo")
     if cfg.elastic:
         _elastic_distributed_init(coord, cfg)
     else:
@@ -169,20 +183,30 @@ def _elastic_distributed_init(coord: str, cfg: Config) -> None:
     _dist = _jaxlib = None
     try:
         from jax._src import distributed as _dist
-        from jax._src.lib import _jax as _jaxlib
+
+        from horovod_tpu.common.compat import jaxlib_extension
+        _jaxlib = jaxlib_extension()
     except ImportError:
         pass
     factory = getattr(_jaxlib, "get_distributed_runtime_client", None)
     state = getattr(_dist, "global_state", None)
     rank = cfg.rank or 0
+    from horovod_tpu.common.hvd_logging import get_logger
     if factory is not None and state is not None:
         hb = int(os.environ.get("HOROVOD_ELASTIC_HEARTBEAT_SECONDS", "10"))
         sd = int(os.environ.get("HOROVOD_ELASTIC_SHUTDOWN_SECONDS", "10"))
         try:
-            client = factory(
+            from horovod_tpu.common.compat import make_distributed_client
+            client, recoverable = make_distributed_client(
                 coord, rank, init_timeout=300, heartbeat_timeout=hb,
-                shutdown_timeout=sd, use_compression=True,
-                recoverable=True, shutdown_on_destruction=False)
+                shutdown_timeout=sd)
+            if not recoverable:
+                get_logger().warning(
+                    "recoverable jax.distributed client unavailable in "
+                    "this jaxlib; elastic uses a standard client — each "
+                    "round still gets a fresh coordinator, but a peer "
+                    "failure may require a full backend re-init instead "
+                    "of an in-place reconnect")
             client.connect()
             state.num_processes = cfg.size
             state.process_id = rank
@@ -191,12 +215,12 @@ def _elastic_distributed_init(coord: str, cfg: Config) -> None:
             return
         except TypeError:
             pass  # jaxlib changed the factory signature — fall back
-    from horovod_tpu.common.hvd_logging import get_logger
     get_logger().warning(
-        "recoverable jax.distributed client unavailable in this jaxlib "
-        "(private API moved); elastic falls back to a standard client — "
-        "peer failure recovery degrades from in-process reset to full "
-        "worker restart")
+        "jax distributed-runtime client unavailable in this jaxlib "
+        "(private API moved); elastic falls back to "
+        "jax.distributed.initialize — NOTE: on jaxlib <= 0.4.x this "
+        "auto-starts a competing coordination service on process 0 and "
+        "must not be combined with a launcher-owned coordinator")
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=cfg.size, process_id=rank)
 
@@ -218,7 +242,9 @@ def recoverable_client_contract():
     fallback (docs/elastic.md "jaxlib compatibility")."""
     try:
         from jax._src import distributed as _dist  # noqa: F401
-        from jax._src.lib import _jax as _jaxlib
+
+        from horovod_tpu.common.compat import jaxlib_extension
+        _jaxlib = jaxlib_extension()
     except ImportError as e:
         return False, f"jax._src import moved: {e}"
     factory = getattr(_jaxlib, "get_distributed_runtime_client", None)
@@ -416,6 +442,13 @@ def init(process_sets: Optional[Sequence] = None,
                         cfg.stall_shutdown_seconds)
             except Exception:
                 _state.stall_inspector = None
+            if _state.stall_inspector is None:
+                # No toolchain / load failure: same contract in pure
+                # Python, so elastic-mode collective waits stay bounded
+                # (ops/collectives.py StallWatchdog) everywhere.
+                from horovod_tpu.common.resilience import PyStallInspector
+                _state.stall_inspector = PyStallInspector(
+                    cfg.stall_warning_seconds, cfg.stall_shutdown_seconds)
 
         from horovod_tpu.common.hvd_logging import get_logger
         get_logger().info(
@@ -499,10 +532,21 @@ def _start_stall_watch(si, cfg: Config) -> None:
                 # background loop is live, operations.cc).
                 if not (_state.initialized and _state.stall_inspector is si):
                     return
-                get_logger().error(
-                    "Stall exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; "
-                    "aborting")
-                os._exit(1)
+                if cfg.elastic:
+                    # Elastic mode: the StallWatchdog guarding the blocked
+                    # wait (ops/collectives.py) raises HorovodInternalError
+                    # in the waiting thread within shutdown_sec, handing
+                    # recovery to the elastic retry loop — killing the
+                    # process here would forfeit in-memory state.
+                    get_logger().error(
+                        "Stall exceeded "
+                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; elastic "
+                        "watchdog will raise HorovodInternalError")
+                else:
+                    get_logger().error(
+                        "Stall exceeded "
+                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; aborting")
+                    os._exit(1)
             _time.sleep(max(cfg.stall_warning_seconds / 2.0, 1.0))
 
     threading.Thread(target=watch, name="hvd-stall-watch",

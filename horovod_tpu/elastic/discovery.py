@@ -111,7 +111,14 @@ class HostManager:
         self._lock = threading.Lock()
 
     def update_available_hosts(self) -> bool:
-        """Poll discovery; returns True if the usable host set changed."""
+        """Poll discovery; returns True if the usable host set changed.
+
+        May raise (discovery script failure, injected flap): callers own
+        the retry — ElasticDriver._discover_loop backs off under its
+        RetryPolicy, wait_for_available_slots absorbs until its timeout.
+        """
+        from horovod_tpu.testing import faults
+        faults.inject("discovery.poll")
         found = self._discovery.find_available_hosts_and_slots()
         usable = {h: s for h, s in found.items()
                   if not self._blacklist.is_blacklisted(h)}

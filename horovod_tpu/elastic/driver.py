@@ -21,7 +21,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.common.exceptions import (HorovodTpuError,
+                                           ResetLimitExceededError)
+from horovod_tpu.common.resilience import RetryPolicy, discovery_retry_policy
 from horovod_tpu.elastic.discovery import HostManager
 from horovod_tpu.elastic.registration import WorkerStateRegistry
 from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
@@ -51,7 +53,8 @@ class ElasticDriver:
                  discovery_interval: float = 1.0,
                  reset_limit: Optional[int] = None,
                  publish_fn: Optional[Callable[[List[SlotInfo], int],
-                                               None]] = None):
+                                               None]] = None,
+                 discovery_retry: Optional[RetryPolicy] = None):
         self.hosts = host_manager
         self.spawn_fn = spawn_fn
         self.stop_fn = stop_fn
@@ -64,6 +67,15 @@ class ElasticDriver:
         self.max_num_proc = max_num_proc
         self.discovery_interval = discovery_interval
         self.reset_limit = reset_limit
+        # Backoff schedule for discovery-poll failures (env prefix
+        # HOROVOD_DISCOVERY_RETRY). The poll loop is perpetual, so the
+        # policy bounds each failure BURST, not the loop: exhaustion is
+        # surfaced via `discovery_failures` and the loop keeps probing at
+        # the capped cadence (a dead discovery script must not kill a
+        # healthy running job — but it must be loudly visible).
+        self.discovery_retry = discovery_retry if discovery_retry is not None \
+            else discovery_retry_policy()
+        self.discovery_failures = 0   # consecutive; 0 once healthy
         self.registry = WorkerStateRegistry()
 
         self._workers: Dict[int, _Worker] = {}   # rank -> worker
@@ -94,10 +106,19 @@ class ElasticDriver:
     # ---------------------------------------------------------------- hosts
     def wait_for_available_slots(self, min_np: int,
                                  timeout: float = 600.0) -> None:
-        """Block until discovery finds ≥ min_np slots (reference :153)."""
+        """Block until discovery finds ≥ min_np slots (reference :153).
+
+        Discovery hiccups while waiting do not abort the wait — they are
+        absorbed (and logged) until the caller's timeout, which stays the
+        single bound on this wait.
+        """
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            self.hosts.update_available_hosts()
+            try:
+                self.hosts.update_available_hosts()
+            except Exception as e:
+                print(f"elastic: discovery error while waiting for slots: "
+                      f"{e}", file=sys.stderr)
             if self.hosts.available_slots() >= min_np:
                 return
             time.sleep(self.discovery_interval)
@@ -106,13 +127,39 @@ class ElasticDriver:
             f"(have {self.hosts.available_slots()})")
 
     def _discover_loop(self) -> None:
+        """Discovery poll with policy-bounded failure backoff.
+
+        Healthy polls tick at `discovery_interval`. On failure the wait
+        follows `discovery_retry`'s backoff schedule; when the schedule is
+        exhausted the burst is surfaced (stderr + `discovery_failures`)
+        and polling continues at the policy's capped delay — recovery
+        re-arms the schedule.
+        """
+        backoff = None
         while not self._shutdown.is_set():
             try:
                 if self.hosts.update_available_hosts():
                     self._host_change.set()
-            except Exception as e:  # discovery script hiccup: log, retry
-                print(f"elastic: discovery error: {e}", file=sys.stderr)
-            self._shutdown.wait(self.discovery_interval)
+                self.discovery_failures = 0
+                backoff = None
+                wait = self.discovery_interval
+            except Exception as e:
+                self.discovery_failures += 1
+                if backoff is None:
+                    backoff = self.discovery_retry.delays()
+                try:
+                    wait = next(backoff)
+                    print(f"elastic: discovery error "
+                          f"(attempt {self.discovery_failures}, retry in "
+                          f"{wait:.2f}s): {e}", file=sys.stderr)
+                except StopIteration:
+                    wait = self.discovery_retry.max_delay
+                    print(f"elastic: discovery failing persistently "
+                          f"({self.discovery_failures} consecutive "
+                          f"errors; HOROVOD_DISCOVERY_RETRY_* bounds "
+                          f"exhausted, probing every {wait:.1f}s): {e}",
+                          file=sys.stderr)
+            self._shutdown.wait(wait)
 
     # ---------------------------------------------------------- assignments
     def compute_assignments(self) -> List[SlotInfo]:
@@ -259,9 +306,10 @@ class ElasticDriver:
         self._host_change.clear()
         self._resets += 1
         if self.reset_limit is not None and self._resets > self.reset_limit:
-            raise HorovodTpuError(
-                f"elastic reset limit {self.reset_limit} exceeded "
-                f"(reference: launch.py --reset-limit)")
+            raise ResetLimitExceededError(
+                f"elastic reset limit {self.reset_limit} exceeded after "
+                f"{self._resets - 1} reset(s) (reference: launch.py "
+                f"--reset-limit)")
         try:
             self._start_round()
         except HorovodTpuError:
@@ -318,13 +366,18 @@ class RoundPublisher:
             "HOROVOD_ELASTIC_SHUTDOWN_SECONDS", "10"))
 
     def _make_service(self, round_id: int, n: int) -> str:
-        from jax._src.lib import _jax as _jaxlib
-
+        from horovod_tpu.common.compat import make_distributed_service
         from horovod_tpu.runner.launch import _free_port
 
         port = _free_port()
-        self._services[round_id] = _jaxlib.get_distributed_runtime_service(
-            f"[::]:{port}", n, heartbeat_timeout=self._hb,
+        # IPv4 wildcard, matching the IPv4 coordinator address we publish
+        # (_local_ip): on some kernels a [::] dual-stack bind accepts the
+        # workers' connections but never completes cluster registration —
+        # the init barrier hangs with no error. Overridable for
+        # IPv6-only fabrics.
+        bind = os.environ.get("HOROVOD_COORD_BIND_ADDR", "0.0.0.0")
+        self._services[round_id] = make_distributed_service(
+            f"{bind}:{port}", n, heartbeat_timeout=self._hb,
             shutdown_timeout=self._sd)
         self.round_coords[round_id] = f"{self.ip}:{port}"
         for rid in [r for r in self._services if r <= round_id - 2]:
@@ -379,7 +432,11 @@ def drive_elastic_loop(driver: "ElasticDriver", elastic_timeout: float,
     idle_since = None
     try:
         while True:
-            driver.maybe_reset()
+            try:
+                driver.maybe_reset()
+            except ResetLimitExceededError as e:
+                print(f"elastic: {e}", file=sys.stderr)
+                return 1
             driver.reap_leaving()
             with driver._lock:
                 workers = dict(driver._workers)

@@ -85,6 +85,102 @@ def is_comm_failure(e: BaseException) -> bool:
     return any(m in msg for m in _COMM_FAILURE_MARKERS)
 
 
+class StallWatchdog:
+    """Python-side watchdog over a blocking collective wait.
+
+    Built on the StallInspector bindings (native/__init__.py:247, or the
+    pure-Python fallback common/resilience.py:PyStallInspector): the wait
+    is registered via submit()/done() so the global watcher names it in
+    warnings; guard() additionally BOUNDS the wait — it warns once at
+    `warn_sec` and at `shutdown_sec` raises HorovodInternalError in the
+    waiting thread, so the elastic retry loop (restore → re-rendezvous)
+    owns recovery instead of a silent hang (or the non-elastic os._exit).
+
+    Mechanics: `jax.block_until_ready` cannot be interrupted from Python,
+    so the blocking call runs in a daemon thread and the caller polls its
+    completion. On a shutdown raise the daemon thread stays blocked until
+    the elastic reset tears the backend down (or the process exits) — it
+    never outlives recovery. The thread is spawned per call on purpose:
+    a reusable executor thread would be abandoned mid-block by exactly
+    the timeouts this guard exists for, forcing respawn logic that
+    degenerates to per-call spawn; the ~100 us spawn cost is noise next
+    to a cross-process collective, and only elastic mode pays it.
+    """
+
+    def __init__(self, inspector, warn_sec: float, shutdown_sec: float,
+                 poll_interval: float = 0.05):
+        self.inspector = inspector
+        self.warn_sec = warn_sec
+        self.shutdown_sec = shutdown_sec
+        self.poll_interval = poll_interval
+
+    def guard(self, name: str, fn: Callable[[], Any]) -> Any:
+        import time as _time
+
+        from horovod_tpu.common.hvd_logging import get_logger
+
+        self.inspector.submit(name)
+        box: dict = {}
+        finished = threading.Event()
+
+        def run() -> None:
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # delivered to the caller below
+                box["error"] = e
+            finally:
+                finished.set()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"hvd-guarded-wait-{name}")
+        start = _time.monotonic()
+        t.start()
+        warned = False
+        try:
+            while not finished.wait(self.poll_interval):
+                age = _time.monotonic() - start
+                if not warned and age >= self.warn_sec:
+                    warned = True
+                    get_logger().warning(
+                        "collective '%s' stalled for %.1fs "
+                        "(HOROVOD_STALL_CHECK_TIME_SECONDS=%.0f)",
+                        name, age, self.warn_sec)
+                if self.shutdown_sec > 0 and age >= self.shutdown_sec:
+                    stalled, _ = self.inspector.check()
+                    raise HorovodInternalError(
+                        f"collective '{name}' stalled past "
+                        f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                        f"{self.shutdown_sec:.0f}s"
+                        + (f" (outstanding: {', '.join(stalled)})"
+                           if stalled else ""))
+            if "error" in box:
+                raise box["error"]
+            return box["value"]
+        finally:
+            self.inspector.done(name)
+
+
+def _guarded_wait(name: str, fn: Callable[[], Any]) -> Any:
+    """Run a blocking host-side wait under the stall inspector.
+
+    Elastic mode with a shutdown window: the StallWatchdog bounds the wait
+    (HorovodInternalError within shutdown_sec). Otherwise: plain call with
+    submit/done bookkeeping, so the topology watcher can still warn (and,
+    non-elastic, enforce its own shutdown via os._exit).
+    """
+    st = topology.raw_state()
+    si = st.stall_inspector
+    cfg = st.config
+    if si is None or not cfg.elastic or cfg.stall_shutdown_seconds <= 0:
+        _stall_submit(name)
+        try:
+            return fn()
+        finally:
+            _stall_done(name)
+    return StallWatchdog(si, cfg.stall_warning_seconds,
+                         cfg.stall_shutdown_seconds).guard(name, fn)
+
+
 def _execute(fn: Callable, *args):
     """Run a compiled collective with failure propagation.
 
@@ -94,14 +190,20 @@ def _execute(fn: Callable, *args):
     force completion so a peer death surfaces HERE — inside the elastic
     retry scope — as HorovodInternalError, instead of as a raw
     XlaRuntimeError at some later readback the retry loop can't catch.
+    The forced wait runs under the stall watchdog, so a PEER THAT NEVER
+    ARRIVES (as opposed to one that dies loudly) also surfaces as
+    HorovodInternalError within the shutdown window instead of hanging.
     Non-elastic runs keep fully async dispatch and raw errors.
     """
     elastic = topology.raw_state().config.elastic
     try:
-        out = fn(*args)
         if elastic:
-            jax.block_until_ready(out)
-        return out
+            # The guard must cover DISPATCH too: CPU/gloo executes the
+            # collective synchronously inside fn(*args), so a missing
+            # peer blocks there — before any block_until_ready.
+            return _guarded_wait(
+                "collective", lambda: jax.block_until_ready(fn(*args)))
+        return fn(*args)
     except Exception as e:
         if elastic and is_comm_failure(e):
             raise HorovodInternalError(
@@ -1093,16 +1195,18 @@ def synchronize(handle: Any) -> Any:
     """Wait for an async collective result (reference: mpi_ops.py:1269).
 
     JAX arrays are futures under async dispatch, so the handle IS the result.
+    The wait runs under the stall watchdog (elastic mode: bounded by
+    HOROVOD_STALL_SHUTDOWN_TIME_SECONDS → HorovodInternalError).
     """
-    _stall_submit("synchronize")
     try:
-        return jax.block_until_ready(handle)
+        return _guarded_wait("synchronize",
+                             lambda: jax.block_until_ready(handle))
     except Exception as e:
+        if isinstance(e, HorovodInternalError):
+            raise
         if topology.raw_state().config.elastic and is_comm_failure(e):
             raise HorovodInternalError(f"synchronize failed: {e}") from e
         raise
-    finally:
-        _stall_done("synchronize")
 
 
 def poll(handle: Any) -> bool:

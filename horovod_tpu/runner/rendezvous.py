@@ -119,15 +119,39 @@ class KVClient:
     """Worker-side client (reference: http_client.py read_data_from_kvstore).
     By default signs with the job secret from HOROVOD_SECRET_KEY; pass
     secret=None explicitly for an unsigned client, or secret=<bytes> to
-    override."""
+    override.
 
-    def __init__(self, addr: str, port: int, secret=_FROM_ENV):
+    Every request runs under a RetryPolicy (common/resilience.py, env
+    prefix HOROVOD_KV_RETRY): transient transport failures — connection
+    refused/reset while the rendezvous server restarts, timeouts, HTTP
+    5xx — are retried with jittered exponential backoff up to the policy's
+    attempt/deadline bounds. Non-transient responses (403 auth rejection,
+    404 missing key) surface immediately: retrying them would mask a real
+    error or add latency to the get() not-found poll.
+    """
+
+    # GET polls for keys that do not exist yet (assignment publication
+    # races): back off from POLL_BASE doubling to POLL_CAP instead of the
+    # old fixed 50 ms busy-wait.
+    POLL_BASE = 0.02
+    POLL_CAP = 0.5
+
+    def __init__(self, addr: str, port: int, secret=_FROM_ENV,
+                 retry_policy=None):
+        from horovod_tpu.common import resilience
         self.base = f"http://{addr}:{port}"
         self.secret = secret_mod.secret_from_env() \
             if secret is _FROM_ENV else secret
+        self.retry = retry_policy if retry_policy is not None \
+            else resilience.kv_retry_policy()
+        self.attempts = 0  # total request attempts (test observability)
 
-    def _request(self, method: str, path: str, data: Optional[bytes]):
+    def _request_once(self, method: str, path: str, data: Optional[bytes]):
         import urllib.request
+
+        from horovod_tpu.testing import faults
+        self.attempts += 1
+        faults.inject("kv.request")
         req = urllib.request.Request(f"{self.base}{path}", data=data,
                                      method=method)
         if self.secret is not None:
@@ -136,6 +160,9 @@ class KVClient:
                 secret_mod.compute_digest(self.secret, method, path,
                                           data or b""))
         return urllib.request.urlopen(req, timeout=30 if data else 10)
+
+    def _request(self, method: str, path: str, data: Optional[bytes]):
+        return self.retry.call(self._request_once, method, path, data)
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         self._request("PUT", f"/{scope}/{key}", value).read()
@@ -150,15 +177,26 @@ class KVClient:
 
     def get(self, scope: str, key: str,
             timeout: float = 30.0) -> Optional[bytes]:
+        """Fetch a key, polling through 404 until `timeout` (None after).
+
+        Two distinct waits compose here: transient transport/5xx failures
+        retry INSIDE _request under the KV policy (the server is sick);
+        404 polls OUT HERE under the caller's timeout with capped
+        exponential backoff (the server is healthy, the key just is not
+        written yet — e.g. the next round's assignment).
+        """
         import time
         import urllib.error
         deadline = time.monotonic() + timeout
+        delay = self.POLL_BASE
         while True:
             try:
                 return self._request("GET", f"/{scope}/{key}", None).read()
             except urllib.error.HTTPError as e:
-                if e.code != 404 or time.monotonic() > deadline:
-                    if e.code == 404:
-                        return None
+                if e.code != 404:
                     raise
-                time.sleep(0.05)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                time.sleep(min(delay, remaining))
+                delay = min(delay * 2, self.POLL_CAP)

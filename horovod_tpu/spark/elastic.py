@@ -320,7 +320,19 @@ def run_elastic(fn, args=(), kwargs=None,
         publish_fn=publisher.publish)
 
     deadline = time.monotonic() + start_timeout
-    while not (hm.update_available_hosts() or hm.current_hosts):
+
+    def _poll_agents() -> bool:
+        # update_available_hosts may raise (discovery hiccup, injected
+        # flap): absorb until start_timeout — the deadline below stays
+        # the single bound on this wait, like wait_for_available_slots.
+        try:
+            return bool(hm.update_available_hosts())
+        except Exception as e:
+            print(f"elastic spark: discovery error while waiting for "
+                  f"agents: {e}", file=sys.stderr)
+            return False
+
+    while not (_poll_agents() or hm.current_hosts):
         if time.monotonic() > deadline:
             kv.put(_SCOPE, "stopall", b"1")
             rdv.stop()

@@ -25,6 +25,30 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--run-faults", action="store_true", default=False,
+        help="run the chaos/fault-injection suite (make chaos)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "faults: end-to-end chaos tests driving elastic jobs under injected "
+        "faults (HOROVOD_FAULT_SPEC); minutes of runtime, so excluded from "
+        "tier-1 — run via `make chaos` or --run-faults")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--run-faults"):
+        return
+    skip = pytest.mark.skip(
+        reason="chaos suite: run with `make chaos` (pytest --run-faults)")
+    for item in items:
+        if "faults" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture()
 def hvd():
     """Initialized framework handle; shuts down after the test."""

@@ -21,6 +21,17 @@ Modes (argv[1]):
   crash   — the worker on CRASH_HOSTNAME exits(7) at step CRASH_STEP in
             round 1; survivors must recover from the last commit via
             HorovodInternalError -> restore -> re-rendezvous.
+  stall   — the worker on STALL_HOSTNAME stops calling collectives at step
+            STALL_STEP in round 1 (prints STALLING, sleeps, then exits(9)).
+            The survivor's allreduce blocks on the missing peer; its stall
+            watchdog (ops/collectives.py StallWatchdog) must raise
+            HorovodInternalError within HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
+            — long before the staller's eventual exit — handing recovery to
+            the elastic retry loop instead of an indefinite hang.
+
+Each step passes the `worker.step` fault-injection site
+(horovod_tpu/testing/faults.py), so the chaos suite can add latency or
+crash workers purely via HOROVOD_FAULT_SPEC in the job environment.
 """
 
 import os
@@ -41,6 +52,12 @@ WAIT_STEP = int(os.environ.get("ELASTIC_WAIT_STEP", "8"))
 PROGRESS_FILE = os.environ.get("ELASTIC_PROGRESS_FILE", "")
 CRASH_HOSTNAME = os.environ.get("ELASTIC_CRASH_HOSTNAME", "")
 CRASH_STEP = int(os.environ.get("ELASTIC_CRASH_STEP", "5"))
+STALL_HOSTNAME = os.environ.get("ELASTIC_STALL_HOSTNAME", "")
+STALL_STEP = int(os.environ.get("ELASTIC_STALL_STEP", "5"))
+# The staller lingers well past the survivor's shutdown_sec before exiting,
+# so recovery can only have been triggered by the watchdog raise — not by
+# the driver noticing a dead process.
+STALL_EXIT_AFTER = float(os.environ.get("ELASTIC_STALL_EXIT_AFTER", "8"))
 
 
 def main():
@@ -78,9 +95,17 @@ def main():
                 st.check_host_updates()
                 time.sleep(0.1)
                 continue
+            if (mode == "stall" and my_host == STALL_HOSTNAME
+                    and st.step == STALL_STEP
+                    and os.environ.get("HOROVOD_ELASTIC_ROUND") == "1"):
+                print(f"STALLING host={my_host} step={st.step}", flush=True)
+                time.sleep(STALL_EXIT_AFTER)
+                os._exit(9)
             # One "training step": allreduce a per-rank gradient; every
             # rank adds exactly 1.0 to w per step regardless of world size,
             # so w == step at all times if and only if state survived.
+            from horovod_tpu.testing import faults
+            faults.inject("worker.step")
             g = hvd.allreduce(np.ones((4,), np.float32), op="sum")
             st.params = {"w": st.params["w"] + np.asarray(g) / now}
             st.step += 1

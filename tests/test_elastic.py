@@ -275,8 +275,10 @@ def test_elastic_init_survives_missing_private_api(monkeypatch):
 
     monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
 
-    # 1) factory vanished entirely
-    from jax._src.lib import _jax as _jaxlib
+    # 1) factory vanished entirely (resolve the extension through compat,
+    # like the production path — the module name drifts across jaxlibs)
+    from horovod_tpu.common.compat import jaxlib_extension
+    _jaxlib = jaxlib_extension()
     monkeypatch.delattr(_jaxlib, "get_distributed_runtime_client")
     cfg = Config(rank=1, size=4, elastic=True)
     topology._elastic_distributed_init("10.0.0.1:9999", cfg)

@@ -1,0 +1,436 @@
+"""Chaos suite: the control plane under deterministic injected faults.
+
+Exercises the resilience layer (common/resilience.py) end to end through
+the fault-injection harness (horovod_tpu/testing/faults.py):
+
+* KVClient rides out injected connection refusals / 5xx and a REAL
+  rendezvous-server restart; non-transient 403/404 are never retried.
+* HostManager / ElasticDriver absorb flapping discovery with bounded
+  backoff; blacklisted hosts are re-admitted after cooldown.
+* ElasticDriver surfaces reset-limit exhaustion as the typed
+  ResetLimitExceededError and drive_elastic_loop turns it into a clean
+  nonzero exit instead of looping forever.
+* (`faults`-marked, `make chaos`) real 2-process elastic jobs complete
+  despite injected rendezvous outages, a killed worker, a flapping host,
+  and a stalled collective — every wait bounded by a policy deadline, the
+  stall surfacing as HorovodInternalError within shutdown_sec.
+
+Fast in-process tests run in tier 1; the e2e jobs are `faults`-marked and
+run via `make chaos` (pytest --run-faults).
+"""
+
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+
+import pytest
+
+from horovod_tpu.common.exceptions import (FaultInjectedError,
+                                           HorovodTpuError, RetryError,
+                                           ResetLimitExceededError)
+from horovod_tpu.common.resilience import RetryPolicy
+from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+from horovod_tpu.testing import faults
+from horovod_tpu.testing.faults import FaultInjector, FaultRule, parse_spec
+
+# Top-level module name: pytest imports rootless test files with their own
+# directory prepended to sys.path, so this resolves under both `pytest`
+# and `python -m pytest`; a `tests.`-qualified import only works for the
+# latter (repo root on sys.path) and double-imports the module.
+from test_elastic_e2e import finish, start_job, wait_for_step, write_hosts
+
+
+@pytest.fixture(autouse=True)
+def clean_injector():
+    """Every test starts and ends with no process-wide injector."""
+    prev = faults.install(None)
+    yield
+    faults.install(prev)
+
+
+def fast_policy(**kw):
+    kw.setdefault("max_attempts", 6)
+    kw.setdefault("base_delay", 0.005)
+    kw.setdefault("max_delay", 0.02)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("deadline", 5.0)
+    return RetryPolicy(**kw)
+
+
+# ----------------------------------------------------------- injector harness
+
+def test_parse_spec_full_grammar():
+    rules = parse_spec(
+        "site=kv.request,kind=connect_refused,p=0.3,count=2;"
+        "site=worker.step,kind=latency,ms=50,after=3")
+    assert len(rules) == 2
+    assert rules[0] == FaultRule("kv.request", "connect_refused", p=0.3,
+                                 count=2)
+    assert rules[1] == FaultRule("worker.step", "latency", ms=50.0, after=3)
+
+
+def test_parse_spec_rejects_bad_input():
+    with pytest.raises(HorovodTpuError):
+        parse_spec("site=x,kind=not_a_kind")
+    with pytest.raises(HorovodTpuError):
+        parse_spec("kind=latency")          # missing site
+    with pytest.raises(HorovodTpuError):
+        parse_spec("site=x,kind=latency,oops")  # field without '='
+
+
+def test_injector_after_and_count_windows():
+    inj = FaultInjector([FaultRule("s", "flap", after=2, count=2)])
+    outcomes = []
+    for _ in range(6):
+        try:
+            inj.fire("s")
+            outcomes.append("ok")
+        except FaultInjectedError:
+            outcomes.append("fault")
+    # Hits 0-1 skipped by `after`, hits 2-3 fault, then `count` exhausted.
+    assert outcomes == ["ok", "ok", "fault", "fault", "ok", "ok"]
+    assert inj.hits["s"] == 6 and inj.injected["s"] == 2
+
+
+def test_injector_probability_deterministic_per_seed():
+    def schedule(seed):
+        inj = FaultInjector([FaultRule("s", "flap", p=0.5)], seed=seed)
+        out = []
+        for _ in range(20):
+            try:
+                inj.fire("s")
+                out.append(0)
+            except FaultInjectedError:
+                out.append(1)
+        return out
+
+    assert schedule(1) == schedule(1)       # replayable
+    assert schedule(1) != schedule(2)       # seed actually matters
+    assert 0 < sum(schedule(1)) < 20        # p=0.5 is neither never nor always
+
+
+def test_injector_rule_streams_independent():
+    """Adding a rule for another site must not perturb this site's draws."""
+    base = FaultInjector([FaultRule("a", "flap", p=0.5)], seed=3)
+    extended = FaultInjector([FaultRule("a", "flap", p=0.5),
+                              FaultRule("b", "latency", ms=0.0)], seed=3)
+
+    def draws(inj):
+        out = []
+        for _ in range(10):
+            try:
+                inj.fire("a")
+                out.append(0)
+            except FaultInjectedError:
+                out.append(1)
+        return out
+
+    assert draws(base) == draws(extended)
+
+
+def test_injector_inert_without_spec(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV, raising=False)
+    assert FaultInjector.from_env() is None
+    faults.inject("anything")  # no injector installed: must be a no-op
+
+
+def test_injected_crash_kills_process():
+    code = (
+        "from horovod_tpu.testing import faults\n"
+        "faults.inject('worker.step')\n"
+        "print('unreachable')\n")
+    env = dict(os.environ)
+    env[faults.FAULT_SPEC_ENV] = "site=worker.step,kind=crash"
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 7
+    assert "unreachable" not in proc.stdout
+
+
+# ------------------------------------------------- KVClient under injection
+
+@pytest.fixture()
+def server():
+    srv = RendezvousServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def client_for(srv, **policy_kw):
+    return KVClient("127.0.0.1", srv.port, secret=None,
+                    retry_policy=fast_policy(**policy_kw))
+
+
+def test_kv_put_rides_out_connection_refused(server):
+    faults.install(FaultInjector(
+        [FaultRule("kv.request", "connect_refused", count=2)]))
+    c = client_for(server)
+    c.put("s", "k", b"v")
+    assert c.attempts == 3                      # 2 refused + 1 success
+    assert c.get("s", "k") == b"v"
+
+
+def test_kv_get_retries_injected_5xx(server):
+    server.put("s", "k", b"payload")
+    faults.install(FaultInjector(
+        [FaultRule("kv.request", "http_5xx", count=2)]))
+    c = client_for(server)
+    assert c.get("s", "k") == b"payload"
+    assert c.attempts == 3
+
+
+def test_kv_delete_rides_out_refusal_and_404_passes(server):
+    server.put("s", "k", b"v")
+    faults.install(FaultInjector(
+        [FaultRule("kv.request", "connect_refused", count=1)]))
+    c = client_for(server)
+    c.delete("s", "k")
+    assert server.get("s", "k") is None
+    c.delete("s", "k")  # second delete: 404 is swallowed, not retried
+
+
+def test_kv_exhaustion_is_typed_and_bounded():
+    # Nothing listens on this port: every attempt is a real refusal.
+    dead = KVClient("127.0.0.1", 1, secret=None,
+                    retry_policy=fast_policy(max_attempts=3))
+    t0 = time.monotonic()
+    with pytest.raises(RetryError):
+        dead.put("s", "k", b"v")
+    assert time.monotonic() - t0 < 5.0
+    assert dead.attempts == 3
+
+
+def test_kv_404_polls_with_backoff_not_retry(server):
+    c = client_for(server)
+    t0 = time.monotonic()
+    assert c.get("s", "missing", timeout=0.4) is None
+    elapsed = time.monotonic() - t0
+    assert 0.35 <= elapsed < 2.0                # bounded by caller timeout
+    # Exponential poll backoff: far fewer round-trips than the old fixed
+    # 50 ms loop would make (~8), yet more than one.
+    assert 2 <= c.attempts <= 7
+
+
+def test_kv_404_then_key_appears(server):
+    c = client_for(server)
+
+    import threading
+    threading.Timer(0.15, server.put, args=("s", "late", b"now")).start()
+    assert c.get("s", "late", timeout=5.0) == b"now"
+
+
+def test_kv_survives_real_server_restart():
+    """The scenario from the issue: the rendezvous server restarts mid-job
+    and a put lands during the outage. The retry policy must carry the
+    client across the down window."""
+    srv = RendezvousServer()
+    srv.start()
+    port = srv.port
+    srv.stop()
+
+    import threading
+    restarted = {}
+
+    def restart():
+        restarted["srv"] = RendezvousServer(port=port)
+        restarted["srv"].start()
+
+    threading.Timer(0.3, restart).start()
+    c = KVClient("127.0.0.1", port, secret=None,
+                 retry_policy=fast_policy(max_attempts=30, max_delay=0.1,
+                                          deadline=20.0))
+    try:
+        c.put("s", "k", b"survived")
+        assert c.attempts > 1                   # the outage was real
+        assert c.get("s", "k") == b"survived"
+    finally:
+        restarted["srv"].stop()
+
+
+# --------------------------------------------------- rendezvous auth (403s)
+
+def test_auth_rejection_is_not_retried():
+    """403 is non-transient: one attempt, immediate clear error — retrying
+    would only mask a misconfigured HOROVOD_SECRET_KEY."""
+    from horovod_tpu.runner.secret import make_secret_key
+    srv = RendezvousServer(secret=make_secret_key().encode())
+    srv.start()
+    try:
+        for bad in (KVClient("127.0.0.1", srv.port, secret=None,
+                             retry_policy=fast_policy()),
+                    KVClient("127.0.0.1", srv.port, secret=b"wrong",
+                             retry_policy=fast_policy())):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                bad.put("s", "k", b"poison")
+            assert ei.value.code == 403
+            assert bad.attempts == 1
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------- discovery flaps + driver bounds
+
+def test_host_manager_propagates_injected_flap():
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    hm = HostManager(FixedHosts({"a": 2}))
+    faults.install(FaultInjector([FaultRule("discovery.poll", "flap",
+                                            count=2)]))
+    with pytest.raises(FaultInjectedError):
+        hm.update_available_hosts()
+    with pytest.raises(FaultInjectedError):
+        hm.update_available_hosts()
+    assert hm.update_available_hosts()          # recovered; set changed
+    assert hm.available_slots() == 2
+
+
+def test_blacklist_cooldown_readmission():
+    """A blacklisted host rejoins the usable set once its cooldown lapses —
+    and that re-admission reports as a host-set change so the driver
+    triggers a rescale round."""
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    hm = HostManager(FixedHosts({"a": 1, "b": 1}),
+                     cooldown_range=(0.2, 0.4))
+    assert hm.update_available_hosts()
+    hm.blacklist("b")
+    hm.update_available_hosts()
+    assert [h.hostname for h in hm.current_hosts] == ["a"]
+    deadline = time.monotonic() + 5.0
+    while not hm.update_available_hosts():
+        assert time.monotonic() < deadline, "cooldown never lapsed"
+        time.sleep(0.05)
+    assert [h.hostname for h in hm.current_hosts] == ["a", "b"]
+
+
+def make_mock_driver(hosts, **kw):
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.elastic.driver import ElasticDriver
+    hm = HostManager(FixedHosts(hosts))
+    d = ElasticDriver(hm, lambda slot, rid: object(), lambda h: None,
+                      discovery_interval=0.02, **kw)
+    return d, hm
+
+
+def test_discover_loop_backs_off_on_flaps_then_recovers():
+    d, hm = make_mock_driver(
+        {"a": 1},
+        discovery_retry=RetryPolicy(max_attempts=3, base_delay=0.01,
+                                    max_delay=0.05, jitter=0.0,
+                                    deadline=None))
+    d.start()
+    # Install only after start(): wait_for_available_slots also polls
+    # discovery and would eat the rule's fire budget.
+    faults.install(FaultInjector([FaultRule("discovery.poll", "flap",
+                                            count=4)]))
+    try:
+        deadline = time.monotonic() + 5.0
+        # 4 flaps exceed the 3-attempt schedule: the loop must keep probing
+        # at the capped cadence (never die) and then recover to healthy.
+        while d.discovery_failures < 4:
+            assert time.monotonic() < deadline, "flaps never observed"
+            time.sleep(0.01)
+        while d.discovery_failures != 0:
+            assert time.monotonic() < deadline, "loop never recovered"
+            time.sleep(0.01)
+        assert d.hosts.available_slots() == 1
+    finally:
+        d.stop()
+
+
+def test_reset_limit_exhaustion_is_typed():
+    d, hm = make_mock_driver({"a": 2}, reset_limit=1)
+    d.start()
+    try:
+        d._host_change.set()
+        assert d.maybe_reset()
+        d._host_change.set()
+        with pytest.raises(ResetLimitExceededError):
+            d.maybe_reset()
+    finally:
+        d.stop()
+
+
+def test_drive_elastic_loop_exits_cleanly_on_reset_limit():
+    """The main loop turns ResetLimitExceededError into rc=1 instead of an
+    unhandled traceback or an infinite reset cycle."""
+    from horovod_tpu.elastic.driver import drive_elastic_loop
+
+    class NeverExits:
+        def poll(self):
+            return None
+
+        def terminate(self):
+            pass
+
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.elastic.driver import ElasticDriver
+    hm = HostManager(FixedHosts({"a": 1}))
+    d = ElasticDriver(hm, lambda slot, rid: NeverExits(),
+                      lambda h: h.terminate(), discovery_interval=0.02,
+                      reset_limit=0)
+    d.start()
+    d._host_change.set()
+    t0 = time.monotonic()
+    assert drive_elastic_loop(d, elastic_timeout=5.0) == 1
+    assert time.monotonic() - t0 < 5.0
+
+
+# ------------------------------------------------ e2e chaos (`make chaos`)
+
+@pytest.mark.faults
+def test_chaos_elastic_run_survives_injected_control_plane_faults(tmp_path):
+    """2-process elastic job under seeded chaos: intermittent rendezvous
+    refusals + latency on every control hop, a flapping discovery script,
+    AND a hard worker kill mid-run. The job must still complete with full
+    state — every wait policy-bounded, no indefinite hang."""
+    proc, hosts_file, progress = start_job(
+        tmp_path, "crash",
+        extra_env={
+            "ELASTIC_CRASH_HOSTNAME": "127.0.0.1",
+            "ELASTIC_CRASH_STEP": "5",
+            "HOROVOD_FAULT_SEED": "1234",
+            "HOROVOD_FAULT_SPEC": (
+                "site=kv.request,kind=connect_refused,p=0.15,count=6;"
+                "site=kv.request,kind=latency,ms=40,p=0.3;"
+                "site=worker.step,kind=latency,ms=60,p=0.25;"
+                "site=discovery.poll,kind=flap,p=0.2,count=8"),
+        })
+    write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    wait_for_step(progress, 6, proc=proc)
+    write_hosts(hosts_file, "localhost:1")
+    out = finish(proc)
+    assert "CRASHING host=127.0.0.1 step=5" in out, out
+    done = [l for l in out.splitlines() if "ELASTIC_DONE" in l]
+    assert len(done) == 1, out
+    assert "step=12" in done[0] and "w=12.000" in done[0], done[0]
+
+
+@pytest.mark.faults
+def test_chaos_stalled_collective_raises_within_shutdown_window(tmp_path):
+    """The stall-watchdog acceptance path: one worker silently stops
+    participating (no crash, no exit — the hardest failure mode). The
+    survivor's blocked allreduce must surface HorovodInternalError within
+    HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, and the elastic retry loop must
+    then carry the job to completion once the staller is reaped."""
+    proc, hosts_file, progress = start_job(
+        tmp_path, "stall",
+        extra_env={
+            "ELASTIC_STALL_HOSTNAME": "127.0.0.1",
+            "ELASTIC_STALL_STEP": "5",
+            "ELASTIC_STALL_EXIT_AFTER": "8",
+            "HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "3",
+        })
+    write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    wait_for_step(progress, 6, proc=proc)
+    write_hosts(hosts_file, "localhost:1")
+    out = finish(proc)
+    assert "STALLING host=127.0.0.1 step=5" in out, out
+    # The watchdog named the hung wait before shutdown fired.
+    assert "stalled" in out, out
+    done = [l for l in out.splitlines() if "ELASTIC_DONE" in l]
+    assert len(done) == 1, out
+    assert "size=1" in done[0] and "step=12" in done[0] \
+        and "w=12.000" in done[0], done[0]

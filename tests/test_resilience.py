@@ -1,0 +1,269 @@
+"""Unit tests for the resilience layer (common/resilience.py).
+
+Covers the RetryPolicy contract (bounded attempts/deadline, jittered
+backoff, typed exhaustion), the CircuitBreaker state machine, the
+PyStallInspector fallback, and the StallWatchdog bound on blocking
+collective waits. The chaos-level integration lives in tests/test_faults.py.
+"""
+
+import random
+import threading
+import time
+import urllib.error
+
+import pytest
+
+from horovod_tpu.common.exceptions import (CircuitOpenError,
+                                           HorovodInternalError, RetryError)
+from horovod_tpu.common.resilience import (CircuitBreaker, PyStallInspector,
+                                           RetryPolicy, is_transient,
+                                           kv_retry_policy)
+
+
+# -------------------------------------------------------------- RetryPolicy
+
+def test_backoff_schedule_caps_and_counts():
+    p = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.4,
+                    multiplier=2.0, jitter=0.0)
+    assert list(p.delays()) == [0.1, 0.2, 0.4, 0.4]  # capped, 4 retries
+
+
+def test_backoff_jitter_deterministic_with_seeded_rng():
+    p = RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=1.0,
+                    jitter=0.5)
+    a = list(p.delays(random.Random(7)))
+    b = list(p.delays(random.Random(7)))
+    c = list(p.delays(random.Random(8)))
+    assert a == b
+    assert a != c
+    for d, cap in zip(a, [0.1, 0.2, 0.4, 0.8, 1.0]):
+        assert cap * 0.5 <= d <= cap  # jitter=0.5: within [cap/2, cap]
+
+
+def test_call_retries_transient_then_succeeds():
+    p = RetryPolicy(max_attempts=4, base_delay=0.001, jitter=0.0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionRefusedError("transient")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    assert calls["n"] == 3
+
+
+def test_call_exhaustion_raises_retry_error_with_cause():
+    p = RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0)
+
+    def always():
+        raise ConnectionResetError("down")
+
+    with pytest.raises(RetryError) as ei:
+        p.call(always)
+    assert isinstance(ei.value.__cause__, ConnectionResetError)
+
+
+def test_call_does_not_retry_non_transient():
+    p = RetryPolicy(max_attempts=5, base_delay=0.001)
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("user error")
+
+    with pytest.raises(ValueError):
+        p.call(bad)
+    assert calls["n"] == 1
+
+
+def test_call_deadline_bounds_total_time():
+    p = RetryPolicy(max_attempts=100, base_delay=0.05, max_delay=0.05,
+                    jitter=0.0, deadline=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(RetryError) as ei:
+        p.call(lambda: (_ for _ in ()).throw(ConnectionRefusedError()))
+    assert time.monotonic() - t0 < 1.0
+    assert "deadline" in str(ei.value)
+
+
+def test_on_retry_hook_observes_attempts():
+    p = RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0)
+    seen = []
+
+    def flaky():
+        if len(seen) < 2:
+            raise TimeoutError("slow")
+        return 1
+
+    assert p.call(flaky, on_retry=lambda a, e, d: seen.append((a, d))) == 1
+    assert [a for a, _ in seen] == [1, 2]
+
+
+def test_from_env_overrides(monkeypatch):
+    monkeypatch.setenv("HOROVOD_KV_RETRY_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("HOROVOD_KV_RETRY_BASE_DELAY", "0.123")
+    monkeypatch.setenv("HOROVOD_KV_RETRY_DEADLINE", "0")  # 0 = unbounded
+    p = kv_retry_policy()
+    assert p.max_attempts == 2
+    assert p.base_delay == pytest.approx(0.123)
+    assert p.deadline is None
+
+
+def test_is_transient_classification():
+    hdrs = None
+    assert is_transient(urllib.error.HTTPError("u", 503, "x", hdrs, None))
+    assert is_transient(urllib.error.HTTPError("u", 500, "x", hdrs, None))
+    assert not is_transient(urllib.error.HTTPError("u", 403, "x", hdrs, None))
+    assert not is_transient(urllib.error.HTTPError("u", 404, "x", hdrs, None))
+    assert is_transient(urllib.error.URLError(ConnectionRefusedError()))
+    assert is_transient(TimeoutError())
+    assert is_transient(ConnectionResetError())
+    assert not is_transient(ValueError("nope"))
+
+
+# ------------------------------------------------------------ CircuitBreaker
+
+def make_breaker(**kw):
+    t = [0.0]
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("recovery_timeout", 10.0)
+    cb = CircuitBreaker(clock=lambda: t[0], **kw)
+    return cb, t
+
+
+def trip(cb, n):
+    for _ in range(n):
+        with pytest.raises(ConnectionError):
+            cb.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+
+
+def test_breaker_opens_after_threshold_and_fails_fast():
+    cb, t = make_breaker()
+    trip(cb, 2)
+    assert cb.state == "closed"
+    trip(cb, 1)
+    assert cb.state == "open"
+    calls = {"n": 0}
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: calls.__setitem__("n", 1))
+    assert calls["n"] == 0  # open circuit never touched the target
+
+
+def test_breaker_half_open_probe_then_close():
+    cb, t = make_breaker()
+    trip(cb, 3)
+    t[0] += 10.1
+    assert cb.state == "half_open"
+    assert cb.call(lambda: "ok") == "ok"
+    assert cb.state == "closed"
+
+
+def test_breaker_half_open_failure_reopens():
+    cb, t = make_breaker()
+    trip(cb, 3)
+    t[0] += 10.1
+    trip(cb, 1)  # probe fails
+    assert cb.state == "open"
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: "nope")
+
+
+def test_breaker_half_open_admits_single_probe():
+    cb, t = make_breaker()
+    trip(cb, 3)
+    t[0] += 10.1
+    assert cb.allow()       # first caller gets the probe
+    assert not cb.allow()   # second caller is rejected while probing
+    cb.record_success()
+    assert cb.state == "closed"
+
+
+def test_breaker_success_resets_failure_count():
+    cb, t = make_breaker()
+    trip(cb, 2)
+    cb.call(lambda: "fine")
+    trip(cb, 2)
+    assert cb.state == "closed"  # never reached 3 consecutive
+
+
+# --------------------------------------------------- stall inspector fallback
+
+def test_py_stall_inspector_warn_and_shutdown_windows():
+    si = PyStallInspector(warn_sec=0.03, shutdown_sec=0.08)
+    si.submit("allreduce.grad")
+    assert si.check() == ([], False)
+    time.sleep(0.04)
+    stalled, shut = si.check()
+    assert stalled == ["allreduce.grad"] and not shut
+    time.sleep(0.06)
+    stalled, shut = si.check()
+    assert stalled == ["allreduce.grad"] and shut
+    si.done("allreduce.grad")
+    assert si.check() == ([], False)
+
+
+def test_py_stall_inspector_no_shutdown_when_disabled():
+    si = PyStallInspector(warn_sec=0.01, shutdown_sec=0.0)
+    si.submit("x")
+    time.sleep(0.03)
+    stalled, shut = si.check()
+    assert stalled == ["x"] and not shut
+
+
+# -------------------------------------------------------------- StallWatchdog
+
+def make_watchdog(warn=0.05, shutdown=0.2):
+    from horovod_tpu.ops.collectives import StallWatchdog
+    si = PyStallInspector(warn, shutdown)
+    return StallWatchdog(si, warn_sec=warn, shutdown_sec=shutdown,
+                         poll_interval=0.01), si
+
+
+def test_watchdog_passes_through_fast_wait():
+    wd, si = make_watchdog()
+    assert wd.guard("fast", lambda: 41 + 1) == 42
+    assert si.check() == ([], False)  # done() cleared the entry
+
+
+def test_watchdog_propagates_inner_error():
+    wd, _ = make_watchdog()
+    with pytest.raises(ValueError):
+        wd.guard("err", lambda: (_ for _ in ()).throw(ValueError("inner")))
+
+
+def test_watchdog_raises_internal_error_within_shutdown_window():
+    wd, _ = make_watchdog(warn=0.05, shutdown=0.2)
+    release = threading.Event()
+    t0 = time.monotonic()
+    with pytest.raises(HorovodInternalError) as ei:
+        wd.guard("hung.collective", lambda: release.wait(30.0))
+    elapsed = time.monotonic() - t0
+    release.set()
+    assert 0.15 <= elapsed < 2.0, elapsed  # within shutdown_sec + slack
+    assert "hung.collective" in str(ei.value)
+
+
+def test_watchdog_unbounded_when_shutdown_disabled():
+    wd, _ = make_watchdog(warn=0.01, shutdown=0.0)
+    assert wd.guard("slowish", lambda: time.sleep(0.1) or "done") == "done"
+
+
+def test_guarded_wait_raises_in_elastic_mode(hvd, monkeypatch):
+    """End-to-end wiring: with elastic on and a shutdown window set, a
+    blocking collective wait surfaces HorovodInternalError — the elastic
+    retry loop's trigger — instead of hanging."""
+    from horovod_tpu.core import topology
+    from horovod_tpu.ops import collectives
+
+    st = topology.raw_state()
+    monkeypatch.setattr(st.config, "elastic", True)
+    monkeypatch.setattr(st.config, "stall_shutdown_seconds", 0.2)
+    monkeypatch.setattr(st.config, "stall_warning_seconds", 0.05)
+    monkeypatch.setattr(st, "stall_inspector", PyStallInspector(0.05, 0.2))
+    release = threading.Event()
+    with pytest.raises(HorovodInternalError):
+        collectives._guarded_wait("never.completes",
+                                  lambda: release.wait(30.0))
+    release.set()
