@@ -9,15 +9,18 @@
 #   make chaos       fault-injection suite: elastic jobs under injected
 #                    rendezvous outages / worker kills / flapping hosts
 #                    (tests marked `faults`; see docs/resilience.md)
+#   make metrics     observability smoke: registry/exporter units + a
+#                    scraped 2-process elastic job (docs/observability.md)
+#   make lint        static checks (env-knob docs drift, scripts/)
 #   make native      build the native control-plane library
 #   make bench       one-line JSON benchmark (real accelerator if present)
 
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint metrics
 
-test: test-unit test-multiprocess test-e2e chaos entry
+test: lint test-unit test-multiprocess test-e2e chaos entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -37,6 +40,13 @@ test-e2e:
 # already run in test-unit, so `make test` doesn't run them twice.
 chaos:
 	$(PYTEST) tests/test_faults.py --run-faults -m faults
+
+metrics:
+	$(PYTEST) tests/test_metrics.py tests/test_metrics_e2e.py \
+	    tests/test_timeline.py
+
+lint:
+	$(PYTHON) scripts/check_env_docs.py
 
 entry:
 	$(PYTHON) __graft_entry__.py

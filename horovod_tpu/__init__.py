@@ -53,6 +53,23 @@ from horovod_tpu import elastic  # noqa: F401  (hvd.elastic.run / State)
 __version__ = "0.1.0"
 
 
+def metrics() -> dict:
+    """This process's metrics registry as a plain-JSON snapshot
+    (docs/observability.md has the catalog). Works before init();
+    after init() the snapshot carries this process's rank."""
+    from horovod_tpu.core import topology
+    from horovod_tpu.observability import metrics as m
+    return m.registry().snapshot(topology.rank_or_none())
+
+
+def metrics_text() -> str:
+    """This process's metrics in Prometheus text exposition format —
+    what the rendezvous server's `/metrics` route serves job-wide."""
+    from horovod_tpu.core import topology
+    from horovod_tpu.observability import metrics as m
+    return m.registry().render(topology.rank_or_none())
+
+
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
     """Start runtime timeline capture (reference: operations.cc:1077)."""
     from horovod_tpu.profiler.timeline import Timeline

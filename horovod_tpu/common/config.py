@@ -76,6 +76,13 @@ HOROVOD_CONSISTENCY_TIMEOUT = "HOROVOD_CONSISTENCY_TIMEOUT"
 HOROVOD_NATIVE_KV_ADDR = "HOROVOD_NATIVE_KV_ADDR"
 HOROVOD_NATIVE_KV_PORT = "HOROVOD_NATIVE_KV_PORT"
 
+# Metrics / telemetry (observability/metrics.py, docs/observability.md).
+HOROVOD_METRICS = "HOROVOD_METRICS"
+HOROVOD_METRICS_DUMP = "HOROVOD_METRICS_DUMP"
+HOROVOD_METRICS_DUMP_INTERVAL = "HOROVOD_METRICS_DUMP_INTERVAL"
+HOROVOD_METRICS_PUSH_INTERVAL = "HOROVOD_METRICS_PUSH_INTERVAL"
+HOROVOD_METRICS_LABEL_MAX = "HOROVOD_METRICS_LABEL_MAX"
+
 # Topology / launcher knobs (reference: injected by the launcher,
 # horovod/runner/gloo_run.py:69-75).
 HOROVOD_RANK = "HOROVOD_RANK"
@@ -127,6 +134,16 @@ class Config:
     autotune_steps_per_sample: int = 10
     autotune_bayes_opt_max_samples: int = 20
     autotune_gaussian_process_noise: float = 0.8
+
+    # Metrics / telemetry (registry in observability/metrics.py; the
+    # registry itself reads HOROVOD_METRICS and HOROVOD_METRICS_LABEL_MAX
+    # directly — it must work in the launcher, which never builds a
+    # Config — so those two have no field here; these gate/configure the
+    # worker-side exporter).
+    metrics_enabled: bool = True
+    metrics_dump: str = ""
+    metrics_dump_interval: float = 30.0
+    metrics_push_interval: float = 5.0
 
     # Stall inspector
     stall_check_disable: bool = False
@@ -192,6 +209,12 @@ class Config:
                 HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20),
             autotune_gaussian_process_noise=_env_float(
                 HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8),
+            metrics_enabled=_env_bool(HOROVOD_METRICS, True),
+            metrics_dump=os.environ.get(HOROVOD_METRICS_DUMP, ""),
+            metrics_dump_interval=_env_float(
+                HOROVOD_METRICS_DUMP_INTERVAL, 30.0),
+            metrics_push_interval=_env_float(
+                HOROVOD_METRICS_PUSH_INTERVAL, 5.0),
             stall_check_disable=_env_bool(HOROVOD_STALL_CHECK_DISABLE),
             stall_warning_seconds=_env_float(
                 HOROVOD_STALL_CHECK_TIME_SECONDS, DEFAULT_STALL_WARNING_SECONDS),

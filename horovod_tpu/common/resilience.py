@@ -42,6 +42,34 @@ from horovod_tpu.common.config import _env_float, _env_int
 from horovod_tpu.common.exceptions import (CircuitOpenError, HorovodTpuError,
                                            RetryError)
 
+_mx = None
+
+
+def _metrics():
+    """Lazy retry/breaker instrument handles (observability/metrics.py;
+    refreshed if the registry is reset under test). Series are touched at
+    policy creation so a healthy job still scrapes explicit zeros for
+    its retry counters instead of an absent metric."""
+    global _mx
+    from horovod_tpu.observability import metrics as m
+    reg = m.registry()
+    if _mx is None or _mx[0] is not reg:
+        _mx = (reg, {
+            "retries": reg.counter(
+                "horovod_retry_attempts_total",
+                "Retries performed after a transient failure",
+                labelnames=("policy",)),
+            "exhausted": reg.counter(
+                "horovod_retry_exhausted_total",
+                "RetryError raises (attempt or deadline budget spent)",
+                labelnames=("policy",)),
+            "breaker": reg.counter(
+                "horovod_circuit_transitions_total",
+                "CircuitBreaker state transitions",
+                labelnames=("state",)),
+        })
+    return _mx[1]
+
 
 def is_transient(e: BaseException) -> bool:
     """Default retryable predicate: transport-level failures and HTTP 5xx.
@@ -91,6 +119,15 @@ class RetryPolicy:
     jitter: float = 0.5
     deadline: Optional[float] = 30.0
     retryable: Callable[[BaseException], bool] = is_transient
+    # Metrics label for this policy's retry/exhaustion counters; ""
+    # disables per-policy instrumentation (ad-hoc inline policies).
+    name: str = ""
+
+    def __post_init__(self) -> None:
+        if self.name:
+            mx = _metrics()
+            mx["retries"].labels(policy=self.name)
+            mx["exhausted"].labels(policy=self.name)
 
     @staticmethod
     def from_env(prefix: str = "HOROVOD_RETRY", **defaults) -> "RetryPolicy":
@@ -144,6 +181,7 @@ class RetryPolicy:
         start = time.monotonic()
         schedule = self.delays(rng)
         attempt = 0
+        mx = _metrics() if self.name else None
         while True:
             attempt += 1
             try:
@@ -154,16 +192,22 @@ class RetryPolicy:
                 try:
                     delay = next(schedule)
                 except StopIteration:
+                    if mx is not None:
+                        mx["exhausted"].labels(policy=self.name).inc()
                     raise RetryError(
                         f"retries exhausted after {attempt} attempt(s): "
                         f"{e}") from e
                 if self.deadline is not None:
                     remaining = self.deadline - (time.monotonic() - start)
                     if remaining <= 0:
+                        if mx is not None:
+                            mx["exhausted"].labels(policy=self.name).inc()
                         raise RetryError(
                             f"retry deadline {self.deadline}s exceeded "
                             f"after {attempt} attempt(s): {e}") from e
                     delay = min(delay, remaining)
+                if mx is not None:
+                    mx["retries"].labels(policy=self.name).inc()
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
                 time.sleep(delay)
@@ -173,11 +217,12 @@ class RetryPolicy:
 # takes O(100ms) on loopback and O(s) across a pod; 8 attempts over ~6 s of
 # backoff (cap 1 s) rides out a restart without hammering a dead endpoint.
 KV_RETRY_DEFAULTS = dict(max_attempts=8, base_delay=0.05, max_delay=1.0,
-                         deadline=30.0)
+                         deadline=30.0, name="kv")
 # Discovery scripts flake for longer (cloud API hiccups); cap higher and
 # let the driver loop re-arm the schedule — see ElasticDriver._discover_loop.
 DISCOVERY_RETRY_DEFAULTS = dict(max_attempts=6, base_delay=0.5,
-                                max_delay=10.0, deadline=60.0)
+                                max_delay=10.0, deadline=60.0,
+                                name="discovery")
 
 
 def kv_retry_policy(**overrides) -> RetryPolicy:
@@ -244,16 +289,23 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            reopened = self._opened_at is not None
             self._failures = 0
             self._opened_at = None
             self._probing = False
+        if reopened:
+            _metrics()["breaker"].labels(state="closed").inc()
 
     def record_failure(self) -> None:
         with self._lock:
             self._failures += 1
             self._probing = False
+            opened = False
             if self._failures >= self.failure_threshold:
+                opened = self._opened_at is None
                 self._opened_at = self._clock()
+        if opened:
+            _metrics()["breaker"].labels(state="open").inc()
 
     def call(self, fn: Callable, *args, **kwargs):
         if not self.allow():

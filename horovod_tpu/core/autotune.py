@@ -297,6 +297,7 @@ class ParameterManager:
         except Exception:
             pass
         score = s.bytes / max(s.seconds, 1e-12)  # bytes/sec (reference metric)
+        self._observe_sample(s, score)
         if self.warmup_remaining > 0:
             self.warmup_remaining -= 1
             self._current = _Sample(x=s.x)
@@ -316,6 +317,34 @@ class ParameterManager:
         self._current = _Sample(x=cur_x, skip=1 if changed else 0)
         self._maybe_log()
         return changed
+
+    def _observe_sample(self, s: "_Sample", score: float) -> None:
+        """Sample-boundary telemetry (observability/metrics.py): cycle
+        count/duration, achieved bytes/sec, and the knob values currently
+        applied — what a dashboard needs to watch a tune converge."""
+        try:
+            from horovod_tpu.observability import metrics as m
+            reg = m.registry()
+            if not reg.enabled:
+                return
+            reg.counter("horovod_autotune_samples_total",
+                        "Autotune sample windows completed").inc()
+            reg.histogram("horovod_autotune_sample_seconds",
+                          "Accumulated reduction time per sample window",
+                          buckets=m.TIME_BUCKETS).observe(s.seconds)
+            reg.gauge("horovod_autotune_score_bytes_per_sec",
+                      "Last sample window's reduction throughput"
+                      ).set(score)
+            reg.gauge("horovod_autotune_frozen",
+                      "1 once the tuner froze its final choice"
+                      ).set(1.0 if self._frozen else 0.0)
+            chosen = reg.gauge("horovod_autotune_param",
+                               "Currently applied tunable values",
+                               labelnames=("param",))
+            for k in self.knobs:
+                chosen.labels(param=k.name).set(float(k.get(self.cfg)))
+        except Exception:
+            pass  # telemetry must never break the tuner
 
     def _decide(self, x: np.ndarray, score: float):
         """One tuning decision on the deciding rank; returns

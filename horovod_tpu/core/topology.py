@@ -403,9 +403,14 @@ def init(process_sets: Optional[Sequence] = None,
             for ps in process_sets:
                 _state.process_set_table.register(ps)
 
-        if cfg.timeline_path:
+        if cfg.timeline_path and _state.rank == 0:
             # Reference: HOROVOD_TIMELINE auto-starts capture at init
-            # (operations.cc:531); manual hvd.start_timeline also works.
+            # (operations.cc:531) ON RANK 0 — the coordinator writes the
+            # trace (timeline.cc); co-hosted ranks sharing the path would
+            # clobber each other. Gates on the COMPUTED rank (launcher-
+            # less multi-process runs have no HOROVOD_RANK env). Manual
+            # hvd.start_timeline still works on any rank (point it at a
+            # per-rank path).
             try:
                 from horovod_tpu.profiler.timeline import Timeline
                 _state.timeline = Timeline(
@@ -449,6 +454,16 @@ def init(process_sets: Optional[Sequence] = None,
                 from horovod_tpu.common.resilience import PyStallInspector
                 _state.stall_inspector = PyStallInspector(
                     cfg.stall_warning_seconds, cfg.stall_shutdown_seconds)
+
+        # Metrics fan-out (observability/export.py): KV push to the
+        # launcher's /metrics scrape, JSON dumps, timeline counter
+        # tracks. Best-effort — telemetry never blocks init.
+        try:
+            from horovod_tpu.observability import export as _mexport
+            _mexport.start_exporter(cfg)
+        except Exception as e:
+            from horovod_tpu.common.hvd_logging import get_logger
+            get_logger().warning("metrics exporter not started: %s", e)
 
         from horovod_tpu.common.hvd_logging import get_logger
         get_logger().info(
@@ -520,6 +535,15 @@ def _start_stall_watch(si, cfg: Config) -> None:
                             who = f"; rank(s) {lag} have not arrived"
                 except Exception:
                     pass
+                try:
+                    from horovod_tpu.observability import metrics as _m
+                    _m.registry().counter(
+                        "horovod_stall_warnings_total",
+                        "Stall warnings",
+                        labelnames=("source",)).labels(
+                            source="watcher").inc()
+                except Exception:
+                    pass
                 get_logger().warning(
                     "One or more collectives stalled for over %.0fs: %s — "
                     "some ranks may not have reached them%s "
@@ -558,6 +582,11 @@ def shutdown() -> None:
     with _state.lock:
         if not _state.initialized:
             return
+        try:  # final metrics flush while rank/timeline are still valid
+            from horovod_tpu.observability import export as _mexport
+            _mexport.stop_exporter()
+        except Exception:
+            pass
         if _state.timeline is not None:
             _state.timeline.shutdown()
         from horovod_tpu.core import consistency as _cc

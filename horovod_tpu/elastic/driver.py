@@ -28,6 +28,38 @@ from horovod_tpu.elastic.discovery import HostManager
 from horovod_tpu.elastic.registration import WorkerStateRegistry
 from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
 
+_mx_cache = None
+
+
+def _mx():
+    """Launcher-side elastic telemetry (observability/metrics.py) —
+    served to scrapers by the rendezvous server's /metrics route, which
+    runs in this same launcher process."""
+    global _mx_cache
+    from horovod_tpu.observability import metrics as m
+    reg = m.registry()
+    if _mx_cache is None or _mx_cache[0] is not reg:
+        _mx_cache = (reg, {
+            "rounds": reg.counter("horovod_elastic_rounds_total",
+                                  "Rendezvous rounds started"),
+            "resets": reg.counter("horovod_elastic_resets_total",
+                                  "Host-change resets processed"),
+            "spawned": reg.counter("horovod_elastic_workers_spawned_total",
+                                   "Worker processes spawned"),
+            "failures": reg.counter(
+                "horovod_elastic_worker_failures_total",
+                "Worker exits with non-zero status"),
+            "blacklists": reg.counter(
+                "horovod_elastic_host_blacklists_total",
+                "Hosts blacklisted after a failure"),
+            "disc_fail": reg.counter(
+                "horovod_elastic_discovery_failures_total",
+                "Host-discovery poll failures"),
+            "world": reg.gauge("horovod_elastic_world_size",
+                               "Workers in the current round"),
+        })
+    return _mx_cache[1]
+
 
 @dataclasses.dataclass
 class _Worker:
@@ -145,6 +177,7 @@ class ElasticDriver:
                 wait = self.discovery_interval
             except Exception as e:
                 self.discovery_failures += 1
+                _mx()["disc_fail"].inc()
                 if backoff is None:
                     backoff = self.discovery_retry.delays()
                 try:
@@ -234,6 +267,7 @@ class ElasticDriver:
             self._round_spawned = len(slots)
             self._round_failed = 0
             self._round_succeeded = 0
+            mx = _mx()
             for slot in slots:
                 key = (slot.hostname, slot.local_rank)
                 if key in survivors:
@@ -244,6 +278,9 @@ class ElasticDriver:
                 else:
                     handle = self.spawn_fn(slot, round_id)
                     self._workers[slot.rank] = _Worker(slot, handle, round_id)
+                    mx["spawned"].inc()
+            mx["rounds"].inc()
+            mx["world"].set(len(slots))
 
     def reap_leaving(self) -> None:
         """Drop leaving workers that exited; force-stop stragglers past the
@@ -274,6 +311,7 @@ class ElasticDriver:
                 self.consecutive_failed_rounds = 0
             return
         self.registry.record_failure(rank)
+        _mx()["failures"].inc()
         with self._lock:
             self._round_failed += 1
             if (self._round_succeeded == 0
@@ -281,6 +319,7 @@ class ElasticDriver:
                 self.consecutive_failed_rounds += 1
         if host_failure:
             self.hosts.blacklist(w.slot.hostname)
+            _mx()["blacklists"].inc()
         self._host_change.set()
 
     # ------------------------------------------------------------------ run
@@ -305,6 +344,7 @@ class ElasticDriver:
             return False
         self._host_change.clear()
         self._resets += 1
+        _mx()["resets"].inc()
         if self.reset_limit is not None and self._resets > self.reset_limit:
             raise ResetLimitExceededError(
                 f"elastic reset limit {self.reset_limit} exceeded after "

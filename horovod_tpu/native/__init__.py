@@ -75,6 +75,13 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_char, ctypes.c_longlong, ctypes.c_longlong,
             ctypes.c_int, ctypes.c_int]
         lib.hvdn_timeline_close.argtypes = [ctypes.c_void_p]
+        try:  # stale prebuilt .so without counter-track support
+            lib.hvdn_timeline_emit_counter.restype = ctypes.c_int
+            lib.hvdn_timeline_emit_counter.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_double, ctypes.c_longlong]
+        except AttributeError:
+            pass
         lib.hvdn_stall_new.restype = ctypes.c_void_p
         lib.hvdn_stall_new.argtypes = [ctypes.c_double, ctypes.c_double]
         lib.hvdn_stall_free.argtypes = [ctypes.c_void_p]
@@ -231,6 +238,14 @@ class NativeTimeline:
         self._lib.hvdn_timeline_emit(
             self._h, name.encode(), cat.encode(), phase.encode(),
             ts_us, dur_us, pid, tid)
+
+    def emit_counter(self, name: str, series: str, value: float,
+                     ts_us: int) -> None:
+        """Chrome `"ph":"C"` counter sample (timeline counter tracks)."""
+        fn = getattr(self._lib, "hvdn_timeline_emit_counter", None)
+        if fn is not None:
+            fn(self._h, name.encode(), series.encode(), float(value),
+               ts_us)
 
     def close(self) -> None:
         if self._h:

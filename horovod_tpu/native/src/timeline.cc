@@ -20,12 +20,14 @@ namespace hvdn {
 
 struct Event {
   char name[64];
-  char cat[24];
-  char phase;  // 'B' begin, 'E' end, 'X' complete, 'i' instant, 'M' meta
+  char cat[24];  // for phase 'C': the counter series name (args key)
+  char phase;  // 'B' begin, 'E' end, 'X' complete, 'i' instant, 'M' meta,
+               // 'C' counter
   int64_t ts_us;
   int64_t dur_us;
   int32_t pid;
   int32_t tid;
+  double value;  // phase 'C' only
 };
 
 class Timeline {
@@ -72,13 +74,20 @@ class Timeline {
       std::unique_lock<std::mutex> g(mu_);
       cv_.wait_for(g, std::chrono::milliseconds(100),
                    [this] { return head_ != tail_ || closing_.load(); });
+      bool drained = false;
       while (tail_ != head_) {
         Event e = ring_[tail_];
         tail_ = (tail_ + 1) % capacity_;
         g.unlock();
         WriteEvent(e, first);
         first = false;
+        drained = true;
         g.lock();
+      }
+      if (drained) {
+        // Durability: push the batch into the OS page cache so a
+        // SIGKILL'd run still leaves a loadable (truncated-array) trace.
+        std::fflush(f_);
       }
       if (closing_.load() && head_ == tail_) break;
     }
@@ -100,7 +109,13 @@ class Timeline {
     JsonEscape(e.name, name, sizeof(name));
     JsonEscape(e.cat, cat, sizeof(cat));
     if (!first) std::fputs(",\n", f_);
-    if (e.phase == 'X') {
+    if (e.phase == 'C') {
+      std::fprintf(f_,
+                   "{\"name\":\"%s\",\"ph\":\"C\",\"ts\":%lld,"
+                   "\"pid\":%d,\"args\":{\"%s\":%.17g}}",
+                   name, static_cast<long long>(e.ts_us), e.pid, cat,
+                   e.value);
+    } else if (e.phase == 'X') {
       std::fprintf(f_,
                    "{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"X\","
                    "\"ts\":%lld,\"dur\":%lld,\"pid\":%d,\"tid\":%d}",
@@ -148,6 +163,17 @@ int hvdn_timeline_emit(void* h, const char* name, const char* cat, char phase,
   e.dur_us = dur_us;
   e.pid = pid;
   e.tid = tid;
+  return static_cast<hvdn::Timeline*>(h)->Emit(e) ? 0 : -1;
+}
+
+int hvdn_timeline_emit_counter(void* h, const char* name, const char* series,
+                               double value, long long ts_us) {
+  hvdn::Event e{};
+  std::snprintf(e.name, sizeof(e.name), "%s", name);
+  std::snprintf(e.cat, sizeof(e.cat), "%s", series);
+  e.phase = 'C';
+  e.ts_us = ts_us;
+  e.value = value;
   return static_cast<hvdn::Timeline*>(h)->Emit(e) ? 0 : -1;
 }
 

@@ -1,0 +1,16 @@
+"""Cluster-wide metrics & telemetry.
+
+`metrics` — the process-local registry (Counter/Gauge/Histogram, no-op
+shell under HOROVOD_METRICS=0) and Prometheus text rendering.
+`export` — the background fan-out: rendezvous KV push (feeds the
+launcher's `/metrics` scrape route), periodic JSON dumps, and Chrome-
+trace counter tracks. See docs/observability.md for the metric catalog.
+"""
+
+from horovod_tpu.observability.metrics import (  # noqa: F401
+    COUNT_BUCKETS, MetricsRegistry, NOOP, SIZE_BUCKETS, TIME_BUCKETS,
+    enabled, parse_snapshot, registry, render_snapshots, reset_for_tests,
+)
+from horovod_tpu.observability.export import (  # noqa: F401
+    MetricsExporter, start_exporter, stop_exporter,
+)

@@ -1,0 +1,222 @@
+"""Metric export paths: JSON dump, rendezvous KV push, timeline counters.
+
+One background thread per process fans the registry out to whichever
+sinks are configured; every sink failure is swallowed and counted —
+telemetry must never take down training.
+
+* KV push (`HOROVOD_METRICS_PUSH_INTERVAL`, multi-process runs): each
+  worker PUTs its JSON snapshot to the launcher's rendezvous KV under
+  `metrics/rank-<r>`. The server's `/metrics` GET route
+  (runner/rendezvous.py) renders every pushed snapshot plus its own
+  control-plane registry as one Prometheus page, so a single scrape of
+  the launcher sees the whole job — the metrics analog of the reference's
+  rank-0-writes-the-timeline design (timeline.cc).
+* JSON dump (`HOROVOD_METRICS_DUMP` / `HOROVOD_METRICS_DUMP_INTERVAL`,
+  offline runs): atomic snapshot file per interval; `{rank}` in the path
+  expands per process so co-hosted workers do not clobber each other.
+* Timeline counter tracks: every tick emits each counter/gauge family
+  into the live Timeline as a `"ph":"C"` event, so Perfetto shows counter
+  tracks alongside the ALLREDUCE/COMPILE spans (the hot-path
+  instrumentation in ops/collectives.py additionally emits per-call byte
+  counters for step-grained resolution).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.observability import metrics as metrics_mod
+
+SCOPE = "metrics"  # rendezvous KV scope for pushed snapshots
+
+
+class MetricsExporter:
+    """Background fan-out thread. `rank_fn`/`timeline_fn` are lazy so the
+    exporter can start before topology init has settled; `kv_factory` is
+    injectable for tests."""
+
+    def __init__(self, cfg: Config,
+                 rank_fn: Callable[[], Optional[int]],
+                 timeline_fn: Callable[[], object],
+                 kv_factory: Optional[Callable[[], object]] = None) -> None:
+        self.cfg = cfg
+        self.rank_fn = rank_fn
+        self.timeline_fn = timeline_fn
+        self._kv_factory = kv_factory
+        self._kv = None
+        self._kv_dead = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._next_dump = 0.0
+        self._next_push = 0.0
+        reg = metrics_mod.registry()
+        self._push_failures = reg.counter(
+            "horovod_metrics_push_failures_total",
+            "Snapshot pushes to the rendezvous KV that failed")
+
+    # ---------------------------------------------------------------- kv
+    def _kv_client(self):
+        if self._kv is None and not self._kv_dead:
+            try:
+                if self._kv_factory is not None:
+                    self._kv = self._kv_factory()
+                elif self.cfg.rendezvous_addr:
+                    from horovod_tpu.common import resilience
+                    from horovod_tpu.runner.rendezvous import KVClient
+                    # Telemetry gets a SHORT budget on BOTH axes — the
+                    # retry deadline AND the per-request socket timeout
+                    # (a blackholed connect otherwise blocks ~30s on its
+                    # first attempt): a push that can't land in ~2s is
+                    # dropped, the next tick supersedes it. Never seconds
+                    # of blocking inside a shutdown flush.
+                    self._kv = KVClient(
+                        self.cfg.rendezvous_addr,
+                        self.cfg.rendezvous_port,
+                        retry_policy=resilience.kv_retry_policy(
+                            max_attempts=2, deadline=2.0),
+                        request_timeout=2.0)
+                else:
+                    self._kv_dead = True
+            except Exception:
+                self._kv_dead = True
+        return self._kv
+
+    # -------------------------------------------------------------- sinks
+    def _dump(self, snap: dict) -> None:
+        path = self.cfg.metrics_dump
+        if "{rank}" in path:
+            path = path.format(rank=snap.get("rank") or 0)
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _push(self, snap: dict) -> None:
+        kv = self._kv_client()
+        if kv is None:
+            return
+        rank = snap.get("rank")
+        if rank is None:
+            # Mid-reset (topology torn down): a push keyed by anything but
+            # rank would linger forever and render WITHOUT a rank label —
+            # co-hosted workers would then publish duplicate series and
+            # poison every later scrape. Skip; the next tick supersedes.
+            return
+        try:
+            kv.put(SCOPE, f"rank-{rank}", json.dumps(snap).encode())
+        except Exception:
+            self._push_failures.inc()
+
+    def _timeline_counters(self, snap: dict) -> None:
+        tl = self.timeline_fn()
+        if tl is None or not getattr(tl, "counter", None):
+            return
+        for name, fam in snap.get("families", {}).items():
+            if fam["kind"] not in ("counter", "gauge"):
+                continue
+            values = {}
+            for s in fam.get("series", []):
+                series = ",".join(s["labels"]) or "value"
+                values[series] = s["value"]
+            if values:
+                try:
+                    tl.counter(name, values)
+                except Exception:
+                    return  # timeline shut down mid-tick
+
+    def tick(self, now: Optional[float] = None, force: bool = False) -> None:
+        """One export pass (public for tests and the final shutdown
+        flush). `force` ignores the per-sink schedules."""
+        now = time.monotonic() if now is None else now
+        reg = metrics_mod.registry()
+        if not reg.enabled:
+            return
+        snap = None
+        if self.cfg.metrics_dump and (force or now >= self._next_dump):
+            self._next_dump = now + max(self.cfg.metrics_dump_interval, 0.1)
+            snap = reg.snapshot(self.rank_fn())
+            self._dump(snap)
+        if force or now >= self._next_push:
+            self._next_push = now + max(self.cfg.metrics_push_interval, 0.1)
+            snap = snap or reg.snapshot(self.rank_fn())
+            self._push(snap)
+            self._timeline_counters(snap)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hvd-metrics-export",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        period = max(min(self.cfg.metrics_push_interval,
+                         self.cfg.metrics_dump_interval
+                         if self.cfg.metrics_dump else 1e9) / 2.0, 0.1)
+        while not self._stop.wait(period):
+            try:
+                self.tick()
+            except Exception:
+                pass
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if final_flush:
+            try:
+                self.tick(force=True)
+            except Exception:
+                pass
+
+
+_exporter: Optional[MetricsExporter] = None
+_exporter_lock = threading.Lock()
+
+
+def start_exporter(cfg: Config) -> Optional[MetricsExporter]:
+    """Idempotent process-wide exporter start (called from hvd.init();
+    elastic in-process re-inits reuse the running thread). Starts only
+    when there is a sink to feed: a dump path, a rendezvous to push to,
+    or a live timeline for counter tracks."""
+    global _exporter
+    if not (cfg.metrics_enabled and metrics_mod.registry().enabled):
+        return None
+    with _exporter_lock:
+        if _exporter is not None:
+            return _exporter
+        from horovod_tpu.core import topology
+
+        def rank_fn() -> Optional[int]:
+            return topology.rank_or_none()
+
+        def timeline_fn():
+            return topology.raw_state().timeline
+
+        if not (cfg.metrics_dump or cfg.rendezvous_addr
+                or cfg.timeline_path):
+            return None
+        _exporter = MetricsExporter(cfg, rank_fn, timeline_fn)
+        _exporter.start()
+        return _exporter
+
+
+def stop_exporter() -> None:
+    """Final flush + thread stop (called from hvd.shutdown())."""
+    global _exporter
+    with _exporter_lock:
+        exp, _exporter = _exporter, None
+    if exp is not None:
+        exp.stop()

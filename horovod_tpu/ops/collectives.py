@@ -36,10 +36,11 @@ stacked the same way (sharded over the mesh, so they stay distributed).
 from __future__ import annotations
 
 import collections
-import contextlib
 import functools
+import math
 import threading
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -141,12 +142,14 @@ class StallWatchdog:
                 age = _time.monotonic() - start
                 if not warned and age >= self.warn_sec:
                     warned = True
+                    _mx()["stall_warn"].labels(source="watchdog").inc()
                     get_logger().warning(
                         "collective '%s' stalled for %.1fs "
                         "(HOROVOD_STALL_CHECK_TIME_SECONDS=%.0f)",
                         name, age, self.warn_sec)
                 if self.shutdown_sec > 0 and age >= self.shutdown_sec:
                     stalled, _ = self.inspector.check()
+                    _mx()["stall_shut"].inc()
                     raise HorovodInternalError(
                         f"collective '{name}' stalled past "
                         f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
@@ -233,7 +236,9 @@ class _CompiledCache:
     def get_or_build(self, key: Any, builder: Callable[[], Callable]) -> Callable:
         if key in self._cache:
             self._cache.move_to_end(key)
+            _mx()["cache"].labels(event="hit").inc()
             return self._cache[key]
+        _mx()["cache"].labels(event="miss").inc()
         fn = self._compile_timed(builder(), str(key[0]))
         self._cache[key] = fn
         cap = self._capacity()
@@ -630,7 +635,11 @@ def allreduce(tensor: Any,
             out_shardings=out_sh))
         _consistency(f"allreduce(shape={(k,) + shape},dtype={dtype},"
                      f"op={int(rop)},ps={ps.process_set_id})", ps)
-        with _timeline_span(name or "allreduce", "ALLREDUCE"):
+        with _instrument(name or "allreduce", "ALLREDUCE",
+                         nbytes_fn=lambda: (
+                             (math.prod(shape) * k *
+                              _dtype_info(dtype)[0]),
+                             _dtype_info(dtype)[1])):
             return _execute(fn, jnp.asarray(tensor))
     g, stacked = _to_global(tensor, ps)
     key = ("ar", g.shape, str(g.dtype), int(rop), ps.cache_token,
@@ -645,7 +654,7 @@ def allreduce(tensor: Any,
             ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
     _consistency(f"allreduce(shape={g.shape},dtype={g.dtype},op={int(rop)},"
                  f"ps={ps.process_set_id})", ps)
-    with _timeline_span(name or "allreduce", "ALLREDUCE"):
+    with _instrument(name or "allreduce", "ALLREDUCE", arrays=(g,)):
         return _from_global(_execute(fn, g), stacked)
 
 
@@ -695,7 +704,12 @@ def grouped_allreduce(tensors: Sequence[Any],
         _consistency(f"grouped_allreduce(n={len(tensors)},shapes="
                      f"{[(k,) + s for s in shapes]},op={int(rop)},"
                      f"ps={ps.process_set_id})", ps)
-        with _timeline_span(name or "grouped_allreduce", "ALLREDUCE"):
+        with _instrument(name or "grouped_allreduce", "ALLREDUCE",
+                         ntensors=len(tensors),
+                         nbytes_fn=lambda: (
+                             sum(math.prod(s) * k * _dtype_info(d)[0]
+                                 for s, d in zip(shapes, dtypes)),
+                             dtypes[0] if dtypes else "")):
             outs = _execute(fn, *[jnp.asarray(t) for t in tensors])
         return list(outs)
     gs, stackeds = _lift_group(tensors, ps)
@@ -734,7 +748,8 @@ def grouped_allreduce(tensors: Sequence[Any],
     _consistency(f"grouped_allreduce(n={len(gs)},shapes="
                  f"{[tuple(g.shape) for g in gs]},op={int(rop)},"
                  f"ps={ps.process_set_id})", ps)
-    with _timeline_span(name or "grouped_allreduce", "ALLREDUCE"):
+    with _instrument(name or "grouped_allreduce", "ALLREDUCE",
+                     arrays=tuple(gs), ntensors=len(gs)):
         outs = _execute(fn, *gs)
     return [_from_global(o, s) for o, s in zip(outs, stackeds)]
 
@@ -764,7 +779,7 @@ def broadcast(tensor: Any, root_rank: int,
     fn = _cache.get_or_build(key, build)
     _consistency(f"broadcast(shape={g.shape},dtype={g.dtype},root={root},"
                  f"ps={ps.process_set_id})", ps)
-    with _timeline_span(name or "broadcast", "BROADCAST"):
+    with _instrument(name or "broadcast", "BROADCAST", arrays=(g,)):
         return _from_global(_execute(fn, g), stacked)
 
 
@@ -847,7 +862,7 @@ def allgather(tensor: Any, name: Optional[str] = None,
                 [g, jnp.zeros((g.shape[0], pad) + g.shape[2:], g.dtype)], axis=1)
         key = ("ag", g.shape, str(g.dtype), tuple(sizes), ps.cache_token)
     fn = _cache.get_or_build(key, build)
-    with _timeline_span(name or "allgather", "ALLGATHER"):
+    with _instrument(name or "allgather", "ALLGATHER", arrays=(g,)):
         return _from_global(_execute(fn, g), stacked)
 
 
@@ -885,7 +900,8 @@ def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
     fn = _cache.get_or_build(key, build)
     _consistency(f"reducescatter(shape={g.shape},dtype={g.dtype},"
                  f"op={int(rop)},ps={ps.process_set_id})", ps)
-    with _timeline_span(name or "reducescatter", "REDUCESCATTER"):
+    with _instrument(name or "reducescatter", "REDUCESCATTER",
+                     arrays=(g,)):
         out = _execute(fn, g)
     return _rs_trim(out, stacked, d0, k, ps)
 
@@ -972,7 +988,8 @@ def grouped_reducescatter(tensors: Sequence[Any], op: Any = T.ReduceOp.AVERAGE,
     _consistency(f"grouped_reducescatter(n={len(gs)},shapes="
                  f"{[tuple(g.shape) for g in gs]},op={int(rop)},"
                  f"ps={ps.process_set_id})", ps)
-    with _timeline_span(name or "grouped_reducescatter", "REDUCESCATTER"):
+    with _instrument(name or "grouped_reducescatter", "REDUCESCATTER",
+                     arrays=tuple(gs), ntensors=len(gs)):
         outs = _execute(fn, *gs)
     return [_rs_trim(o, st, d0, k, ps)
             for o, st, d0 in zip(outs, stackeds, d0s)]
@@ -1058,7 +1075,8 @@ def grouped_allgather(tensors: Sequence[Any],
         return jax.jit(fn)
 
     fn = _cache.get_or_build(key, build)
-    with _timeline_span(name or "grouped_allgather", "ALLGATHER"):
+    with _instrument(name or "grouped_allgather", "ALLGATHER",
+                     arrays=tuple(padded), ntensors=len(padded)):
         outs = _execute(fn, *padded)
     return [_from_global(o, st) for o, st in zip(outs, stackeds)]
 
@@ -1142,7 +1160,7 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
         return jax.jit(fn)
 
     fn = _cache.get_or_build(key, build)
-    with _timeline_span(name or "alltoall", "ALLTOALL"):
+    with _instrument(name or "alltoall", "ALLTOALL", arrays=(g,)):
         out = _execute(fn, g)  # (k_local_rows, k, max_chunk, *rest)
 
     def trim(rank_in_set: int, rowdata):
@@ -1185,7 +1203,7 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     # what the stall inspector watches (reference: stall_inspector.cc).
     _stall_submit("barrier")
     try:
-        with _timeline_span("barrier", "BARRIER"):
+        with _instrument("barrier", "BARRIER"):
             jax.block_until_ready(_execute(fn, g))
     finally:
         _stall_done("barrier")
@@ -1349,18 +1367,138 @@ def _consistency(desc: str, ps: ProcessSet) -> None:
         checker.check(desc, ranks=ranks, group=group)
 
 
-@contextlib.contextmanager
-def _timeline_span(name: str, activity: str):
-    """EXECUTE-style duration span around eager dispatch (reference: the
-    per-tensor op-activity spans, timeline.cc + operations.cc:286-330).
-    Under async dispatch the span covers host-side dispatch; in elastic
-    mode (_execute forces completion) it covers the full collective."""
-    tl = topology.state().timeline
-    if tl is None:
-        yield
-        return
-    tl.span_begin(name, activity)
-    try:
-        yield
-    finally:
-        tl.span_end(name, activity)
+# ---------------------------------------------------------------- metrics
+
+_mx_cache = None
+_cum_bytes: Dict[str, float] = {}
+_cum_lock = threading.Lock()
+_dtype_cache: Dict[Any, Tuple[int, str]] = {}
+
+
+def _dtype_info(dt) -> Tuple[int, str]:
+    """(itemsize, canonical name) memoized per dtype object — np.dtype()
+    construction and str(dtype) cost ~10 us each, too hot for per-call."""
+    info = _dtype_cache.get(dt)
+    if info is None:
+        ndt = np.dtype(dt)
+        info = (ndt.itemsize, str(ndt))
+        _dtype_cache[dt] = info
+    return info
+
+
+def _mx():
+    """Lazy hot-path instrument handles (observability/metrics.py).
+    Cached per registry instance; when metrics are disabled every family
+    is the shared NOOP, so recording costs one no-op method call."""
+    global _mx_cache
+    from horovod_tpu.observability import metrics as m
+    reg = m.registry()
+    if _mx_cache is None or _mx_cache[0] is not reg:
+        _mx_cache = (reg, {
+            "calls": reg.counter(
+                "horovod_collective_calls_total",
+                "Eager collective calls", labelnames=("op", "dtype")),
+            "bytes": reg.counter(
+                "horovod_collective_bytes_total",
+                "Global payload bytes moved by collectives",
+                labelnames=("op", "dtype")),
+            "seconds": reg.histogram(
+                "horovod_collective_seconds",
+                "Host-side wall time per collective call (dispatch under "
+                "async, full completion in elastic mode)",
+                labelnames=("op",), buckets=m.TIME_BUCKETS),
+            "group": reg.histogram(
+                "horovod_grouped_fusion_tensors",
+                "Tensors per grouped (fused) collective call",
+                labelnames=("op",), buckets=m.COUNT_BUCKETS),
+            "cache": reg.counter(
+                "horovod_compile_cache_total",
+                "Compiled-executable cache lookups",
+                labelnames=("event",)),
+            "stall_warn": reg.counter(
+                "horovod_stall_warnings_total",
+                "Stall warnings", labelnames=("source",)),
+            "stall_shut": reg.counter(
+                "horovod_stall_shutdowns_total",
+                "Stall shutdown raises (elastic watchdog)"),
+        })
+    return _mx_cache[1]
+
+
+def _record(activity: str, arrays, nbytes_fn, ntensors, seconds,
+            tl) -> None:
+    """Post-call accounting (metrics enabled only): counters, the wall-
+    time histogram, and a per-op cumulative-bytes counter track in the
+    live timeline so the trace shows byte throughput next to the spans."""
+    op = activity.lower()
+    mx = _mx()
+    nbytes = 0
+    dtype = ""
+    for a in arrays:
+        try:
+            isize, dname = _dtype_info(a.dtype)
+            dtype = dtype or dname
+            nbytes += int(a.size) * isize
+        except Exception:
+            pass
+    if nbytes_fn is not None:
+        try:
+            extra_bytes, extra_dtype = nbytes_fn()
+            nbytes += extra_bytes
+            dtype = dtype or extra_dtype
+        except Exception:
+            pass
+    mx["calls"].labels(op=op, dtype=dtype).inc()
+    if nbytes:
+        mx["bytes"].labels(op=op, dtype=dtype).inc(nbytes)
+    mx["seconds"].labels(op=op).observe(seconds)
+    if ntensors is not None:
+        mx["group"].labels(op=op).observe(ntensors)
+    if tl is not None:
+        with _cum_lock:
+            _cum_bytes[op] = cum = _cum_bytes.get(op, 0.0) + nbytes
+        tl.counter("horovod_collective_bytes_total", {op: cum})
+
+
+class _instrument:
+    """EXECUTE-style timeline span + metrics around eager dispatch
+    (reference: the per-tensor op-activity spans, timeline.cc +
+    operations.cc:286-330). Under async dispatch the measured window
+    covers host-side dispatch; in elastic mode (_execute forces
+    completion) it covers the full collective.
+
+    Byte counts are computed lazily — from `arrays` (already-lifted
+    global payloads) or `nbytes_fn` (fast paths that never materialize a
+    global array) — only when metrics are enabled, so with
+    HOROVOD_METRICS=0 the hot path pays a single branch."""
+
+    __slots__ = ("name", "activity", "arrays", "nbytes_fn", "ntensors",
+                 "tl", "enabled", "t0")
+
+    def __init__(self, name: str, activity: str, arrays: Sequence = (),
+                 nbytes_fn: Optional[Callable] = None,
+                 ntensors: Optional[int] = None) -> None:
+        self.name = name
+        self.activity = activity
+        self.arrays = arrays
+        self.nbytes_fn = nbytes_fn
+        self.ntensors = ntensors
+
+    def __enter__(self) -> "_instrument":
+        from horovod_tpu.observability import metrics as m
+        self.enabled = m.registry().enabled
+        self.tl = topology.state().timeline
+        if self.tl is not None:
+            self.tl.span_begin(self.name, self.activity)
+        self.t0 = time.perf_counter() if self.enabled else 0.0
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self.enabled:
+            dt = time.perf_counter() - self.t0
+        if self.tl is not None:
+            self.tl.span_end(self.name, self.activity)
+        if self.enabled:
+            _record(self.activity, self.arrays, self.nbytes_fn,
+                    self.ntensors, dt, self.tl)
+        return False

@@ -112,8 +112,31 @@ def aggregate_xspace(xspace, reps: int = 1,
                          total_ms=total, reps=reps)
 
 
+def _import_xplane_pb2():
+    """The xplane protobuf bindings are an OPTIONAL dependency: only
+    `load_xspace` needs them (parsing a trace off disk);
+    `aggregate_xspace` and `classify` work on any object with the xplane
+    shape and import nothing. Probed under both packagings, with an
+    actionable error instead of a bare ImportError."""
+    errors = []
+    for mod in ("tensorflow.tsl.profiler.protobuf.xplane_pb2",
+                "tsl.profiler.protobuf.xplane_pb2"):
+        try:
+            import importlib
+            return importlib.import_module(mod)
+        except ImportError as e:
+            errors.append(f"{mod}: {e}")
+    raise ImportError(
+        "load_xspace needs the XPlane protobuf bindings, which ship with "
+        "TensorFlow (tensorflow.tsl.profiler.protobuf.xplane_pb2) or the "
+        "standalone `tsl` package — neither is installed. Install one "
+        "(e.g. `pip install tensorflow-cpu`) or parse the .xplane.pb "
+        "yourself and call aggregate_xspace(), which has no TF "
+        "dependency. Probed: " + "; ".join(errors))
+
+
 def load_xspace(trace_dir: str):
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    xplane_pb2 = _import_xplane_pb2()
 
     paths = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb",
                              recursive=True))

@@ -11,6 +11,18 @@ collectives; the interesting host-side spans are ENQUEUE (eager call),
 COMPILE (executable-cache miss) and EXECUTE. Device-side detail comes from
 `jax.profiler` (XPlane); `start_jax_trace` bridges the two. The writer-thread
 + queue structure is preserved so tracing never blocks the hot path.
+
+Durability: the Python writer streams events to disk incrementally (the
+file is flushed at least every `_FLUSH_EVENTS` events / `_FLUSH_SECONDS`
+seconds), so a SIGKILL'd or stall-shutdown run still leaves a loadable
+trace — Perfetto and about:tracing both accept a trace whose JSON array
+is missing its closing bracket, and `recover_trace()` repairs one into
+strict JSON. Exactly the run that dies is the run whose trace you need.
+
+Counter tracks: `counter()` emits Chrome `"ph":"C"` events, rendering as
+counter tracks alongside the spans (fed by the metrics plane —
+observability/export.py periodic tracks plus ops/collectives.py per-call
+byte counters).
 """
 
 from __future__ import annotations
@@ -20,12 +32,19 @@ import os
 import queue
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 # Chrome trace phase constants
 _PH_COMPLETE = "X"
 _PH_INSTANT = "i"
 _PH_METADATA = "M"
+_PH_COUNTER = "C"
+
+_FLUSH_EVENTS = 32     # flush after this many buffered events...
+_FLUSH_SECONDS = 0.5   # ...or this much time, whichever first
+
+_HEADER = '{"displayTimeUnit":"ms","traceEvents":[\n'
+_FOOTER = "\n]}\n"
 
 
 class Timeline:
@@ -40,7 +59,10 @@ class Timeline:
         self._active = False
         self._t0 = time.monotonic_ns()
         self._lock = threading.Lock()
-        self._pending_spans: dict = {}
+        # Guarded by _lock: span_begin/span_end may race across threads
+        # (concurrent collectives from frontends' async handles), and a
+        # plain dict read-modify-write drops or corrupts spans.
+        self._pending_spans: Dict[tuple, float] = {}
         self._native = None
         self._use_native = use_native
 
@@ -106,14 +128,16 @@ class Timeline:
                     "ts": self._now_us(), "name": f"{activity}:{name}"})
 
     def span_begin(self, name: str, activity: str) -> None:
-        self._pending_spans[(name, activity)] = self._now_us()
+        t = self._now_us()
+        with self._lock:
+            self._pending_spans[(name, activity)] = t
 
     def span_end(self, name: str, activity: str) -> None:
-        t0 = self._pending_spans.pop((name, activity), None)
-        if t0 is None:
-            return
         t1 = self._now_us()
         with self._lock:
+            t0 = self._pending_spans.pop((name, activity), None)
+            if t0 is None:
+                return
             if self._native is not None:
                 self._native.emit(f"{activity}:{name}", activity, "X",
                                   int(t0), dur_us=int(t1 - t0))
@@ -121,23 +145,92 @@ class Timeline:
         self._emit({"ph": _PH_COMPLETE, "pid": 0, "tid": 0, "ts": t0,
                     "dur": t1 - t0, "name": activity, "args": {"tensor": name}})
 
+    def counter(self, name: str, values: Dict[str, float]) -> None:
+        """Emit a `"ph":"C"` counter sample: one track named `name`, one
+        series per key of `values` (Chrome renders args keys as stacked
+        series)."""
+        ts = self._now_us()
+        with self._lock:
+            if self._native is not None:
+                emit_counter = getattr(self._native, "emit_counter", None)
+                if emit_counter is not None:
+                    for series, v in values.items():
+                        emit_counter(name, series, float(v), int(ts))
+                # An older .so without the counter symbol drops counters
+                # rather than corrupting the native writer's file.
+                return
+        self._emit({"ph": _PH_COUNTER, "pid": 0, "ts": ts, "name": name,
+                    "args": {k: float(v) for k, v in values.items()}})
+
     def mark_cycle(self) -> None:
         if self.mark_cycles:
             self.record_instant("cycle", "CYCLE_START")
 
     # -- writer thread (reference TimelineWriter::WriterLoop) --------------
     def _writer_loop(self) -> None:
-        events = []
-        while True:
-            ev = self._queue.get()
-            if ev is None:
-                break
-            events.append(ev)
-        tmp = self.path + ".tmp"
+        """Stream events to disk with bounded buffering (see module
+        docstring: a killed run keeps everything up to the last flush)."""
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-        os.replace(tmp, self.path)
+        f = open(self.path, "w")
+        f.write(_HEADER)
+        first = True
+        pending = 0
+        last_flush = time.monotonic()
+        try:
+            while True:
+                try:
+                    ev = self._queue.get(timeout=_FLUSH_SECONDS / 2)
+                except queue.Empty:
+                    ev = False  # timeout tick: flush check only
+                if ev is None:
+                    break
+                if ev is not False:
+                    if not first:
+                        f.write(",\n")
+                    first = False
+                    f.write(json.dumps(ev))
+                    pending += 1
+                now = time.monotonic()
+                if pending and (pending >= _FLUSH_EVENTS
+                                or now - last_flush >= _FLUSH_SECONDS):
+                    f.flush()
+                    pending = 0
+                    last_flush = now
+            f.write(_FOOTER)
+        finally:
+            f.close()
+
+
+def recover_trace(path: str) -> list:
+    """Load `path`'s traceEvents even if the writer never finalized it
+    (crash/SIGKILL mid-run). The stream may end not just without `]}` but
+    mid-event: stdio auto-flushes its ~8 KiB buffer at byte — not event —
+    boundaries, so a killed run routinely truncates inside an object.
+    Back off to the last complete event before appending the footer.
+    Returns the event list."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except ValueError:
+        try:  # finalizer missing but the last event is complete
+            data = json.loads(text.rstrip().rstrip(",") + _FOOTER)
+        except ValueError:
+            # Truncated mid-event: back off to the previous '}' (a
+            # candidate event end) until the prefix parses. Braces inside
+            # string values just cost extra iterations.
+            data = None
+            end = len(text)
+            while data is None:
+                cut = text.rfind("}", 0, end)
+                if cut <= 0:
+                    raise
+                try:
+                    data = json.loads(
+                        text[:cut + 1].rstrip().rstrip(",") + _FOOTER)
+                except ValueError:
+                    end = cut
+    return data["traceEvents"] if isinstance(data, dict) else data
 
 
 def start_jax_trace(log_dir: str) -> None:

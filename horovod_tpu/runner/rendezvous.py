@@ -15,15 +15,68 @@ When a job secret is set (HOROVOD_SECRET_KEY, reference:
 runner/common/util/secret.py), every request must carry an HMAC digest
 header; unauthenticated requests get 403 — the control plane no longer
 accepts writes from anyone on the network.
+
+Observability: `GET /metrics` serves the whole job's metrics as
+Prometheus text — the launcher's own registry (KV request counts +
+latency, elastic driver counters) merged with every worker snapshot the
+exporters pushed into the `metrics/` scope (observability/export.py), a
+`rank` label distinguishing the series. The route is read-only and
+deliberately exempt from the HMAC check so a stock Prometheus scraper
+can hit it; it exposes telemetry only, never KV contents
+(docs/observability.md).
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from horovod_tpu.runner import secret as secret_mod
+
+METRICS_SCOPE = "metrics"   # KV scope worker snapshots are pushed under
+HOROVOD_RENDEZVOUS_PORT_FILE = "HOROVOD_RENDEZVOUS_PORT_FILE"
+
+_kv_mx = None
+
+
+def _metrics():
+    """Lazy KV-server instrument handles (refreshed if the registry is
+    reset under test)."""
+    global _kv_mx
+    from horovod_tpu.observability import metrics as m
+    reg = m.registry()
+    if _kv_mx is None or _kv_mx[0] is not reg:
+        _kv_mx = (reg, {
+            "requests": reg.counter(
+                "horovod_kv_requests_total",
+                "KV requests served by the rendezvous server",
+                labelnames=("method",)),
+            "seconds": reg.histogram(
+                "horovod_kv_request_seconds",
+                "Rendezvous KV request service time",
+                labelnames=("method",), buckets=m.TIME_BUCKETS),
+            "scrapes": reg.counter(
+                "horovod_metrics_scrapes_total",
+                "GET /metrics scrapes served"),
+        })
+    return _kv_mx[1]
+
+
+def announce_port(port: int) -> None:
+    """Write the rendezvous port to HOROVOD_RENDEZVOUS_PORT_FILE (when
+    set) so out-of-band tooling — a Prometheus scraper, the metrics e2e
+    test — can find the `/metrics` route of a job whose port was
+    OS-assigned."""
+    path = os.environ.get(HOROVOD_RENDEZVOUS_PORT_FILE, "")
+    if not path:
+        return
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+    os.replace(tmp, path)
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -49,6 +102,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_PUT(self):
+        t0 = time.perf_counter()
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
         if not self._authorized(body):
@@ -57,8 +111,12 @@ class _KVHandler(BaseHTTPRequestHandler):
             self.store[self._key()] = body
         self.send_response(200)
         self.end_headers()
+        self._observe("PUT", t0)
 
     def do_GET(self):
+        if self.path == "/metrics":
+            return self._serve_metrics()
+        t0 = time.perf_counter()
         if not self._authorized(b""):
             return self._reject()
         with self.lock:
@@ -66,19 +124,55 @@ class _KVHandler(BaseHTTPRequestHandler):
         if val is None:
             self.send_response(404)
             self.end_headers()
+            self._observe("GET", t0)
             return
         self.send_response(200)
         self.send_header("Content-Length", str(len(val)))
         self.end_headers()
         self.wfile.write(val)
+        self._observe("GET", t0)
 
     def do_DELETE(self):
+        t0 = time.perf_counter()
         if not self._authorized(b""):
             return self._reject()
         with self.lock:
             self.store.pop(self._key(), None)
         self.send_response(200)
         self.end_headers()
+        self._observe("DELETE", t0)
+
+    # -------------------------------------------------------- observability
+    def _observe(self, method: str, t0: float) -> None:
+        try:
+            mx = _metrics()
+            mx["requests"].labels(method=method).inc()
+            mx["seconds"].labels(method=method).observe(
+                time.perf_counter() - t0)
+        except Exception:
+            pass  # telemetry must never fail a control-plane request
+
+    def _serve_metrics(self) -> None:
+        """One Prometheus page for the whole job: launcher registry +
+        every pushed worker snapshot (scope `metrics/`)."""
+        from horovod_tpu.observability import metrics as m
+        _metrics()["scrapes"].inc()
+        reg = m.registry()
+        snaps = [reg.snapshot()] if reg.enabled else []
+        with self.lock:
+            pushed = [v for k, v in sorted(self.store.items())
+                      if k.startswith(METRICS_SCOPE + "/")]
+        for raw in pushed:
+            snap = m.parse_snapshot(raw)
+            if snap is not None:
+                snaps.append(snap)
+        body = m.render_snapshots(snaps).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
 
 class RendezvousServer:
@@ -97,6 +191,7 @@ class RendezvousServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        announce_port(self.port)
         return self.port
 
     def put(self, scope: str, key: str, value: bytes) -> None:
@@ -137,13 +232,19 @@ class KVClient:
     POLL_CAP = 0.5
 
     def __init__(self, addr: str, port: int, secret=_FROM_ENV,
-                 retry_policy=None):
+                 retry_policy=None, request_timeout: Optional[float] = None):
         from horovod_tpu.common import resilience
         self.base = f"http://{addr}:{port}"
         self.secret = secret_mod.secret_from_env() \
             if secret is _FROM_ENV else secret
         self.retry = retry_policy if retry_policy is not None \
             else resilience.kv_retry_policy()
+        # Per-request socket timeout override. The retry DEADLINE only
+        # bounds time between attempts — a single blackholed connect
+        # otherwise blocks for the full default urlopen timeout (30 s for
+        # PUTs), which is what low-latency callers (telemetry pushes
+        # inside shutdown) must cap.
+        self.request_timeout = request_timeout
         self.attempts = 0  # total request attempts (test observability)
 
     def _request_once(self, method: str, path: str, data: Optional[bytes]):
@@ -159,7 +260,9 @@ class KVClient:
                 secret_mod.DIGEST_HEADER,
                 secret_mod.compute_digest(self.secret, method, path,
                                           data or b""))
-        return urllib.request.urlopen(req, timeout=30 if data else 10)
+        timeout = self.request_timeout if self.request_timeout is not None \
+            else (30 if data else 10)
+        return urllib.request.urlopen(req, timeout=timeout)
 
     def _request(self, method: str, path: str, data: Optional[bytes]):
         return self.retry.call(self._request_once, method, path, data)

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Fail if any HOROVOD_* env var referenced in horovod_tpu/ is undocumented.
+
+The knob surface drifts: code grows `HOROVOD_FOO` reads faster than docs
+grow tables. This lint (wired into `make lint` / CI) extracts every
+quoted `"HOROVOD_..."` string literal from `horovod_tpu/**/*.py` and
+requires the exact name to appear somewhere under `docs/` or README.md —
+docs/env_vars.md is the canonical catalog.
+
+Composed names (a policy prefix like HOROVOD_KV_RETRY plus a `_MAX_ATTEMPTS`
+suffix) are covered by documenting the prefix: a literal that is a
+documented literal plus a documented suffix pattern passes.
+
+Usage: python scripts/check_env_docs.py  (exit 1 on undocumented vars)
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+CODE_DIR = ROOT / "horovod_tpu"
+DOC_PATHS = sorted((ROOT / "docs").glob("**/*.md")) + [ROOT / "README.md"]
+
+LITERAL_RE = re.compile(r"""["'](HOROVOD_[A-Z0-9_]+)["']""")
+
+# Suffixes appended to documented prefixes at runtime (RetryPolicy.from_env
+# env scheme, docs/resilience.md): HOROVOD_KV_RETRY + _MAX_ATTEMPTS etc.
+COMPOSED_SUFFIXES = ("_MAX_ATTEMPTS", "_BASE_DELAY", "_MAX_DELAY",
+                     "_MULTIPLIER", "_JITTER", "_DEADLINE")
+
+
+def referenced_vars() -> dict:
+    """name -> first 'file:line' referencing it."""
+    found: dict = {}
+    for path in sorted(CODE_DIR.glob("**/*.py")):
+        for lineno, line in enumerate(
+                path.read_text(encoding="utf-8").splitlines(), 1):
+            for name in LITERAL_RE.findall(line):
+                found.setdefault(
+                    name, f"{path.relative_to(ROOT)}:{lineno}")
+    return found
+
+
+def documented_vars() -> set:
+    text = "\n".join(p.read_text(encoding="utf-8")
+                     for p in DOC_PATHS if p.exists())
+    return set(re.findall(r"HOROVOD_[A-Z0-9_]+", text))
+
+
+def main() -> int:
+    refs = referenced_vars()
+    docs = documented_vars()
+    missing = []
+    for name, where in sorted(refs.items()):
+        if name in docs:
+            continue
+        if any(name.endswith(sfx) and name[: -len(sfx)] in docs
+               for sfx in COMPOSED_SUFFIXES):
+            continue
+        missing.append((name, where))
+    if missing:
+        print("Undocumented HOROVOD_* env vars (add them to "
+              "docs/env_vars.md or the relevant doc):", file=sys.stderr)
+        for name, where in missing:
+            print(f"  {name}  (first referenced at {where})",
+                  file=sys.stderr)
+        return 1
+    print(f"env-docs lint: {len(refs)} HOROVOD_* vars referenced, "
+          f"all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

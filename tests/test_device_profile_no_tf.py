@@ -1,0 +1,74 @@
+"""device_profile must work without TensorFlow (ISSUE 2 satellite):
+module import and `aggregate_xspace` are TF-free; only `load_xspace`
+needs the xplane protobufs, and when they are absent it must raise an
+actionable error naming the optional dependency — not a bare
+ImportError from a private TF path.
+
+Kept separate from test_device_profile.py, whose module-level
+`importorskip("tensorflow")` would skip these exact tests in the
+TF-less environment they exist for."""
+
+import importlib
+
+import pytest
+
+
+def test_module_imports_without_tf():
+    # Function-level TF imports only: importing the module (and the
+    # TF-free surface) must not require tensorflow/tsl.
+    from horovod_tpu.profiler.device_profile import (aggregate_xspace,
+                                                     classify)
+    assert callable(aggregate_xspace)
+    assert classify("%all-reduce.1") == "collective"
+
+
+def test_aggregate_xspace_works_on_duck_typed_xspace():
+    from horovod_tpu.profiler.device_profile import aggregate_xspace
+
+    class Event:
+        def __init__(self, mid, dur_ps):
+            self.metadata_id = mid
+            self.duration_ps = dur_ps
+
+    class Meta:
+        def __init__(self, name):
+            self.name = name
+
+    class Line:
+        name = "XLA Ops"
+
+        def __init__(self, events):
+            self.events = events
+
+    class Plane:
+        name = "/device:TPU:0"
+        event_metadata = {1: Meta("%fusion.1")}
+
+        def __init__(self):
+            self.lines = [Line([Event(1, int(2e9)), Event(1, int(1e9))])]
+
+    class XSpace:
+        planes = [Plane()]
+
+    prof = aggregate_xspace(XSpace(), reps=1)
+    assert prof.total_ms == pytest.approx(3.0)
+    assert prof.per_op["%fusion.1"] == pytest.approx(3.0)
+
+
+def test_load_xspace_error_is_actionable(monkeypatch):
+    from horovod_tpu.profiler import device_profile
+
+    real_import = importlib.import_module
+
+    def no_xplane(name, *args, **kwargs):
+        if "xplane_pb2" in name:
+            raise ImportError(f"No module named {name!r}")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(importlib, "import_module", no_xplane)
+    with pytest.raises(ImportError) as ei:
+        device_profile._import_xplane_pb2()
+    msg = str(ei.value)
+    assert "tensorflow" in msg            # names the optional dependency
+    assert "aggregate_xspace" in msg      # points at the TF-free escape
+    assert "xplane_pb2" in msg

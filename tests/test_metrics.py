@@ -1,0 +1,369 @@
+"""Metrics registry + export-path unit tests (ISSUE 2 tentpole).
+
+Covers: counter/gauge/histogram semantics, label cardinality cap,
+disabled-mode no-ops, thread-safety under a hammer, Prometheus rendering
+and multi-rank merge, the exporter sinks (JSON dump, KV push, timeline
+counter tracks), collective-layer instrumentation through a real run,
+and the rendezvous server's /metrics route.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.observability import metrics as m
+
+
+def reg():
+    return m.MetricsRegistry(enabled=True, label_max=64)
+
+
+# ------------------------------------------------------------- semantics
+
+def test_counter_semantics():
+    r = reg()
+    c = r.counter("c_total", "help", labelnames=("op",))
+    c.labels(op="a").inc()
+    c.labels(op="a").inc(2.5)
+    c.labels(op="b").inc()
+    assert c.labels(op="a").value == 3.5
+    assert c.labels(op="b").value == 1.0
+
+
+def test_counter_default_series_without_labels():
+    r = reg()
+    c = r.counter("plain_total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5.0
+
+
+def test_gauge_set_and_dec():
+    r = reg()
+    g = r.gauge("g")
+    g.set(10)
+    g.dec(3)
+    g.inc(0.5)
+    assert g.value == 7.5
+
+
+def test_histogram_buckets_and_sum():
+    r = reg()
+    h = r.histogram("h_seconds", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    s = h.labels()
+    assert s.count == 4
+    assert s.sum == 105.0
+    # counts per (le 1, le 2, le 4, +Inf) — non-cumulative internally
+    assert s.counts == [1, 1, 1, 1]
+
+
+def test_family_reregistration_conflict():
+    r = reg()
+    r.counter("x_total", labelnames=("a",))
+    with pytest.raises(ValueError):
+        r.gauge("x_total", labelnames=("a",))
+    with pytest.raises(ValueError):
+        r.counter("x_total", labelnames=("b",))
+
+
+def test_label_cardinality_cap_folds_to_other():
+    r = m.MetricsRegistry(enabled=True, label_max=4)
+    c = r.counter("capped_total", labelnames=("k",))
+    for i in range(50):
+        c.labels(k=f"key{i}").inc()
+    fam = r.snapshot()["families"]["capped_total"]
+    series = {tuple(s["labels"]): s["value"] for s in fam["series"]}
+    assert len(series) <= 5  # 4 real + the fold bucket
+    assert series[("other",)] == 46.0  # keys 4..49 folded, none lost
+
+
+# ---------------------------------------------------------- disabled mode
+
+def test_disabled_registry_is_noop():
+    r = m.MetricsRegistry(enabled=False)
+    c = r.counter("c_total", labelnames=("op",))
+    assert c is m.NOOP
+    c.labels(op="a").inc()
+    c.observe(1)  # histogram surface too — never raises
+    c.set(2)
+    assert r.snapshot()["families"] == {}
+    assert m.render_snapshots([r.snapshot()]) == ""
+
+
+def test_env_disable(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "0")
+    m.reset_for_tests()
+    try:
+        assert not m.registry().enabled
+        assert m.registry().counter("x_total") is m.NOOP
+    finally:
+        monkeypatch.setenv("HOROVOD_METRICS", "1")
+        m.reset_for_tests()
+
+
+# ----------------------------------------------------------- thread hammer
+
+def test_thread_hammer_counter_and_histogram():
+    r = reg()
+    c = r.counter("hammer_total", labelnames=("t",))
+    h = r.histogram("hammer_seconds", buckets=m.TIME_BUCKETS)
+    n_threads, n_iter = 8, 2000
+
+    def work(tid):
+        child = c.labels(t=str(tid % 2))
+        for i in range(n_iter):
+            child.inc()
+            h.observe(1e-6 * (i % 7 + 1))
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(s["value"] for s in
+                r.snapshot()["families"]["hammer_total"]["series"])
+    assert total == n_threads * n_iter
+    hs = h.labels()
+    assert hs.count == n_threads * n_iter
+    assert sum(hs.counts) == hs.count
+
+
+# ------------------------------------------------------------- rendering
+
+def test_render_merges_ranks_with_rank_label():
+    r0, r1 = reg(), reg()
+    for rank, r in enumerate((r0, r1)):
+        r.counter("calls_total", "calls", ("op",)).labels(
+            op="allreduce").inc(rank + 1)
+    text = m.render_snapshots([r0.snapshot(rank=0), r1.snapshot(rank=1)])
+    assert 'calls_total{op="allreduce",rank="0"} 1' in text
+    assert 'calls_total{op="allreduce",rank="1"} 2' in text
+    assert text.count("# TYPE calls_total counter") == 1
+
+
+def test_render_histogram_cumulative_buckets():
+    r = reg()
+    h = r.histogram("lat_seconds", buckets=(1.0, 2.0))
+    for v in (0.5, 0.6, 1.5, 9.0):
+        h.observe(v)
+    text = m.render_snapshots([r.snapshot()])
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="2"} 3' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+    assert "lat_seconds_sum 11.6" in text
+    assert "lat_seconds_count 4" in text
+
+
+def test_parse_snapshot_rejects_garbage():
+    assert m.parse_snapshot(b"\xff\x00 not json") is None
+    assert m.parse_snapshot(b"[1,2,3]") is None
+    assert m.parse_snapshot(b'{"families": {}}') == {"families": {}}
+
+
+# ------------------------------------------------------- exporter sinks
+
+def _mk_cfg(**kw):
+    from horovod_tpu.common.config import Config
+    return Config(**kw)
+
+
+def test_exporter_json_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "1")
+    m.reset_for_tests()
+    m.registry().counter("dumped_total").inc(7)
+    from horovod_tpu.observability.export import MetricsExporter
+    path = tmp_path / "metrics-{rank}.json"
+    cfg = _mk_cfg(metrics_dump=str(path), metrics_dump_interval=0.1,
+                  metrics_push_interval=0.1)
+    exp = MetricsExporter(cfg, rank_fn=lambda: 3, timeline_fn=lambda: None)
+    exp.tick(force=True)
+    snap = json.loads((tmp_path / "metrics-3.json").read_text())
+    assert snap["rank"] == 3
+    assert snap["families"]["dumped_total"]["series"][0]["value"] == 7
+    m.reset_for_tests()
+
+
+def test_exporter_kv_push(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "1")
+    m.reset_for_tests()
+    m.registry().counter("pushed_total").inc()
+    pushed = {}
+
+    class FakeKV:
+        def put(self, scope, key, value):
+            pushed[(scope, key)] = value
+
+    from horovod_tpu.observability.export import MetricsExporter
+    cfg = _mk_cfg(metrics_push_interval=0.1)
+    exp = MetricsExporter(cfg, rank_fn=lambda: 1, timeline_fn=lambda: None,
+                          kv_factory=FakeKV)
+    exp.tick(force=True)
+    snap = json.loads(pushed[("metrics", "rank-1")])
+    assert "pushed_total" in snap["families"]
+    m.reset_for_tests()
+
+
+def test_exporter_timeline_counter_tracks(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "1")
+    m.reset_for_tests()
+    m.registry().counter("tracked_total", labelnames=("op",)).labels(
+        op="x").inc(5)
+    emitted = []
+
+    class FakeTL:
+        def counter(self, name, values):
+            emitted.append((name, values))
+
+    from horovod_tpu.observability.export import MetricsExporter
+    cfg = _mk_cfg(metrics_push_interval=0.1)
+    exp = MetricsExporter(cfg, rank_fn=lambda: 0,
+                          timeline_fn=lambda: FakeTL())
+    exp.tick(force=True)
+    assert ("tracked_total", {"x": 5.0}) in emitted
+    m.reset_for_tests()
+
+
+# -------------------------------------- instrumentation through a real run
+
+def test_collectives_record_metrics(hvd):
+    m.reset_for_tests()
+    try:
+        hvd.allreduce(np.ones((16,), np.float32), op="sum")
+        hvd.allreduce(np.ones((16,), np.float32), op="sum")
+        hvd.grouped_allreduce(
+            [np.ones((4,), np.float32), np.ones((2, 2), np.float64)],
+            op="sum")
+        snap = hvd.metrics()
+        fams = snap["families"]
+        calls = {tuple(s["labels"]): s["value"]
+                 for s in fams["horovod_collective_calls_total"]["series"]}
+        assert calls[("allreduce", "float32")] >= 3
+        total_bytes = sum(
+            s["value"]
+            for s in fams["horovod_collective_bytes_total"]["series"])
+        # 8-device mesh (conftest): 2x 16 f32 + group of (4 f32, 4 f64)
+        assert total_bytes == 8 * (2 * 64 + 16 + 32)
+        cache = {tuple(s["labels"]): s["value"]
+                 for s in fams["horovod_compile_cache_total"]["series"]}
+        # second allreduce reuses the first's executable
+        assert cache[("hit",)] >= 1 and cache[("miss",)] >= 2
+        lat = fams["horovod_collective_seconds"]["series"]
+        assert sum(s["count"] for s in lat) >= 3
+        grp = fams["horovod_grouped_fusion_tensors"]["series"]
+        assert sum(s["count"] for s in grp) == 1
+        text = hvd.metrics_text()
+        assert "horovod_collective_bytes_total" in text
+    finally:
+        m.reset_for_tests()
+
+
+def test_disabled_mode_skips_collective_metrics(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "0")
+    m.reset_for_tests()
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    hvd.init()
+    try:
+        hvd.allreduce(np.ones((4,), np.float32), op="sum")
+        assert hvd.metrics()["families"] == {}
+        assert hvd.metrics_text() == ""
+    finally:
+        hvd.shutdown()
+        monkeypatch.setenv("HOROVOD_METRICS", "1")
+        m.reset_for_tests()
+
+
+# ----------------------------------------------------- /metrics route
+
+def test_rendezvous_metrics_route():
+    from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+    m.reset_for_tests()
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        kv = KVClient("127.0.0.1", port)
+        kv.put("scope", "key", b"v")
+        worker = m.MetricsRegistry(enabled=True)
+        worker.counter("horovod_collective_calls_total", "",
+                       ("op", "dtype")).labels(
+                           op="allreduce", dtype="float32").inc(9)
+        kv.put("metrics", "rank-1",
+               json.dumps(worker.snapshot(rank=1)).encode())
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        # launcher-side KV metrics and the pushed worker snapshot merge
+        assert 'horovod_kv_requests_total{method="PUT"}' in text
+        assert "horovod_kv_request_seconds_bucket" in text
+        assert ('horovod_collective_calls_total'
+                '{op="allreduce",dtype="float32",rank="1"} 9') in text
+        # retry counters render as explicit zeros on a healthy server
+        assert 'horovod_retry_attempts_total{policy="kv"}' in text
+    finally:
+        srv.stop()
+        m.reset_for_tests()
+
+
+def test_metrics_route_survives_garbage_snapshot():
+    from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+    m.reset_for_tests()
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        KVClient("127.0.0.1", port).put("metrics", "rank-0",
+                                        b"\xde\xad not json")
+        status = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).status
+        assert status == 200
+    finally:
+        srv.stop()
+        m.reset_for_tests()
+
+
+# -------------------------------------------------------------- resilience
+
+def test_retry_metrics_counted(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "1")
+    m.reset_for_tests()
+    from horovod_tpu.common.exceptions import RetryError
+    from horovod_tpu.common.resilience import RetryPolicy
+    pol = RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.001,
+                      deadline=None, retryable=lambda e: True,
+                      name="testpol")
+    with pytest.raises(RetryError):
+        pol.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+    snap = m.registry().snapshot()
+    retries = {tuple(s["labels"]): s["value"] for s in
+               snap["families"]["horovod_retry_attempts_total"]["series"]}
+    exhausted = {tuple(s["labels"]): s["value"] for s in
+                 snap["families"]["horovod_retry_exhausted_total"]["series"]}
+    assert retries[("testpol",)] == 2  # 3 attempts = 2 retries
+    assert exhausted[("testpol",)] == 1
+    m.reset_for_tests()
+
+
+def test_circuit_breaker_transition_metrics():
+    m.reset_for_tests()
+    from horovod_tpu.common.exceptions import CircuitOpenError
+    from horovod_tpu.common.resilience import CircuitBreaker
+    clock = [0.0]
+    br = CircuitBreaker(failure_threshold=2, recovery_timeout=10.0,
+                        clock=lambda: clock[0])
+    for _ in range(2):
+        with pytest.raises(ValueError):
+            br.call(lambda: (_ for _ in ()).throw(ValueError("y")))
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: 1)
+    clock[0] = 11.0  # half-open: probe succeeds, circuit closes
+    assert br.call(lambda: 42) == 42
+    snap = m.registry().snapshot()
+    trans = {tuple(s["labels"]): s["value"] for s in
+             snap["families"]["horovod_circuit_transitions_total"]["series"]}
+    assert trans[("open",)] == 1
+    assert trans[("closed",)] == 1
+    m.reset_for_tests()

@@ -1,0 +1,117 @@
+"""Metrics end-to-end: scrape a REAL 2-process elastic job mid-run.
+
+The ISSUE 2 acceptance path: an elastic job with HOROVOD_METRICS=1 serves
+Prometheus text on the launcher rendezvous server's `/metrics` route,
+containing per-rank collective byte/call counters (pushed by each
+worker's exporter through the KV store), resilience retry counters, KV
+latency histograms, and the launcher's elastic-driver counters — all in
+ONE scrape. The same run writes a rank-0 timeline whose trace carries
+`"ph":"C"` counter tracks next to the ALLREDUCE spans.
+
+Reuses the elastic harness from test_elastic_e2e (real launcher, real
+workers, scripted discovery file).
+"""
+
+import json
+import time
+import urllib.request
+
+from test_elastic_e2e import finish, start_job, wait_for_step, write_hosts
+
+
+def _wait_port(port_file, proc, timeout=60.0) -> int:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return int(port_file.read_text())
+        except (FileNotFoundError, ValueError):
+            time.sleep(0.2)
+    proc.kill()
+    out, _ = proc.communicate()
+    raise TimeoutError(f"rendezvous port never announced; output:\n{out}")
+
+
+def _scrape(port: int) -> str:
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+
+
+def test_elastic_job_scrapes_prometheus_and_counter_tracks(tmp_path):
+    port_file = tmp_path / "rdv.port"
+    timeline = tmp_path / "tl.json"
+    proc, hosts_file, progress = start_job(
+        tmp_path, "resize", total_steps=16,
+        extra_env={
+            "HOROVOD_METRICS": "1",
+            "HOROVOD_METRICS_PUSH_INTERVAL": "0.3",
+            "HOROVOD_RENDEZVOUS_PORT_FILE": str(port_file),
+            "HOROVOD_TIMELINE": str(timeline),
+            # no resize in this test: don't hold at the resize gate
+            "ELASTIC_WAIT_STEP": "999",
+        })
+    write_hosts(hosts_file, "localhost:2")
+    port = _wait_port(port_file, proc)
+    wait_for_step(progress, 3, proc=proc)
+
+    # ---- scrape MID-RUN until both ranks' pushed snapshots appear
+    deadline = time.monotonic() + 60.0
+    text = ""
+    while time.monotonic() < deadline:
+        try:
+            text = _scrape(port)
+        except OSError:
+            text = ""
+        if all(f'rank="{r}"' in text for r in (0, 1)) \
+                and "horovod_collective_calls_total" in text:
+            break
+        time.sleep(0.3)
+    for r in (0, 1):
+        assert (f'horovod_collective_calls_total'
+                f'{{op="allreduce",dtype="float32",rank="{r}"}}') in text, \
+            text[:4000]
+        assert (f'horovod_collective_bytes_total'
+                f'{{op="allreduce",dtype="float32",rank="{r}"}}') in text
+        # per-op wall-time latency histogram per rank
+        assert (f'horovod_collective_seconds_bucket'
+                f'{{op="allreduce",rank="{r}"') in text
+    # resilience retry counters (explicit zeros on a healthy run)
+    assert 'horovod_retry_attempts_total{policy="kv"' in text
+    # launcher-side: KV request latency histogram + elastic driver state
+    assert 'horovod_kv_request_seconds_bucket{method="GET"' in text
+    assert "horovod_elastic_rounds_total 1" in text
+    assert "horovod_elastic_world_size 2" in text
+
+    out = finish(proc)
+    assert out.count("ELASTIC_DONE") == 2, out
+
+    # ---- the same run's rank-0 timeline has counter tracks + spans
+    events = json.loads(timeline.read_text())
+    if isinstance(events, dict):
+        events = events["traceEvents"]
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert any(e["name"] == "horovod_collective_bytes_total"
+               and "allreduce" in e.get("args", {}) for e in counters), \
+        f"no byte counter track; counters={counters[:5]}"
+    assert any(e.get("ph") == "X" and "ALLREDUCE" in str(e.get("name"))
+               for e in events)
+
+
+def test_metrics_disabled_serves_launcher_only(tmp_path):
+    """HOROVOD_METRICS=0 in the job: workers push nothing and their
+    registries are no-op shells — the scrape still answers 200 (launcher
+    registry may itself be disabled; the route must not error)."""
+    port_file = tmp_path / "rdv.port"
+    proc, hosts_file, progress = start_job(
+        tmp_path, "resize", total_steps=6,
+        extra_env={
+            "HOROVOD_METRICS": "0",
+            "HOROVOD_RENDEZVOUS_PORT_FILE": str(port_file),
+            "ELASTIC_WAIT_STEP": "999",
+        })
+    write_hosts(hosts_file, "localhost:2")
+    port = _wait_port(port_file, proc)
+    wait_for_step(progress, 2, proc=proc)
+    text = _scrape(port)
+    assert "horovod_collective_calls_total" not in text
+    out = finish(proc)
+    assert out.count("ELASTIC_DONE") == 2, out

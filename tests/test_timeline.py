@@ -1,8 +1,12 @@
 """Timeline span validation (the repo's analog of reference
 test/parallel/test_timeline.py: run a training loop with HOROVOD_TIMELINE
-set and validate the Chrome-trace JSON — durations, not just instants)."""
+set and validate the Chrome-trace JSON — durations, not just instants),
+plus span thread-safety, incremental-flush durability, and `"ph":"C"`
+counter tracks (ISSUE 2)."""
 
 import json
+import threading
+import time
 
 import numpy as np
 
@@ -80,3 +84,131 @@ def test_mark_cycles_at_autotune_sample_boundaries(tmp_path, monkeypatch):
     cycles = [e for e in events if "CYCLE_START" in str(e.get("name", ""))
               or "CYCLE_START" in str(e.get("cat", ""))]
     assert len(cycles) >= 2, events[:8]
+
+
+def test_span_state_thread_safe(tmp_path):
+    """Concurrent span_begin/span_end from many threads must never drop
+    or corrupt spans (_pending_spans is shared state; satellite fix:
+    it is now mutated under the timeline lock)."""
+    from horovod_tpu.profiler.timeline import Timeline
+
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path, use_native=False)
+    tl.start()
+    n_threads, n_iter = 8, 200
+
+    def work(tid):
+        for i in range(n_iter):
+            name = f"t{tid}-{i}"
+            tl.span_begin(name, "ALLREDUCE")
+            tl.span_end(name, "ALLREDUCE")
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tl._pending_spans == {}  # nothing leaked
+    tl.stop()
+    spans = [e for e in _load_events(path) if e.get("ph") == "X"]
+    assert len(spans) == n_threads * n_iter
+
+
+def test_incremental_flush_survives_kill(tmp_path):
+    """A run that never reaches stop() (crash / SIGKILL / stall-kill)
+    still leaves a loadable trace: events stream to disk incrementally
+    and recover_trace() repairs the unterminated JSON array."""
+    from horovod_tpu.profiler.timeline import (_FLUSH_SECONDS, Timeline,
+                                               recover_trace)
+
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path, use_native=False)
+    tl.start()
+    for i in range(5):
+        tl.span_begin(f"s{i}", "ALLREDUCE")
+        tl.span_end(f"s{i}", "ALLREDUCE")
+    deadline = time.monotonic() + 10 * _FLUSH_SECONDS
+    events = []
+    while time.monotonic() < deadline:  # wait for a flush, NO stop()
+        try:
+            events = [e for e in recover_trace(path)
+                      if e.get("ph") == "X"]
+        except (FileNotFoundError, ValueError):
+            events = []
+        if len(events) == 5:
+            break
+        time.sleep(0.05)
+    assert len(events) == 5, "events not on disk before stop()"
+    tl.stop()  # cleanliness; the assertion above ran pre-finalize
+
+
+def test_counter_events_python_writer(tmp_path):
+    from horovod_tpu.profiler.timeline import Timeline
+
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path, use_native=False)
+    tl.start()
+    tl.counter("horovod_collective_bytes_total", {"allreduce": 128.0})
+    tl.counter("horovod_collective_bytes_total", {"allreduce": 256.0})
+    tl.stop()
+    counters = [e for e in _load_events(path) if e.get("ph") == "C"]
+    assert len(counters) == 2
+    assert counters[-1]["args"]["allreduce"] == 256.0
+
+
+def test_counter_tracks_written_during_run(tmp_path, monkeypatch):
+    """End-to-end: HOROVOD_TIMELINE + metrics → the trace written during
+    the run contains `"ph":"C"` counter events (from the collective
+    byte instrumentation) alongside the ALLREDUCE spans, through
+    whichever writer (native or Python) is active."""
+    path = str(tmp_path / "tl.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", path)
+    monkeypatch.setenv("HOROVOD_METRICS", "1")
+    from horovod_tpu.observability import metrics as m
+    m.reset_for_tests()
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    try:
+        for _ in range(3):
+            hvd.allreduce(np.ones((8,), np.float32), op="sum")
+    finally:
+        hvd.shutdown()
+        m.reset_for_tests()
+    events = _load_events(path)
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert counters, f"no counter events: {events[:6]}"
+    byte_tracks = [e for e in counters
+                   if e["name"] == "horovod_collective_bytes_total"
+                   and "allreduce" in e.get("args", {})]
+    assert byte_tracks, counters[:6]
+    # cumulative track is monotonically non-decreasing
+    vals = [e["args"]["allreduce"] for e in byte_tracks]
+    assert vals == sorted(vals) and vals[-1] > 0
+    # ...and the spans are still there next to them
+    assert any(e.get("ph") == "X" and "ALLREDUCE" in str(e.get("name", ""))
+               for e in events)
+
+
+def test_recover_trace_truncated_mid_event(tmp_path):
+    """stdio auto-flushes at byte boundaries, so a SIGKILL can cut the
+    file mid-object; recover_trace must back off to the last complete
+    event instead of raising."""
+    from horovod_tpu.profiler.timeline import Timeline, recover_trace
+
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path, use_native=False)
+    tl.start()
+    for i in range(4):
+        tl.span_begin(f"tensor}}{i}", "ALLREDUCE")  # '}' inside a string
+        tl.span_end(f"tensor}}{i}", "ALLREDUCE")
+    tl.stop()
+    full = open(path).read()
+    # cut inside the LAST event object (drop the finalizer and its tail)
+    cut = full.rindex('{"ph"') + 25
+    open(path, "w").write(full[:cut])
+    events = [e for e in recover_trace(path) if e.get("ph") == "X"]
+    assert len(events) == 3  # all complete events survive
+    assert all("tensor}" in e["args"]["tensor"] for e in events)
