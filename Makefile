@@ -11,7 +11,9 @@
 #                    (tests marked `faults`; see docs/resilience.md)
 #   make metrics     observability smoke: registry/exporter units + a
 #                    scraped 2-process elastic job (docs/observability.md)
-#   make lint        static checks (env-knob docs drift, scripts/)
+#   make lint        hvdlint static analysis: collective-consistency +
+#                    concurrency rules + env-knob docs drift
+#                    (docs/static_analysis.md)
 #   make native      build the native control-plane library
 #   make bench       one-line JSON benchmark (real accelerator if present)
 
@@ -46,7 +48,7 @@ metrics:
 	    tests/test_timeline.py
 
 lint:
-	$(PYTHON) scripts/check_env_docs.py
+	$(PYTHON) -m horovod_tpu.analysis horovod_tpu/ examples/
 
 entry:
 	$(PYTHON) __graft_entry__.py

@@ -54,7 +54,8 @@ def main():
             opt.apply_gradients(zip(grads, model.trainable_variables))
             loss_sum += float(loss) * len(xb)
             total += len(xb)
-        avg = float(hvd.allreduce(np.float32(loss_sum / total)))
+        avg = float(hvd.allreduce(np.float32(loss_sum / total),
+                                  name="epoch_loss"))
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={avg:.4f}")
 

@@ -60,8 +60,10 @@ def main():
             total += len(b)
         # Average metrics across ranks (reference: metric_average in the
         # mnist example).
-        avg_loss = float(hvd.allreduce(torch.tensor(loss_sum / total)))
-        avg_acc = float(hvd.allreduce(torch.tensor(correct / total)))
+        avg_loss = float(hvd.allreduce(torch.tensor(loss_sum / total),
+                                       name="epoch_loss"))
+        avg_acc = float(hvd.allreduce(torch.tensor(correct / total),
+                                      name="epoch_acc"))
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={avg_loss:.4f} acc={avg_acc:.3f}")
 

@@ -18,8 +18,9 @@ from horovod_tpu.common.types import (  # noqa: F401, E402
     Adasum, Average, Max, Min, Product, ReduceOp, Status, Sum,
 )
 from horovod_tpu.common.exceptions import (  # noqa: F401
-    DuplicateNameError, HorovodInternalError, HorovodTpuError,
-    HostsUpdatedInterrupt, TensorShapeMismatchError, VersionMismatchError,
+    CollectiveDivergenceError, DuplicateNameError, HorovodInternalError,
+    HorovodTpuError, HostsUpdatedInterrupt, TensorShapeMismatchError,
+    VersionMismatchError,
 )
 from horovod_tpu.core.topology import (  # noqa: F401
     ccl_built, cross_rank, cross_size, cuda_built, ddl_built, gloo_built,

@@ -1,0 +1,37 @@
+"""hvdlint — static analysis for collective consistency and concurrency
+discipline, plus the cross-rank fingerprint verifier.
+
+The reference Horovod's background runtime exists largely to catch one
+failure class at runtime: ranks submitting collectives in different
+orders or with mismatched shapes, which otherwise manifests as a silent
+stall (controller.cc:74-447 mismatch checks, stall_inspector.cc). This
+package moves that detection LEFT of the job launch:
+
+* ``hvdlint`` (``python -m horovod_tpu.analysis``, the single
+  ``make lint`` entrypoint) runs two AST rule families over Python
+  source — collective-consistency rules (HVD0xx) on user/training code
+  and the repo's examples, and concurrency-discipline rules (HVD1xx,
+  including the ``# guarded-by:`` lock annotation convention) on the
+  runtime itself — plus the HVD-ENV documentation-drift rule that
+  subsumes the old ``scripts/check_env_docs.py``.
+
+* ``verifier`` is the runtime companion (``HOROVOD_CHECK_COLLECTIVES=1``):
+  each rank hashes its rolling sequence of
+  ``(op, name, shape, dtype, process_set)`` tuples at the dispatch choke
+  point in ``ops/collectives.py`` and periodically cross-checks the
+  fingerprint through the rendezvous KV, so a divergent rank raises an
+  actionable mismatch error (rank, call index, both fingerprints)
+  instead of tripping the stall watchdog blind.
+
+See docs/static_analysis.md for the rule catalog and suppression syntax.
+
+The analysis modules themselves import only the standard library, but
+``python -m horovod_tpu.analysis`` necessarily executes the parent
+package's ``__init__`` (which needs jax). Environments without the
+runtime stack get the same rules dependency-free by stubbing the parent
+package first — ``scripts/check_env_docs.py`` shows the pattern.
+"""
+
+from horovod_tpu.analysis.driver import (  # noqa: F401
+    Finding, lint_paths, lint_source, main, run_cli,
+)

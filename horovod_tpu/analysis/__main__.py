@@ -1,0 +1,3 @@
+from horovod_tpu.analysis.driver import main
+
+main()

@@ -1,0 +1,302 @@
+"""Collective-consistency lint rules (HVD001-HVD004).
+
+The SPMD contract behind every backend this framework has (and the
+reference's coordinator protocol, controller.cc:74-447) is: **every rank
+issues the same collectives, in the same order, with the same
+signature**. Violations don't crash — they stall, 1000 chips deep. These
+rules flag the source patterns that most often break the contract, on
+user/training code and the repo's own examples:
+
+HVD001  collective invoked under rank-dependent control flow
+        (``if hvd.rank() == 0: hvd.broadcast(...)``) — only some ranks
+        submit it, the rest hang at the next collective.
+HVD002  collective name derived from iteration over an unordered
+        container (a set) — iteration order differs per process, so
+        ranks pair up different tensors under the same call index.
+HVD003  unnamed collective inside a loop — auto-assigned names collide
+        across iterations once calls overlap (async handles, reference
+        DUPLICATE_NAME_ERROR) and make timeline/stall diagnostics
+        ambiguous.
+HVD004  ``process_set=`` differs between branches of one ``if`` — if the
+        condition isn't globally uniform, member sets disagree about who
+        participates.
+
+Heuristics are deliberately lexical (no cross-function dataflow): a
+false positive is one ``disable=... -- rationale`` suppression comment
+away, while a missed stall costs a debugging session on a live cluster.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from horovod_tpu.analysis.driver import Finding, SourceFile
+
+#: The eager collective API surface (ops/collectives.py) plus the
+#: high-level wrappers that submit collectives on the caller's behalf
+#: (optim/functions.py).
+COLLECTIVE_NAMES: Set[str] = {
+    "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
+    "broadcast", "reducescatter", "grouped_reducescatter", "alltoall",
+    "barrier",
+    "allreduce_async", "grouped_allreduce_async", "allgather_async",
+    "broadcast_async", "alltoall_async", "reducescatter_async",
+    "broadcast_object", "broadcast_parameters", "broadcast_variables",
+    "broadcast_optimizer_state", "allgather_object",
+}
+
+#: Ops whose reference auto-naming collides across loop iterations
+#: (HVD003), mapped to the 0-based POSITIONAL index of their `name`
+#: parameter (ops/collectives.py signatures; the frontends mirror
+#: them). The broadcast_* / *_object wrappers name their tensors
+#: internally and barrier takes no name.
+NAME_ARG_POS: Dict[str, Tuple[int, ...]] = {
+    "allreduce": (2,), "grouped_allreduce": (2,),
+    "allgather": (1,), "grouped_allgather": (1,),
+    "broadcast": (2,), "reducescatter": (2,),
+    "grouped_reducescatter": (2,), "alltoall": (2,),
+    "allreduce_async": (2,),
+    # torch's async wrapper takes name at position 1
+    # (frontends/torch.py), the core alias at 2 — accept either.
+    "grouped_allreduce_async": (1, 2),
+    "allgather_async": (1,), "broadcast_async": (2,),
+    "alltoall_async": (2,), "reducescatter_async": (2,),
+}
+NAMED_OP_NAMES: Set[str] = set(NAME_ARG_POS)
+
+#: Receivers whose methods share names with our API but are NOT Horovod
+#: collectives (np.broadcast, tf.broadcast_to's relatives, etc.).
+_FOREIGN_ROOTS: Set[str] = {
+    "np", "numpy", "jnp", "jax", "lax", "torch", "tf", "tensorflow",
+    "mx", "mxnet", "keras", "K",
+}
+
+#: Calls that return this process's identity — the seed of
+#: rank-dependent control flow.
+_RANK_CALL_NAMES: Set[str] = {
+    "rank", "local_rank", "cross_rank", "process_index",
+}
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _root_name(func: ast.AST) -> Optional[str]:
+    node = func
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def is_collective_call(node: ast.AST) -> Optional[str]:
+    """The collective's op name if `node` is a Horovod collective call."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = _terminal_name(node.func)
+    if name not in COLLECTIVE_NAMES:
+        return None
+    if isinstance(node.func, ast.Attribute) \
+            and _root_name(node.func) in _FOREIGN_ROOTS:
+        return None
+    return name
+
+
+def _contains_rank_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and _terminal_name(sub.func) in _RANK_CALL_NAMES:
+            return True
+    return False
+
+
+def _walk_pruned(root: ast.stmt) -> Iterator[Tuple[ast.Call, str]]:
+    """Collective calls under `root`, pruning nested def/class bodies:
+    a ``def`` inside a rank-guard only runs if something calls it, and
+    that callsite is what the rule should (and does) anchor to."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node is not root:
+            continue
+        op = is_collective_call(node)
+        if op is not None:
+            yield node, op  # still recurse: grouped calls can nest args
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _name_argument(call: ast.Call, op: str) -> Optional[ast.expr]:
+    """The expression passed as `name` — keyword or positional."""
+    expr = _kwarg(call, "name")
+    if expr is not None:
+        return expr
+    for pos in NAME_ARG_POS.get(op, ()):
+        if len(call.args) > pos \
+                and not isinstance(call.args[pos], ast.Starred):
+            return call.args[pos]
+    return None
+
+
+# --------------------------------------------------------------- HVD001
+
+def check_rank_dependent(sf: SourceFile) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        branches: List[List[ast.stmt]] = []
+        desc = ""
+        if isinstance(node, ast.If) and _contains_rank_call(node.test):
+            branches = [node.body, node.orelse]
+            desc = "if"
+        elif isinstance(node, ast.While) \
+                and _contains_rank_call(node.test):
+            branches = [node.body]
+            desc = "while"
+        elif isinstance(node, ast.IfExp) \
+                and _contains_rank_call(node.test):
+            branches = []
+            for side in (node.body, node.orelse):
+                op = is_collective_call(side)
+                if op is not None:
+                    yield sf.finding(
+                        side, "HVD001",
+                        f"collective '{op}' in a rank-dependent "
+                        f"conditional expression: every rank must issue "
+                        f"the same collectives in the same order")
+            continue
+        for branch in branches:
+            for call, op in _collectives_under_stmts(branch):
+                yield sf.finding(
+                    call, "HVD001",
+                    f"collective '{op}' under rank-dependent control "
+                    f"flow ({desc} at line {node.lineno}): every rank "
+                    f"must issue the same collectives in the same order")
+
+
+def _collectives_under_stmts(stmts: Iterable[ast.stmt]
+                             ) -> Iterator[Tuple[ast.Call, str]]:
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # see _walk_pruned: flag callsites, not def bodies
+        yield from _walk_pruned(stmt)
+
+
+# --------------------------------------------------------------- HVD002
+
+def _unordered_iter_reason(it: ast.expr) -> Optional[str]:
+    """Why iterating `it` has process-dependent order, or None."""
+    if isinstance(it, ast.Set):
+        return "a set literal"
+    if isinstance(it, ast.SetComp):
+        return "a set comprehension"
+    if isinstance(it, ast.Call):
+        name = _terminal_name(it.func)
+        if name in ("set", "frozenset"):
+            return f"{name}()"
+        if name in ("vars", "globals", "locals"):
+            return f"{name}()"
+    return None
+
+
+def _loop_targets(target: ast.expr) -> Set[str]:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+def check_unordered_naming(sf: SourceFile) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        reason = _unordered_iter_reason(node.iter)
+        if reason is None:
+            continue
+        targets = _loop_targets(node.target)
+        for call, op in _collectives_under_stmts(node.body):
+            name_expr = _name_argument(call, op)
+            if name_expr is None:
+                continue
+            used = {n.id for n in ast.walk(name_expr)
+                    if isinstance(n, ast.Name)}
+            if used & targets:
+                yield sf.finding(
+                    call, "HVD002",
+                    f"collective '{op}' name derives from iteration "
+                    f"over an unordered container ({reason}): iteration "
+                    f"order differs across processes, so ranks submit "
+                    f"mismatched names at the same call index — iterate "
+                    f"a sorted/ordered sequence instead")
+
+
+# --------------------------------------------------------------- HVD003
+
+def check_unnamed_in_loop(sf: SourceFile) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for call, op in _collectives_under_stmts(node.body):
+            if op not in NAMED_OP_NAMES:
+                continue
+            name_expr = _name_argument(call, op)
+            if name_expr is None or (isinstance(name_expr, ast.Constant)
+                                     and name_expr.value is None):
+                yield sf.finding(
+                    call, "HVD003",
+                    f"unnamed collective '{op}' inside a loop: "
+                    f"auto-assigned names collide across iterations "
+                    f"(reference DUPLICATE_NAME_ERROR) and make "
+                    f"timeline/stall diagnostics ambiguous — pass "
+                    f"name=")
+
+
+# --------------------------------------------------------------- HVD004
+
+def _ps_repr(call: ast.Call) -> Optional[str]:
+    ps = _kwarg(call, "process_set")
+    if ps is None:
+        return None
+    return ast.dump(ps)
+
+
+def check_process_set_branches(sf: SourceFile) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.If) or not node.orelse:
+            continue
+        body_ps: Dict[str, Tuple[ast.Call, Optional[str]]] = {}
+        for call, op in _collectives_under_stmts(node.body):
+            body_ps.setdefault(op, (call, _ps_repr(call)))
+        for call, op in _collectives_under_stmts(node.orelse):
+            if op not in body_ps:
+                continue
+            other_call, other_ps = body_ps[op]
+            this_ps = _ps_repr(call)
+            if this_ps != other_ps:
+                yield sf.finding(
+                    call, "HVD004",
+                    f"'{op}' uses a different process_set than the "
+                    f"matching call in the other branch (line "
+                    f"{other_call.lineno}): unless the condition is "
+                    f"globally uniform, ranks disagree on who "
+                    f"participates")
+
+
+RULES = {
+    "HVD001": ("collective under rank-dependent control flow",
+               check_rank_dependent),
+    "HVD002": ("collective named from iteration over an unordered "
+               "container", check_unordered_naming),
+    "HVD003": ("unnamed collective inside a loop (auto-name collision)",
+               check_unnamed_in_loop),
+    "HVD004": ("process_set differs across branches",
+               check_process_set_branches),
+}

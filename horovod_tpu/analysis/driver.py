@@ -1,0 +1,283 @@
+"""hvdlint driver: rule registry, suppression handling, CLI.
+
+One entrypoint (``python -m horovod_tpu.analysis``), one exit code, one
+output format::
+
+    file:line RULE-ID message
+
+Rules have stable IDs (HVD0xx collective consistency, HVD1xx concurrency
+discipline, HVD-ENV documentation drift). A finding on a line is
+suppressed by a trailing ``hvdlint: disable=HVD001 -- root-only by
+design`` comment on that line. The rationale after ``--`` is mandatory:
+a bare suppression is itself a finding (HVD000), so every silenced rule
+carries an explanation a reviewer can audit. ``disable`` with no ID list
+suppresses every rule on the line (rationale still required).
+
+Findings also feed the process metrics registry
+(``hvdlint_findings_total{rule}``, observability/metrics.py) so lint runs
+wired into jobs surface in the same telemetry plane as the runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import pathlib
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: Suppression comment grammar (docs/static_analysis.md). A rule ID
+#: token may contain single dashes (HVD-ENV) but the token pattern
+#: cannot cross the ``--`` rationale separator.
+_ID_TOKEN = r"[A-Za-z0-9_]+(?:-[A-Za-z0-9_]+)*"
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*disable"
+    rf"(?:=(?P<ids>{_ID_TOKEN}(?:\s*,\s*{_ID_TOKEN})*))?"
+    r"(?:\s*--\s*(?P<why>\S.*))?")
+
+#: Suppress-all sentinel in a parsed suppression entry.
+_ALL = "*"
+
+HVD000 = "HVD000"
+
+#: Shared by the AST pass and the HVD-ENV pass — lint_paths dedupes
+#: cross-pass findings by exact message, so there must be ONE copy.
+MSG_NO_RATIONALE = ("suppression comment lacks a rationale: append "
+                    "' -- <why this is safe>' to the disable comment")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a source location."""
+
+    path: str
+    line: int
+    rule_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule_id} {self.message}"
+
+
+def parse_suppression(line: str) -> Optional[Tuple[Set[str], bool]]:
+    """(suppressed rule ids or {"*"}, has_rationale) for one source
+    line, or None if it carries no suppression comment. Shared by the
+    AST rules (via SourceFile) and the repo-level HVD-ENV rule."""
+    m = _SUPPRESS_RE.search(line)
+    if not m:
+        return None
+    ids = m.group("ids")
+    ruleset = ({_ALL} if ids is None else
+               {i.strip().upper() for i in ids.split(",") if i.strip()})
+    return ruleset, m.group("why") is not None
+
+
+def suppression_covers(entry: Optional[Tuple[Set[str], bool]],
+                       rule_id: str) -> bool:
+    if entry is None:
+        return False
+    ruleset, _ = entry
+    return _ALL in ruleset or rule_id.upper() in ruleset
+
+
+class SourceFile:
+    """Parsed source + per-line suppression table shared by every rule."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> (set of suppressed rule ids or {_ALL}, has_rationale)
+        self.suppressions: Dict[int, Tuple[Set[str], bool]] = {}
+        for lineno, line in enumerate(self.lines, 1):
+            entry = parse_suppression(line)
+            if entry is not None:
+                self.suppressions[lineno] = entry
+
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        return suppression_covers(self.suppressions.get(line), rule_id)
+
+    def finding(self, node: ast.AST, rule_id: str, message: str) -> Finding:
+        return Finding(self.path, getattr(node, "lineno", 1), rule_id,
+                       message)
+
+
+def _rationale_findings(sf: SourceFile) -> Iterable[Finding]:
+    """HVD000: a suppression without a ``-- rationale`` is a finding."""
+    for lineno, (_ids, has_why) in sorted(sf.suppressions.items()):
+        if not has_why:
+            yield Finding(sf.path, lineno, HVD000, MSG_NO_RATIONALE)
+
+
+def registry() -> Dict[str, Tuple[str, object]]:
+    """rule_id -> (one-line description, check(sf) -> iterable[Finding]).
+
+    Imported lazily so the CLI only pays for (and only can fail on) the
+    rule modules it actually runs.
+    """
+    from horovod_tpu.analysis import collective_rules, concurrency_rules
+    reg: Dict[str, Tuple[str, object]] = {}
+    reg.update(collective_rules.RULES)
+    reg.update(concurrency_rules.RULES)
+    return reg
+
+
+def lint_source(text: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None,
+                ignore: Sequence[str] = ()) -> List[Finding]:
+    """Run the AST rule families over one source blob (unit-test surface).
+
+    Returns surviving findings (suppressions applied), sorted by line.
+    """
+    sf = SourceFile(path, text)
+    reg = registry()
+    wanted = {r.upper() for r in select} if select is not None else None
+    ignored = {r.upper() for r in ignore}
+    out: List[Finding] = []
+    if (wanted is None or HVD000 in wanted) and HVD000 not in ignored:
+        out.extend(_rationale_findings(sf))
+    for rule_id, (_desc, check) in sorted(reg.items()):
+        if wanted is not None and rule_id not in wanted:
+            continue
+        if rule_id in ignored:
+            continue
+        for f in check(sf):
+            if not sf.suppressed(f.line, f.rule_id):
+                out.append(f)
+    out.sort(key=lambda f: (f.line, f.rule_id))
+    return out
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[pathlib.Path]:
+    seen: Set[pathlib.Path] = set()
+    for p in paths:
+        path = pathlib.Path(p)
+        candidates = (sorted(path.rglob("*.py")) if path.is_dir()
+                      else [path])
+        for c in candidates:
+            c = c.resolve()
+            if c in seen or c.suffix != ".py" or not c.exists():
+                continue
+            # Generated/vendored trees have no lint contract.
+            if "__pycache__" in c.parts:
+                continue
+            seen.add(c)
+            yield c
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               ignore: Sequence[str] = (),
+               root: Optional[str] = None,
+               env_rule: bool = True) -> List[Finding]:
+    """Lint every ``*.py`` under `paths` + the repo-level HVD-ENV rule."""
+    findings: List[Finding] = []
+    for p in paths:
+        # A typo'd path must FAIL the gate, not silently lint nothing —
+        # this command fronts CI.
+        if not pathlib.Path(p).exists():
+            findings.append(Finding(str(p), 1, "HVD999",
+                                    "path does not exist"))
+    for path in _iter_py_files(paths):
+        rel = path
+        if root is not None:
+            try:
+                rel = path.relative_to(pathlib.Path(root).resolve())
+            except ValueError:
+                pass
+        try:
+            text = path.read_text(encoding="utf-8")
+            findings.extend(lint_source(text, str(rel), select=select,
+                                        ignore=ignore))
+        except SyntaxError as e:
+            findings.append(Finding(str(rel), e.lineno or 1, "HVD999",
+                                    f"syntax error: {e.msg}"))
+        except OSError as e:
+            findings.append(Finding(str(rel), 1, "HVD999",
+                                    f"unreadable: {e}"))
+    if env_rule and (select is None or "HVD-ENV" in
+                     {s.upper() for s in select}) \
+            and "HVD-ENV" not in {i.upper() for i in ignore}:
+        from horovod_tpu.analysis import env_rule as env_mod
+        findings.extend(env_mod.check_project(root))
+    # The AST pass and the project-level HVD-ENV pass can both report
+    # the same location (e.g. HVD000 for one bare suppression): dedupe.
+    unique: Dict[Tuple[str, int, str, str], Finding] = {}
+    for f in findings:
+        unique.setdefault((f.path, f.line, f.rule_id, f.message), f)
+    findings = list(unique.values())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings
+
+
+def _record_metrics(findings: Sequence[Finding]) -> None:
+    """Feed findings into the metrics plane (PR 2 registry); lint must
+    still work in environments without the runtime deps, so any import
+    failure is swallowed."""
+    try:
+        from horovod_tpu.observability import metrics as m
+        counter = m.registry().counter(
+            "hvdlint_findings_total", "hvdlint findings by rule",
+            labelnames=("rule",))
+        for f in findings:
+            counter.labels(rule=f.rule_id).inc()
+    except Exception:
+        pass
+
+
+def run_cli(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="hvdlint: collective-consistency and concurrency "
+                    "static analysis (docs/static_analysis.md)")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files or directories to lint")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule IDs to run (default all)")
+    parser.add_argument("--ignore", default="",
+                        help="comma-separated rule IDs to skip")
+    parser.add_argument("--no-env", action="store_true",
+                        help="skip the repo-level HVD-ENV docs-drift rule")
+    parser.add_argument("--root", default=None,
+                        help="repo root for HVD-ENV and relative paths "
+                             "(default: auto-detected from this package)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from horovod_tpu.analysis import env_rule as env_mod
+        reg = dict(registry())
+        reg[env_mod.RULE_ID] = (env_mod.DESCRIPTION, None)
+        reg[HVD000] = ("suppression comment lacks a rationale", None)
+        for rule_id in sorted(reg):
+            print(f"{rule_id}  {reg[rule_id][0]}")
+        return 0
+
+    if not args.paths:
+        parser.error("no paths given (try: horovod_tpu/ examples/)")
+
+    root = args.root
+    if root is None:
+        # horovod_tpu/analysis/driver.py -> repo root two levels up from
+        # the package directory.
+        root = str(pathlib.Path(__file__).resolve().parent.parent.parent)
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    ignore = [s.strip() for s in args.ignore.split(",") if s.strip()]
+    findings = lint_paths(args.paths, select=select, ignore=ignore,
+                          root=root, env_rule=not args.no_env)
+    _record_metrics(findings)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"hvdlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("hvdlint: clean")
+    return 0
+
+
+def main() -> None:
+    sys.exit(run_cli())

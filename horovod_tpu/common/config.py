@@ -73,6 +73,13 @@ HOROVOD_DISABLE_GROUP_FUSION = "HOROVOD_DISABLE_GROUP_FUSION"
 HOROVOD_ADASUM_HALVING = "HOROVOD_ADASUM_HALVING"
 HOROVOD_CONSISTENCY_CHECK = "HOROVOD_CONSISTENCY_CHECK"
 HOROVOD_CONSISTENCY_TIMEOUT = "HOROVOD_CONSISTENCY_TIMEOUT"
+# Cross-rank fingerprint verifier (analysis/verifier.py,
+# docs/static_analysis.md): asymptotically-free divergence detection
+# through the launcher's rendezvous KV.
+HOROVOD_CHECK_COLLECTIVES = "HOROVOD_CHECK_COLLECTIVES"
+HOROVOD_CHECK_COLLECTIVES_INTERVAL = "HOROVOD_CHECK_COLLECTIVES_INTERVAL"
+HOROVOD_CHECK_COLLECTIVES_WINDOW = "HOROVOD_CHECK_COLLECTIVES_WINDOW"
+HOROVOD_CHECK_COLLECTIVES_TIMEOUT = "HOROVOD_CHECK_COLLECTIVES_TIMEOUT"
 HOROVOD_NATIVE_KV_ADDR = "HOROVOD_NATIVE_KV_ADDR"
 HOROVOD_NATIVE_KV_PORT = "HOROVOD_NATIVE_KV_PORT"
 
@@ -155,6 +162,12 @@ class Config:
     # Debug negotiation: agree cross-rank on every eager collective's
     # signature before running it (core/consistency.py).
     consistency_check: bool = False
+    # Rolling fingerprint of the collective call sequence, periodically
+    # cross-checked through the rendezvous KV (analysis/verifier.py).
+    check_collectives: bool = False
+    check_collectives_interval: int = 10
+    check_collectives_window: int = 512
+    check_collectives_timeout: float = 5.0
     dynamic_process_sets: bool = False
 
     # Topology overrides (launcher-injected)
@@ -231,6 +244,13 @@ class Config:
             consistency_check=_env_bool(
                 HOROVOD_CONSISTENCY_CHECK,
                 default=bool(os.environ.get(HOROVOD_NATIVE_KV_ADDR))),
+            check_collectives=_env_bool(HOROVOD_CHECK_COLLECTIVES),
+            check_collectives_interval=_env_int(
+                HOROVOD_CHECK_COLLECTIVES_INTERVAL, 10),
+            check_collectives_window=_env_int(
+                HOROVOD_CHECK_COLLECTIVES_WINDOW, 512),
+            check_collectives_timeout=_env_float(
+                HOROVOD_CHECK_COLLECTIVES_TIMEOUT, 5.0),
             dynamic_process_sets=_env_bool(HOROVOD_DYNAMIC_PROCESS_SETS),
             rank=_env_or_mpi(HOROVOD_RANK, "HOROVOD_MPI_RANK_ENV"),
             size=opt_int(HOROVOD_SIZE),

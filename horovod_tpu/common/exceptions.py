@@ -51,6 +51,14 @@ class StalledTensorError(HorovodTpuError):
     """Stall inspector forced shutdown (reference stall_inspector.cc)."""
 
 
+class CollectiveDivergenceError(HorovodTpuError):
+    """The cross-rank fingerprint verifier (HOROVOD_CHECK_COLLECTIVES,
+    analysis/verifier.py) caught ranks issuing different collective
+    sequences. Deliberately NOT a HorovodInternalError: the elastic
+    retry loop must not restart a job whose program is deterministic-
+    ally divergent — it would diverge again every round."""
+
+
 class RetryError(HorovodTpuError):
     """A RetryPolicy exhausted its attempts or overall deadline.
 

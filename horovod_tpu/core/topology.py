@@ -435,6 +435,14 @@ def init(process_sets: Optional[Sequence] = None,
             # wait for contributions that can never arrive.
             consistency.maybe_init(cfg, jax.process_index(),
                                    jax.process_count())
+        if cfg.check_collectives:
+            # Fingerprint verifier (analysis/verifier.py): like the
+            # consistency checker, agreement is between PROCESSES — a
+            # single controller contributes one call sequence no matter
+            # how many device-ranks it owns.
+            from horovod_tpu.analysis import verifier as _vfmod
+            _vfmod.maybe_init(cfg, jax.process_index(),
+                              jax.process_count())
         if cfg.autotune:
             from horovod_tpu.core.autotune import ParameterManager
             _state.parameter_manager = ParameterManager(cfg)
@@ -536,6 +544,11 @@ def _start_stall_watch(si, cfg: Config) -> None:
                 except Exception:
                     pass
                 try:
+                    from horovod_tpu.analysis import verifier as _vf
+                    who += _vf.stall_context()
+                except Exception:
+                    pass
+                try:
                     from horovod_tpu.observability import metrics as _m
                     _m.registry().counter(
                         "horovod_stall_warnings_total",
@@ -591,6 +604,8 @@ def shutdown() -> None:
             _state.timeline.shutdown()
         from horovod_tpu.core import consistency as _cc
         _cc.reset()
+        from horovod_tpu.analysis import verifier as _vfmod
+        _vfmod.reset()
         from horovod_tpu.ops import collectives as _coll
         _coll.clear_compiled_cache()
         _state.reset()

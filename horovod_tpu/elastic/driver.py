@@ -110,26 +110,26 @@ class ElasticDriver:
         self.discovery_failures = 0   # consecutive; 0 once healthy
         self.registry = WorkerStateRegistry()
 
-        self._workers: Dict[int, _Worker] = {}   # rank -> worker
+        self._workers: Dict[int, _Worker] = {}   # rank -> worker; guarded-by: _lock
         # Workers removed by a resize leave COOPERATIVELY: they observe the
         # round bump, join the distributed-shutdown barrier with the
         # survivors, see no assignment, and exit 0. SIGTERMing them instead
         # would strand the survivors' shutdown barrier on a dead task
         # (jax coordination service), so they are only force-stopped after
         # a grace period. (leaving_deadline, worker) pairs.
-        self._leaving: List[tuple] = []
+        self._leaving: List[tuple] = []  # guarded-by: _lock
         self.leave_grace_seconds = 60.0
-        self._round = 0
-        self._resets = 0
+        self._round = 0  # guarded-by: _lock
+        self._resets = 0  # guarded-by: _lock
         # Per-round outcome tracking (reference: WorkerStateRegistry ends
         # the job when the last worker exits and none succeeded,
         # runner/elastic/registration.py:150-165). Without this, a
         # deterministic user-code failure loops forever: blacklist cooldown
         # (≤300s) re-admits the host before elastic_timeout can fire.
-        self._round_spawned = 0
-        self._round_failed = 0
-        self._round_succeeded = 0
-        self.consecutive_failed_rounds = 0
+        self._round_spawned = 0    # guarded-by: _lock
+        self._round_failed = 0     # guarded-by: _lock
+        self._round_succeeded = 0  # guarded-by: _lock
+        self.consecutive_failed_rounds = 0  # guarded-by: _lock
         self._shutdown = threading.Event()
         self._host_change = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -343,17 +343,20 @@ class ElasticDriver:
         if not self._host_change.is_set():
             return False
         self._host_change.clear()
-        self._resets += 1
+        with self._lock:
+            self._resets += 1
+            resets = self._resets
         _mx()["resets"].inc()
-        if self.reset_limit is not None and self._resets > self.reset_limit:
+        if self.reset_limit is not None and resets > self.reset_limit:
             raise ResetLimitExceededError(
                 f"elastic reset limit {self.reset_limit} exceeded after "
-                f"{self._resets - 1} reset(s) (reference: launch.py "
+                f"{resets - 1} reset(s) (reference: launch.py "
                 f"--reset-limit)")
         try:
             self._start_round()
         except HorovodTpuError:
-            self._resets -= 1
+            with self._lock:
+                self._resets -= 1
             self._host_change.set()
             return False
         return True
@@ -487,8 +490,10 @@ def drive_elastic_loop(driver: "ElasticDriver", elastic_timeout: float,
                       f"({workers[r].slot.hostname}) exited code={c}",
                       file=sys.stderr)
                 driver.handle_worker_exit(r, c, host_failure=(c != 0))
-            if driver.consecutive_failed_rounds >= failed_round_limit:
-                print(f"elastic: {driver.consecutive_failed_rounds} "
+            with driver._lock:
+                failed_rounds = driver.consecutive_failed_rounds
+            if failed_rounds >= failed_round_limit:
+                print(f"elastic: {failed_rounds} "
                       "consecutive rounds failed on every worker; "
                       "giving up", file=sys.stderr)
                 return 1

@@ -371,8 +371,10 @@ def barrier(process_set: Optional[ProcessSet] = None):
 def broadcast_variables(variables, root_rank: int = 0) -> None:
     """In-place sync of tf.Variables from root (reference:
     tensorflow/__init__.py broadcast_variables)."""
-    for v in variables:
-        v.assign(broadcast(v, root_rank))
+    for i, v in enumerate(variables):
+        v.assign(broadcast(v, root_rank,
+                           name=getattr(v, "name", None)
+                           or f"broadcast_variables.{i}"))
 
 
 def broadcast_global_variables(root_rank: int = 0) -> None:
@@ -812,7 +814,8 @@ class MetricAverageCallback:
                     for k, v in list(logs.items()):
                         logs[k] = float(np.asarray(
                             C.allreduce(np.asarray(v, np.float32),
-                                        op=Average)))
+                                        op=Average,
+                                        name=f"metric_avg.{k}")))
 
         return _CB()
 

@@ -522,9 +522,10 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
     else:
         items = list(params)
     torch = _torch()
-    for _, p in items:
+    for pname, p in items:
         if isinstance(p, torch.Tensor):
-            p.data.copy_(broadcast(p.data, root_rank))
+            p.data.copy_(broadcast(p.data, root_rank,
+                                   name=f"broadcast_parameters.{pname}"))
 
 
 def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
@@ -689,10 +690,11 @@ class DistributedOptimizer:
                 else:
                     dense.append(p)
         pre, post = self._scales()
-        for plan in self._group_plan(dense):
+        for gi, plan in enumerate(self._group_plan(dense)):
             pairs = [self.compression.compress(p.grad.data) for p in plan]
             reduced = grouped_allreduce(
                 [t for t, _ in pairs], op=self.op,
+                name=f"grad_group.{gi}",
                 prescale_factor=pre, postscale_factor=post,
                 process_set=self.process_set)
             for p, r, (_, ctx) in zip(plan, reduced, pairs):

@@ -155,7 +155,7 @@ class _Family:
         self.buckets = buckets
         self._label_max = label_max
         self._lock = threading.Lock()
-        self._series: Dict[Tuple[str, ...], object] = {}
+        self._series: Dict[Tuple[str, ...], object] = {}  # guarded-by: _lock
         if not labelnames:
             self._default = self._new_series()
             self._series[()] = self._default
@@ -169,7 +169,11 @@ class _Family:
 
     def labels(self, **kw):
         key = tuple(str(kw.get(n, "")) for n in self.labelnames)
-        s = self._series.get(key)
+        # Lock-free fast path: dict.get on an existing key is atomic
+        # under the GIL and series are never removed, so a hit can only
+        # return a fully-constructed series; misses fall through to the
+        # locked double-check below.
+        s = self._series.get(key)  # hvdlint: disable=HVD101 -- racy read is benign: series are add-only and dict.get is atomic under the GIL
         if s is not None:
             return s
         with self._lock:
@@ -229,7 +233,7 @@ class MetricsRegistry:
         self.label_max = label_max if label_max is not None \
             else _env_int(HOROVOD_METRICS_LABEL_MAX, 64)
         self._lock = threading.Lock()
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  # guarded-by: _lock
 
     def _family(self, name: str, kind: str, help_: str,
                 labelnames: Sequence[str],
@@ -354,7 +358,7 @@ def parse_snapshot(data: bytes) -> Optional[dict]:
 
 
 # ---------------------------------------------------------------- process
-_registry: Optional[MetricsRegistry] = None
+_registry: Optional[MetricsRegistry] = None  # guarded-by: _registry_lock
 _registry_lock = threading.Lock()
 
 
@@ -363,7 +367,7 @@ def registry() -> MetricsRegistry:
     HOROVOD_METRICS=0 (metrics are on by default: the registry costs ~ns
     per event and the export paths all gate separately)."""
     global _registry
-    reg = _registry
+    reg = _registry  # hvdlint: disable=HVD101 -- double-checked locking: unlocked read either sees None (slow path re-checks under lock) or the final value
     if reg is None:
         with _registry_lock:
             if _registry is None:

@@ -150,12 +150,24 @@ class StallWatchdog:
                 if self.shutdown_sec > 0 and age >= self.shutdown_sec:
                     stalled, _ = self.inspector.check()
                     _mx()["stall_shut"].inc()
+                    # With HOROVOD_CHECK_COLLECTIVES=1 the fingerprint
+                    # verifier turns the bare timeout into a diagnosis:
+                    # last agreed call index + first divergent call
+                    # (analysis/verifier.py stall_context). Guarded:
+                    # the stall report must survive a broken analysis
+                    # import.
+                    try:
+                        from horovod_tpu.analysis import verifier as _vf
+                        fp_context = _vf.stall_context()
+                    except Exception:
+                        fp_context = ""
                     raise HorovodInternalError(
                         f"collective '{name}' stalled past "
                         f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
                         f"{self.shutdown_sec:.0f}s"
                         + (f" (outstanding: {', '.join(stalled)})"
-                           if stalled else ""))
+                           if stalled else "")
+                        + fp_context)
             if "error" in box:
                 raise box["error"]
             return box["value"]
@@ -634,7 +646,8 @@ def allreduce(tensor: Any,
                 x, rop, k, prescale_factor, postscale_factor),
             out_shardings=out_sh))
         _consistency(f"allreduce(shape={(k,) + shape},dtype={dtype},"
-                     f"op={int(rop)},ps={ps.process_set_id})", ps)
+                     f"op={int(rop)},ps={ps.process_set_id})", ps,
+                     name=name or "allreduce")
         with _instrument(name or "allreduce", "ALLREDUCE",
                          nbytes_fn=lambda: (
                              (math.prod(shape) * k *
@@ -653,7 +666,7 @@ def allreduce(tensor: Any,
         fn = _cache.get_or_build(key, lambda: _builder_allreduce(
             ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
     _consistency(f"allreduce(shape={g.shape},dtype={g.dtype},op={int(rop)},"
-                 f"ps={ps.process_set_id})", ps)
+                 f"ps={ps.process_set_id})", ps, name=name or "allreduce")
     with _instrument(name or "allreduce", "ALLREDUCE", arrays=(g,)):
         return _from_global(_execute(fn, g), stacked)
 
@@ -703,7 +716,8 @@ def grouped_allreduce(tensors: Sequence[Any],
         fn = _cache.get_or_build(key, build_fast)
         _consistency(f"grouped_allreduce(n={len(tensors)},shapes="
                      f"{[(k,) + s for s in shapes]},op={int(rop)},"
-                     f"ps={ps.process_set_id})", ps)
+                     f"ps={ps.process_set_id})", ps,
+                     name=name or "grouped_allreduce")
         with _instrument(name or "grouped_allreduce", "ALLREDUCE",
                          ntensors=len(tensors),
                          nbytes_fn=lambda: (
@@ -747,7 +761,8 @@ def grouped_allreduce(tensors: Sequence[Any],
     fn = _cache.get_or_build(key, build)
     _consistency(f"grouped_allreduce(n={len(gs)},shapes="
                  f"{[tuple(g.shape) for g in gs]},op={int(rop)},"
-                 f"ps={ps.process_set_id})", ps)
+                 f"ps={ps.process_set_id})", ps,
+                 name=name or "grouped_allreduce")
     with _instrument(name or "grouped_allreduce", "ALLREDUCE",
                      arrays=tuple(gs), ntensors=len(gs)):
         outs = _execute(fn, *gs)
@@ -778,7 +793,7 @@ def broadcast(tensor: Any, root_rank: int,
 
     fn = _cache.get_or_build(key, build)
     _consistency(f"broadcast(shape={g.shape},dtype={g.dtype},root={root},"
-                 f"ps={ps.process_set_id})", ps)
+                 f"ps={ps.process_set_id})", ps, name=name or "broadcast")
     with _instrument(name or "broadcast", "BROADCAST", arrays=(g,)):
         return _from_global(_execute(fn, g), stacked)
 
@@ -802,7 +817,8 @@ def allgather(tensor: Any, name: Optional[str] = None,
     # before the diagnostic could fire. The signature excludes dim 0, which
     # may legitimately differ per rank (uneven allgather).
     _consistency(f"allgather(rest={tuple(g.shape[2:])},ndim={g.ndim},"
-                 f"dtype={g.dtype},ps={ps.process_set_id})", ps)
+                 f"dtype={g.dtype},ps={ps.process_set_id})", ps,
+                 name=name or "allgather")
     if stacked:
         # Single-controller stacked input: all rows share a shape — even path.
         sizes = (int(g.shape[1]),) * k
@@ -899,7 +915,8 @@ def reducescatter(tensor: Any, op: Any = T.ReduceOp.AVERAGE,
 
     fn = _cache.get_or_build(key, build)
     _consistency(f"reducescatter(shape={g.shape},dtype={g.dtype},"
-                 f"op={int(rop)},ps={ps.process_set_id})", ps)
+                 f"op={int(rop)},ps={ps.process_set_id})", ps,
+                 name=name or "reducescatter")
     with _instrument(name or "reducescatter", "REDUCESCATTER",
                      arrays=(g,)):
         out = _execute(fn, g)
@@ -987,7 +1004,8 @@ def grouped_reducescatter(tensors: Sequence[Any], op: Any = T.ReduceOp.AVERAGE,
     fn = _cache.get_or_build(key, build)
     _consistency(f"grouped_reducescatter(n={len(gs)},shapes="
                  f"{[tuple(g.shape) for g in gs]},op={int(rop)},"
-                 f"ps={ps.process_set_id})", ps)
+                 f"ps={ps.process_set_id})", ps,
+                 name=name or "grouped_reducescatter")
     with _instrument(name or "grouped_reducescatter", "REDUCESCATTER",
                      arrays=tuple(gs), ntensors=len(gs)):
         outs = _execute(fn, *gs)
@@ -1016,7 +1034,8 @@ def grouped_allgather(tensors: Sequence[Any],
     _consistency(f"grouped_allgather(n={n},"
                  f"rests={[tuple(g.shape[2:]) for g in gs]},"
                  f"dtypes={[str(g.dtype) for g in gs]},"
-                 f"ps={ps.process_set_id})", ps)
+                 f"ps={ps.process_set_id})", ps,
+                 name=name or "grouped_allgather")
     if jax.process_count() == 1:
         sizes_matrix = np.tile(
             np.asarray([[int(g.shape[1]) for g in gs]], np.int64), (k, 1))
@@ -1119,7 +1138,8 @@ def alltoall(tensor: Any, splits: Optional[Any] = None,
     # Consistency check BEFORE the blocking splits exchange (see allgather);
     # dim 0 = sum(splits) may legitimately differ per rank.
     _consistency(f"alltoall(rest={tuple(g.shape[2:])},ndim={g.ndim},"
-                 f"dtype={g.dtype},ps={ps.process_set_id})", ps)
+                 f"dtype={g.dtype},ps={ps.process_set_id})", ps,
+                 name=name or "alltoall")
     # Exchange the full splits matrix (controller's AlltoallGetRecvSplits,
     # controller.h:63). In stacked mode rows share `my_splits`.
     if stacked and splits is not None:
@@ -1348,23 +1368,43 @@ def _stall_done(name: str) -> None:
         si.done(name)
 
 
-def _consistency(desc: str, ps: ProcessSet) -> None:
-    """Debug-mode cross-rank agreement on this collective's signature
-    (HOROVOD_CONSISTENCY_CHECK; core/consistency.py — the coordinator's
-    mismatch checking, controller.cc:74-447, as an opt-in). Agreement runs
-    among the process set's members only, on the set's own sequence —
-    subset-set collectives must not involve (or desynchronize) outsiders."""
+def _consistency(desc: str, ps: ProcessSet,
+                 name: Optional[str] = None) -> None:
+    """Dispatch choke point for cross-rank call-sequence checking.
+
+    Two independent verifiers hook here:
+
+    * HOROVOD_CONSISTENCY_CHECK (core/consistency.py): synchronous
+      per-call agreement on `desc` — the coordinator's mismatch
+      checking, controller.cc:74-447, as an opt-in. Agreement runs
+      among the process set's members only, on the set's own sequence —
+      subset-set collectives must not involve (or desynchronize)
+      outsiders.
+    * HOROVOD_CHECK_COLLECTIVES (analysis/verifier.py): rolling
+      fingerprint of (op-signature, name) tuples, cross-checked through
+      the rendezvous KV every N calls — asymptotically free, raises
+      CollectiveDivergenceError naming the divergent rank and call.
+    """
     from horovod_tpu.core import consistency as _cc
+    from horovod_tpu.analysis import verifier as _vf
     checker = _cc.get()
+    v = _vf.get()
+    if checker is None and v is None:
+        return
+    ranks = ps.ranks  # None ⇒ world
+    if ranks is None:
+        group = "world"
+    else:
+        import hashlib as _hl
+        member_tag = _hl.sha256(repr(tuple(ranks)).encode()).hexdigest()
+        group = f"ps{ps.process_set_id}-{member_tag[:12]}"
     if checker is not None:
-        ranks = ps.ranks  # None ⇒ world
-        if ranks is None:
-            group = "world"
-        else:
-            import hashlib as _hl
-            member_tag = _hl.sha256(repr(tuple(ranks)).encode()).hexdigest()
-            group = f"ps{ps.process_set_id}-{member_tag[:12]}"
         checker.check(desc, ranks=ranks, group=group)
+    if v is not None:
+        # Scoped per process set, like the checker: only members
+        # dispatch on a subset set, so it has its own sequence.
+        v.record(f"{desc}|name={name}" if name else desc,
+                 ranks=ranks, group=group)
 
 
 # ---------------------------------------------------------------- metrics

@@ -59,10 +59,10 @@ class Timeline:
         self._active = False
         self._t0 = time.monotonic_ns()
         self._lock = threading.Lock()
-        # Guarded by _lock: span_begin/span_end may race across threads
-        # (concurrent collectives from frontends' async handles), and a
-        # plain dict read-modify-write drops or corrupts spans.
-        self._pending_spans: Dict[tuple, float] = {}
+        # span_begin/span_end may race across threads (concurrent
+        # collectives from frontends' async handles), and a plain dict
+        # read-modify-write drops or corrupts spans.
+        self._pending_spans: Dict[tuple, float] = {}  # guarded-by: _lock
         self._native = None
         self._use_native = use_native
 

@@ -80,7 +80,7 @@ def announce_port(port: int) -> None:
 
 
 class _KVHandler(BaseHTTPRequestHandler):
-    store: Dict[str, bytes] = {}
+    store: Dict[str, bytes] = {}  # guarded-by: lock
     lock = threading.Lock()
     secret: Optional[bytes] = None
 

@@ -370,7 +370,35 @@ def scenario_consistency_gather_mismatch(hvd, rank, size):
     check(False, f"rank {rank}: expected TensorShapeMismatchError")
 
 
+def scenario_check_collectives_skip(hvd, rank, size):
+    """Rank 1 silently skips one named allreduce mid-stream: the
+    fingerprint verifier (HOROVOD_CHECK_COLLECTIVES=1) must raise a
+    CollectiveDivergenceError naming the divergent rank and the first
+    divergent call index on BOTH ranks — before the stall deadline —
+    instead of the job dying as an anonymous stall (ISSUE 3 e2e bar)."""
+    from horovod_tpu.analysis import verifier as vf
+    from horovod_tpu.common.exceptions import CollectiveDivergenceError
+
+    check(vf.get() is not None, "fingerprint verifier not active")
+    x = np.ones((2,), np.float32)
+    try:
+        for i in range(12):
+            if rank == 1 and i == 2:
+                continue  # the bug under test: one rank skips call #2
+            hvd.allreduce(x, op="sum", name=f"t{i}")
+    except CollectiveDivergenceError as e:
+        msg = str(e)
+        # Names both ranks, the divergent call, and both call descs.
+        check("rank 0" in msg and "rank 1" in msg, msg)
+        check("first divergent call #2" in msg, msg)
+        check("t2" in msg and "t3" in msg, msg)
+        check("fingerprint" in msg, msg)
+        return
+    check(False, f"rank {rank}: expected CollectiveDivergenceError")
+
+
 SCENARIOS = {
+    "check_collectives_skip": scenario_check_collectives_skip,
     "consistency_mismatch": scenario_consistency_mismatch,
     "consistency_missing": scenario_consistency_missing,
     "consistency_subset": scenario_consistency_subset,

@@ -1,0 +1,766 @@
+"""hvdlint unit suite: fixture snippets for every rule (positive,
+negative, suppression), the driver/CLI surface, the HVD-ENV project
+rule, the fingerprint verifier against a fake KV, and the stall-
+watchdog message integration (docs/static_analysis.md)."""
+
+import pathlib
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import env_rule
+from horovod_tpu.analysis.driver import lint_paths, lint_source, run_cli
+from horovod_tpu.analysis.verifier import FingerprintVerifier
+from horovod_tpu.common.exceptions import (CollectiveDivergenceError,
+                                           HorovodInternalError)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def ids(findings):
+    return [f.rule_id for f in findings]
+
+
+def src(text):
+    return textwrap.dedent(text)
+
+
+# ---------------------------------------------------------------- HVD001
+
+def test_hvd001_rank_guarded_collective():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x):
+            if hvd.rank() == 0:
+                hvd.broadcast(x, root_rank=0)
+    """))
+    assert ids(findings) == ["HVD001"]
+    assert "rank-dependent" in findings[0].message
+
+
+def test_hvd001_else_branch_and_while():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x):
+            if hvd.rank() == 0:
+                pass
+            else:
+                hvd.allreduce(x)
+            while hvd.local_rank() != 0:
+                hvd.barrier()
+    """))
+    assert ids(findings) == ["HVD001", "HVD001"]
+
+
+def test_hvd001_negative_no_collective_under_guard():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x):
+            y = hvd.allreduce(x, name="t")
+            if hvd.rank() == 0:
+                print(y)
+    """))
+    assert findings == []
+
+
+def test_hvd001_negative_nested_def_not_flagged():
+    # A def inside the guard only runs if called; the callsite is the
+    # thing to flag.
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x):
+            if hvd.rank() == 0:
+                def helper():
+                    return hvd.allreduce(x)
+            return 0
+    """))
+    assert findings == []
+
+
+def test_hvd001_suppression_with_rationale():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x):
+            if hvd.rank() == 0:
+                hvd.broadcast(x, root_rank=0)  # hvdlint: disable=HVD001 -- every rank reaches this branch via a synced flag
+    """))
+    assert findings == []
+
+
+def test_suppression_without_rationale_is_hvd000():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x):
+            if hvd.rank() == 0:
+                hvd.broadcast(x, root_rank=0)  # hvdlint: disable=HVD001
+    """))
+    assert ids(findings) == ["HVD000"]
+
+
+def test_foreign_receivers_not_collectives():
+    findings = lint_source(src("""
+        import numpy as np, jax.numpy as jnp, horovod_tpu as hvd
+        def f(x):
+            if hvd.rank() == 0:
+                np.broadcast(x, x)
+                jnp.broadcast(x, x)
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------- HVD002
+
+def test_hvd002_set_iteration_naming():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(tensors):
+            for k in {"a", "b"}:
+                hvd.allreduce(tensors[k], name="grad." + k)
+    """))
+    assert ids(findings) == ["HVD002"]
+    assert "unordered" in findings[0].message
+
+
+def test_hvd002_set_call_and_fstring():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(d):
+            for k in set(d):
+                hvd.allreduce(d[k], name=f"g.{k}")
+    """))
+    assert ids(findings) == ["HVD002"]
+
+
+def test_hvd002_negative_ordered_iteration():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(d):
+            for k in sorted(d):
+                hvd.allreduce(d[k], name=f"g.{k}")
+            for k in ["a", "b"]:
+                hvd.allreduce(d[k], name=f"g.{k}")
+    """))
+    assert findings == []
+
+
+def test_hvd002_negative_name_not_from_loop_var():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(d):
+            for i, k in enumerate(sorted({"a", "b"})):
+                hvd.allreduce(d[k], name="fixed")
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------- HVD003
+
+def test_hvd003_unnamed_in_loop():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(ts):
+            for t in ts:
+                hvd.allreduce(t)
+    """))
+    assert ids(findings) == ["HVD003"]
+
+
+def test_hvd003_negative_named_or_wrapper():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(ts, params):
+            for i, t in enumerate(ts):
+                hvd.allreduce(t, name=f"t{i}")
+            for p in params:
+                hvd.broadcast_parameters(p, root_rank=0)
+            hvd.allreduce(ts[0])  # not in a loop
+    """))
+    assert findings == []
+
+
+def test_hvd003_negative_positional_name():
+    # name is the 3rd positional parameter of allreduce/broadcast and
+    # the 2nd of allgather — positionally-named calls are named calls.
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(ts):
+            for i, t in enumerate(ts):
+                hvd.allreduce(t, None, f"t{i}")
+                hvd.broadcast(t, 0, f"b{i}")
+                hvd.allgather(t, f"g{i}")
+    """))
+    assert findings == []
+
+
+def test_hvd003_suppression():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(ts):
+            for t in ts:
+                hvd.allreduce(t)  # hvdlint: disable=HVD003 -- single-iteration loop in this config
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------- HVD004
+
+def test_hvd004_process_set_differs():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x, cond, ps_a, ps_b):
+            if cond:
+                hvd.allreduce(x, name="t", process_set=ps_a)
+            else:
+                hvd.allreduce(x, name="t", process_set=ps_b)
+    """))
+    assert ids(findings) == ["HVD004"]
+
+
+def test_hvd004_missing_in_one_branch():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x, cond, ps_a):
+            if cond:
+                hvd.allreduce(x, name="t", process_set=ps_a)
+            else:
+                hvd.allreduce(x, name="t")
+    """))
+    assert ids(findings) == ["HVD004"]
+
+
+def test_hvd004_negative_same_process_set():
+    findings = lint_source(src("""
+        import horovod_tpu as hvd
+        def f(x, cond, ps_a):
+            if cond:
+                hvd.allreduce(x, name="t", process_set=ps_a)
+            else:
+                hvd.allreduce(x * 2, name="t", process_set=ps_a)
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------- HVD101
+
+def test_hvd101_guarded_attr_outside_lock():
+    findings = lint_source(src("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _lock
+            def bad(self):
+                return self._d.get(1)
+            def good(self):
+                with self._lock:
+                    return self._d.get(1)
+    """))
+    assert ids(findings) == ["HVD101"]
+    assert "_d" in findings[0].message and "_lock" in findings[0].message
+
+
+def test_hvd101_init_exempt_and_cross_object_lock():
+    findings = lint_source(src("""
+        import threading
+        class H:
+            store = {}  # guarded-by: lock
+            lock = threading.Lock()
+            def touch(self):
+                with self.lock:
+                    self.store["k"] = 1
+        class S:
+            def __init__(self, h):
+                self._h = h
+            def put(self, k, v):
+                with self._h.lock:
+                    self._h.store[k] = v
+    """))
+    assert findings == []
+
+
+def test_hvd101_module_global():
+    findings = lint_source(src("""
+        import threading
+        _lk = threading.Lock()
+        _state = {}  # guarded-by: _lk
+        def bad():
+            _state["x"] = 1
+        def good():
+            with _lk:
+                _state["x"] = 1
+    """))
+    assert ids(findings) == ["HVD101"]
+
+
+def test_hvd101_suppression_with_rationale():
+    findings = lint_source(src("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _lock
+            def fast(self):
+                return self._d.get(1)  # hvdlint: disable=HVD101 -- racy read is benign: add-only dict, atomic get under the GIL
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------- HVD102
+
+def test_hvd102_thread_without_daemon():
+    findings = lint_source(src("""
+        import threading
+        def f():
+            t = threading.Thread(target=f)
+            t.start()
+    """))
+    assert ids(findings) == ["HVD102"]
+
+
+def test_hvd102_negative_daemon_given():
+    findings = lint_source(src("""
+        import threading
+        def f():
+            threading.Thread(target=f, daemon=True).start()
+            threading.Thread(target=f, daemon=False).start()
+    """))
+    assert findings == []
+
+
+def test_hvd102_other_thread_classes_ignored():
+    findings = lint_source(src("""
+        import foo
+        def f():
+            foo.Thread(target=f)
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------- HVD103
+
+def test_hvd103_sleep_under_lock():
+    findings = lint_source(src("""
+        import threading, time
+        lock = threading.Lock()
+        def f():
+            with lock:
+                time.sleep(1)
+    """))
+    assert ids(findings) == ["HVD103"]
+
+
+def test_hvd103_negative_outside_lock_or_non_lock_cm():
+    findings = lint_source(src("""
+        import threading, time
+        lock = threading.Lock()
+        def f(path):
+            with lock:
+                x = 1
+            time.sleep(1)
+            with open(path) as fh:
+                time.sleep(0.1)  # not under a lock-ish context
+    """))
+    assert findings == []
+
+
+def test_hvd103_wait_and_urlopen_under_lock():
+    findings = lint_source(src("""
+        import threading
+        from urllib.request import urlopen
+        lock = threading.Lock()
+        def f(ev):
+            with lock:
+                ev.wait(5)
+                urlopen("http://x")
+    """))
+    assert ids(findings) == ["HVD103", "HVD103"]
+
+
+# ------------------------------------------------------------- HVD-ENV
+
+def _mk_repo(tmp_path, code, docs):
+    (tmp_path / "horovod_tpu").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "horovod_tpu" / "m.py").write_text(code)
+    (tmp_path / "docs" / "env_vars.md").write_text(docs)
+    return tmp_path
+
+
+def test_env_rule_flags_undocumented(tmp_path):
+    root = _mk_repo(tmp_path,
+                    'import os\nv = os.environ.get("HOROVOD_MYSTERY")\n',
+                    "| `HOROVOD_OTHER` | x |\n")
+    findings = env_rule.check_project(str(root))
+    assert [f.rule_id for f in findings] == ["HVD-ENV"]
+    assert "HOROVOD_MYSTERY" in findings[0].message
+
+
+def test_env_rule_documented_and_composed_pass(tmp_path):
+    root = _mk_repo(
+        tmp_path,
+        'import os\n'
+        'a = os.environ.get("HOROVOD_MYSTERY")\n'
+        'b = os.environ.get("HOROVOD_KV_RETRY_MAX_ATTEMPTS")\n',
+        "`HOROVOD_MYSTERY` and `HOROVOD_KV_RETRY` prefix\n")
+    assert env_rule.check_project(str(root)) == []
+
+
+def test_env_rule_outside_repo_is_noop(tmp_path):
+    assert env_rule.check_project(str(tmp_path)) == []
+
+
+def test_env_rule_respects_suppression(tmp_path):
+    root = _mk_repo(
+        tmp_path,
+        'import os\n'
+        'v = os.environ.get("HOROVOD_SECRET_KNOB")'
+        '  # hvdlint: disable=HVD-ENV -- internal-only knob, not a supported surface\n',
+        "nothing documented\n")
+    assert env_rule.check_project(str(root)) == []
+
+
+def test_env_rule_suppression_without_rationale_is_hvd000(tmp_path):
+    root = _mk_repo(
+        tmp_path,
+        'import os\n'
+        'v = os.environ.get("HOROVOD_SECRET_KNOB")'
+        '  # hvdlint: disable=HVD-ENV\n',
+        "nothing documented\n")
+    findings = env_rule.check_project(str(root))
+    assert [f.rule_id for f in findings] == ["HVD000"]
+
+
+# ------------------------------------------------------- driver surface
+
+def test_driver_output_format_and_exit(tmp_path, capsys):
+    bad = tmp_path / "train.py"
+    bad.write_text(src("""
+        import horovod_tpu as hvd
+        def f(x):
+            if hvd.rank() == 0:
+                hvd.broadcast(x, root_rank=0)
+    """))
+    rc = run_cli([str(bad), "--no-env"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = [ln for ln in out.splitlines() if "HVD001" in ln][0]
+    # Uniform `file:line rule-id message` output.
+    loc, rule, *_ = line.split(" ", 2)
+    assert loc.endswith("train.py:5") and rule == "HVD001"
+
+
+def test_driver_select_and_clean_exit(tmp_path, capsys):
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert run_cli([str(ok), "--no-env"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_driver_list_rules(capsys):
+    assert run_cli(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("HVD001", "HVD002", "HVD003", "HVD004", "HVD101",
+                 "HVD102", "HVD103", "HVD-ENV", "HVD000"):
+        assert rule in out
+
+
+def test_select_and_ignore_cover_hvd000(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(src("""
+        import horovod_tpu as hvd
+        def g(ts):
+            for t in ts:
+                hvd.allreduce(t)  # hvdlint: disable=HVD003
+    """))
+    # Bare suppression → HVD000 by default...
+    assert [x.rule_id for x in lint_paths([str(f)], env_rule=False)] \
+        == ["HVD000"]
+    # ...but --ignore/--select apply to HVD000 like any other rule.
+    assert lint_paths([str(f)], ignore=["HVD000"], env_rule=False) == []
+    assert lint_paths([str(f)], select=["HVD001"], env_rule=False) == []
+
+
+def test_env_rule_hvd000_not_duplicated(tmp_path):
+    """A bare HVD-ENV suppression inside the linted tree must yield ONE
+    HVD000, not one from the AST pass plus one from check_project."""
+    root = _mk_repo(
+        tmp_path,
+        'X = "HOROVOD_SECRET_KNOB"  # hvdlint: disable=HVD-ENV\n',
+        "nothing documented\n")
+    findings = lint_paths([str(root / "horovod_tpu")], root=str(root))
+    assert [f.rule_id for f in findings] == ["HVD000"]
+
+
+def test_syntax_error_becomes_hvd999(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(:\n")
+    findings = lint_paths([str(bad)], env_rule=False)
+    assert [f.rule_id for f in findings] == ["HVD999"]
+
+
+def test_nonexistent_path_fails_the_gate(tmp_path):
+    """A typo'd path must fail lint, not silently report clean — this
+    command fronts CI."""
+    for bogus in (tmp_path / "no_such_dir", tmp_path / "nope.py"):
+        findings = lint_paths([str(bogus)], env_rule=False)
+        assert [f.rule_id for f in findings] == ["HVD999"], bogus
+        assert "does not exist" in findings[0].message
+
+
+def test_repo_lints_clean():
+    """The acceptance bar: hvdlint over horovod_tpu/ + examples/ with
+    every rule enabled reports nothing (fixes + rationaled
+    suppressions)."""
+    findings = lint_paths([str(REPO / "horovod_tpu"),
+                           str(REPO / "examples")], root=str(REPO))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# --------------------------------------------------- fingerprint verifier
+
+class FakeKV:
+    """Dict-backed stand-in for runner.rendezvous.KVClient."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def put(self, scope, key, value):
+        self.store[f"{scope}/{key}"] = value
+
+    def get(self, scope, key, timeout=0.0):
+        return self.store.get(f"{scope}/{key}")
+
+    def delete(self, scope, key):
+        self.store.pop(f"{scope}/{key}", None)
+
+
+def _pair(interval=2):
+    store = {}
+    v0 = FingerprintVerifier(FakeKV(store), 0, 2, "e1", interval=interval)
+    v1 = FingerprintVerifier(FakeKV(store), 1, 2, "e1", interval=interval)
+    return v0, v1
+
+
+def test_verifier_identical_sequences_agree():
+    v0, v1 = _pair()
+    for i in range(8):
+        v0.record(f"allreduce(shape=(2,))|name=t{i}")
+        v1.record(f"allreduce(shape=(2,))|name=t{i}")
+    # Each rank verifies peer checkpoints one interval behind its own
+    # newest (see _checkpoint), so agreement trails by one interval.
+    assert v0.last_agreed_index() == 6
+    assert v1.last_agreed_index() == 6
+    assert v0.divergence is None and v1.divergence is None
+
+
+def test_verifier_skipped_call_named_with_index():
+    v0, v1 = _pair()
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        for i in range(8):
+            v0.record(f"allreduce|name=t{i}")
+            if i != 2:  # rank 1 silently skips call #2
+                v1.record(f"allreduce|name=t{i}")
+    msg = str(ei.value)
+    assert "rank 0" in msg and "rank 1" in msg
+    assert "first divergent call #2" in msg
+    assert "t2" in msg and "t3" in msg
+    assert "fingerprint" in msg
+
+
+def test_verifier_shape_skew_detected():
+    v0, v1 = _pair(interval=1)
+    v0.record("allreduce(shape=(4,),dtype=float32)|name=g")
+    v1.record("allreduce(shape=(8,),dtype=float32)|name=g")
+    # Detection happens one checkpoint later (deterministic lag).
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        v0.record("allreduce(shape=(4,),dtype=float32)|name=g2")
+    msg = str(ei.value)
+    assert "shape=(4,)" in msg and "shape=(8,)" in msg
+
+
+def test_verifier_stall_context_names_lagging_rank():
+    v0, v1 = _pair()
+    for i in range(6):
+        v0.record(f"a|t{i}")
+    for i in range(2):
+        v1.record(f"a|t{i}")
+    ctx = v0.stall_context()
+    assert "rank(s) [1]" in ctx
+    assert "agree through call #2" in ctx
+
+
+def test_verifier_stall_context_reports_divergence():
+    v0, v1 = _pair()
+    for i in range(2):
+        v0.record(f"a|t{i}")
+    v1.record("a|t0")
+    v1.record("a|DIFFERENT")  # publishes a divergent checkpoint
+    # The stalled survivor's watchdog context reads the freshest peer
+    # checkpoints (no interval lag — the watchdog has time to spare)
+    # and reports the divergence.
+    ctx = v0.stall_context()
+    assert "out of step" in ctx
+
+
+def test_verifier_subset_process_set_not_divergent():
+    """A subset-set collective is a separate sequence: rank 0 issuing
+    extra calls on a [0]-only process set must NOT trip the world
+    fingerprint (mirrors scenario_consistency_subset)."""
+    v0, v1 = _pair()
+    for i in range(8):
+        v0.record(f"allreduce|name=t{i}")
+        if i % 2 == 0:
+            v0.record(f"allreduce(ps=1)|name=s{i}", ranks=[0],
+                      group="ps1-abc")
+        v1.record(f"allreduce|name=t{i}")
+    assert v0.divergence is None and v1.divergence is None
+    assert v0.last_agreed_index() == 6
+    # The subset group has no peers for rank 0, so it trivially agrees
+    # and never compares against rank 1.
+    assert v0.last_agreed_index("ps1-abc") >= 0
+
+
+def test_verifier_gc_waits_for_peer_acks():
+    """GC must key off what peers ACKNOWLEDGED verifying, not this
+    rank's own watermark — a lagging peer pauses GC instead of losing
+    the fingerprints it still needs."""
+    store = {}
+    v0 = FingerprintVerifier(FakeKV(store), 0, 2, "e1", interval=1)
+    v1 = FingerprintVerifier(FakeKV(store), 1, 2, "e1", interval=1)
+    # Both keep pace: old keys get collected past the ack floor.
+    for i in range(30):
+        v0.record(f"a|t{i}")
+        v1.record(f"a|t{i}")
+    assert "checkfp/e1/world/fp/0/10" not in store  # GC'd
+    assert "checkfp/e1/world/fp/0/25" in store      # recent, kept
+    # Lagging peer: no acks beyond its progress → nothing GC'd.
+    store2 = {}
+    v0 = FingerprintVerifier(FakeKV(store2), 0, 2, "e1", interval=1)
+    v1 = FingerprintVerifier(FakeKV(store2), 1, 2, "e1", interval=1)
+    for i in range(3):
+        v1.record(f"a|t{i}")
+    for i in range(30):
+        v0.record(f"a|t{i}")
+    assert "checkfp/e1/world/fp/0/1" in store2  # still there for v1
+
+
+def test_verifier_ring_catches_divergence_at_three_ranks():
+    """Ring verification: any divergent rank differs from a ring
+    neighbor, so adjacent-pair checks catch what all-pairs would."""
+    store = {}
+    vs = [FingerprintVerifier(FakeKV(store), r, 3, "e1", interval=2)
+          for r in range(3)]
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        for i in range(8):
+            for r, v in enumerate(vs):
+                if r == 1 and i == 2:
+                    continue  # rank 1 skips a call
+                v.record(f"a|t{i}")
+    assert "rank 1" in str(ei.value)
+
+
+def test_verifier_expired_window_not_counted_as_agreed():
+    """A peer more than `window` calls behind: the lost compares are
+    surfaced in stall_context, never silently folded into agreement."""
+    store = {}
+    v0 = FingerprintVerifier(FakeKV(store), 0, 2, "e1", interval=1,
+                             window=1)
+    v1 = FingerprintVerifier(FakeKV(store), 1, 2, "e1", interval=1,
+                             window=1)
+    for i in range(20):
+        v0.record(f"a|t{i}")
+    for i in range(20):
+        v1.record(f"a|t{i}")
+    # v1 verified v0 fine (v0's keys were all there); v0 catches up on
+    # v1's checkpoints only now, after pruning its own early windows.
+    ctx = v0.stall_context()
+    assert "expired unverified" in ctx
+
+
+def test_verifier_kv_outage_never_fails_the_collective():
+    """A rendezvous-KV blip degrades the diagnostic, not training:
+    record() must swallow KV transport failures entirely."""
+    class DownKV:
+        def put(self, *a, **k):
+            raise OSError("connection refused")
+
+        def get(self, *a, **k):
+            raise OSError("connection refused")
+
+        def delete(self, *a, **k):
+            raise OSError("connection refused")
+
+    v = FingerprintVerifier(DownKV(), 0, 2, "e1", interval=1)
+    for i in range(5):
+        v.record(f"a|t{i}")  # must not raise
+    assert v.divergence is None
+
+
+def test_verifier_metrics_exported():
+    from horovod_tpu.observability import metrics as m
+    v0, v1 = _pair()
+    for i in range(4):
+        v0.record(f"a|t{i}")
+        v1.record(f"a|t{i}")
+    snap = m.registry().snapshot()
+    fams = snap["families"]
+    assert "horovod_check_collectives_checkpoints_total" in fams
+
+
+# ------------------------------------------- stall watchdog integration
+
+def test_stall_watchdog_message_includes_fingerprint_context(monkeypatch):
+    import time
+
+    from horovod_tpu.analysis import verifier as vf
+    from horovod_tpu.ops.collectives import StallWatchdog
+
+    class FakeInspector:
+        def submit(self, name):
+            pass
+
+        def done(self, name):
+            pass
+
+        def check(self):
+            return ["allreduce.t3"], False
+
+    class FakeVerifier:
+        def stall_context(self):
+            return ("collective fingerprints agree through call #40 of "
+                    "44 issued here; rank(s) [1] have not published "
+                    "checkpoint #42")
+
+    monkeypatch.setattr(vf, "_verifier", FakeVerifier())
+    wd = StallWatchdog(FakeInspector(), warn_sec=0.02, shutdown_sec=0.08,
+                       poll_interval=0.01)
+    with pytest.raises(HorovodInternalError) as ei:
+        wd.guard("allreduce.t3", lambda: time.sleep(30))
+    msg = str(ei.value)
+    assert "stalled past" in msg
+    assert "agree through call #40" in msg
+    assert "rank(s) [1]" in msg
+
+
+def test_stall_watchdog_message_without_verifier(monkeypatch):
+    import time
+
+    from horovod_tpu.analysis import verifier as vf
+    from horovod_tpu.ops.collectives import StallWatchdog
+
+    class FakeInspector:
+        def submit(self, name):
+            pass
+
+        def done(self, name):
+            pass
+
+        def check(self):
+            return [], False
+
+    monkeypatch.setattr(vf, "_verifier", None)
+    wd = StallWatchdog(FakeInspector(), warn_sec=0.02, shutdown_sec=0.08,
+                       poll_interval=0.01)
+    with pytest.raises(HorovodInternalError) as ei:
+        wd.guard("x", lambda: time.sleep(30))
+    assert "stalled past" in str(ei.value)

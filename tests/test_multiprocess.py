@@ -146,6 +146,60 @@ def test_consistency_missing_rank_named(tmp_path):
         assert f"MP_WORKER_OK consistency_missing rank={rank}" in text, text
 
 
+def test_check_collectives_names_divergent_rank(tmp_path):
+    """Fingerprint verifier e2e (docs/static_analysis.md): rank 1 skips
+    an allreduce; every rank must get a CollectiveDivergenceError naming
+    the rank and first divergent call index — well inside the stall
+    deadline, with no native KV required (the verifier uses the
+    launcher's rendezvous KV)."""
+    import time
+
+    env = dict(WORKER_ENV)
+    env["HOROVOD_CHECK_COLLECTIVES"] = "1"
+    env["HOROVOD_CHECK_COLLECTIVES_INTERVAL"] = "2"
+    # Stall backstop: if the verifier failed to catch the divergence the
+    # job would die here instead of hanging the suite.
+    stall_deadline = 60.0
+    env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "20"
+    env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = str(int(stall_deadline))
+    out_path = tmp_path / "out.txt"
+    t0 = time.monotonic()
+    with open(out_path, "w") as f:
+        rc = launch_static(2, "localhost:2",
+                           [sys.executable, WORKER,
+                            "check_collectives_skip"],
+                           env, stdout=f)
+    elapsed = time.monotonic() - t0
+    text = out_path.read_text()
+    assert rc == 0, text
+    for rank in range(2):
+        assert (f"MP_WORKER_OK check_collectives_skip rank={rank}"
+                in text), text
+    assert elapsed < stall_deadline, \
+        f"verifier took {elapsed:.0f}s — stall watchdog would have won"
+
+
+def test_check_collectives_subset_process_set_clean(tmp_path):
+    """Per-process-set fingerprint scoping: rank 0 issuing extra
+    collectives on a [0]-only process set is a CORRECT program and must
+    not be declared divergent (the verifier scopes sequences per set,
+    like core/consistency.py)."""
+    env = dict(WORKER_ENV)
+    env["HOROVOD_CHECK_COLLECTIVES"] = "1"
+    env["HOROVOD_CHECK_COLLECTIVES_INTERVAL"] = "1"
+    env["HOROVOD_DYNAMIC_PROCESS_SETS"] = "1"
+    env["HOROVOD_CONSISTENCY_CHECK"] = "0"
+    out_path = tmp_path / "out.txt"
+    with open(out_path, "w") as f:
+        rc = launch_static(2, "localhost:2",
+                           [sys.executable, WORKER, "consistency_subset"],
+                           env, stdout=f)
+    text = out_path.read_text()
+    assert rc == 0, text
+    for rank in range(2):
+        assert f"MP_WORKER_OK consistency_subset rank={rank}" in text, text
+
+
 def test_torch_frontend_multiprocess(tmp_path):
     """Torch frontend over REAL processes (the frontend's analog of
     running test/parallel/test_torch.py under mpirun)."""
