@@ -12,15 +12,21 @@
 #   make metrics     observability smoke: registry/exporter units + a
 #                    scraped 2-process elastic job (docs/observability.md)
 #   make lint        hvdlint static analysis: collective-consistency +
-#                    concurrency rules + env-knob docs drift
+#                    concurrency rules + env-knob docs drift, gating on
+#                    findings NEW relative to the checked-in baseline
 #                    (docs/static_analysis.md)
+#   make race        hvdrace: the concurrency/hammer suites (timeline,
+#                    metrics, elastic driver, rendezvous KV, verifier)
+#                    run under the runtime lockset race detector
+#                    (HOROVOD_RACE_CHECK=1); any guarded-by violation
+#                    fails the run (docs/static_analysis.md)
 #   make native      build the native control-plane library
 #   make bench       one-line JSON benchmark (real accelerator if present)
 
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint metrics
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline metrics race
 
 test: lint test-unit test-multiprocess test-e2e chaos entry
 
@@ -48,7 +54,24 @@ metrics:
 	    tests/test_timeline.py
 
 lint:
-	$(PYTHON) -m horovod_tpu.analysis horovod_tpu/ examples/
+	$(PYTHON) -m horovod_tpu.analysis horovod_tpu/ examples/ \
+	    --baseline scripts/hvdlint_baseline.json
+
+# Regenerate the accepted-findings baseline (review the diff before
+# committing: every entry is a finding future lint runs stop gating on).
+lint-baseline:
+	$(PYTHON) -m horovod_tpu.analysis horovod_tpu/ examples/ \
+	    --format json > scripts/hvdlint_baseline.json || true
+
+# The warm-compile-cache test is a wall-clock subprocess benchmark, not
+# a concurrency test — load-sensitive, and none of its work runs through
+# the instrumented classes, so it only adds noise to this gate.
+race:
+	env HOROVOD_RACE_CHECK=1 $(PYTEST) tests/test_race.py \
+	    tests/test_timeline.py tests/test_metrics.py \
+	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
+	    tests/test_hvdlint.py \
+	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache
 
 entry:
 	$(PYTHON) __graft_entry__.py

@@ -53,6 +53,18 @@ from horovod_tpu import elastic  # noqa: F401  (hvd.elastic.run / State)
 
 __version__ = "0.1.0"
 
+# hvdrace (analysis/race.py, docs/static_analysis.md): with
+# HOROVOD_RACE_CHECK=1 the runtime's `# guarded-by:`-annotated classes
+# are instrumented HERE, at import time — before any runtime instance
+# exists — so every lock they create is tracked from birth. Without the
+# env var nothing is imported or patched.
+import os as _os  # noqa: E402
+
+if _os.environ.get("HOROVOD_RACE_CHECK"):  # presence sniff: zero cost
+    # when unset; race.env_enabled() owns the truthy-value parse.
+    from horovod_tpu.analysis import race as _race
+    _race.maybe_enable_from_env()
+
 
 def metrics() -> dict:
     """This process's metrics registry as a plain-JSON snapshot

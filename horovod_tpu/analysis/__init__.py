@@ -13,7 +13,18 @@ package moves that detection LEFT of the job launch:
   and the repo's examples, and concurrency-discipline rules (HVD1xx,
   including the ``# guarded-by:`` lock annotation convention) on the
   runtime itself — plus the HVD-ENV documentation-drift rule that
-  subsumes the old ``scripts/check_env_docs.py``.
+  subsumes the old ``scripts/check_env_docs.py``. The HVD0xx rules are
+  interprocedural: ``callgraph`` builds a module-level call graph with
+  transitive-collective and rank-taint summaries over every linted
+  file, so helpers no longer hide divergence patterns. ``--format
+  json`` and ``--baseline`` make CI gate on *new* findings only.
+
+* ``race`` (**hvdrace**, ``HOROVOD_RACE_CHECK=1`` / ``make race``) is
+  the runtime enforcement of ``# guarded-by:``: an Eraser-style
+  lockset detector that instruments the annotated runtime classes at
+  import time and reports any guarded attribute touched without its
+  declared lock held — including stale annotations whose lock is never
+  held at all.
 
 * ``verifier`` is the runtime companion (``HOROVOD_CHECK_COLLECTIVES=1``):
   each rank hashes its rolling sequence of

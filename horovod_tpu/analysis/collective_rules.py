@@ -1,4 +1,4 @@
-"""Collective-consistency lint rules (HVD001-HVD004).
+"""Collective-consistency lint rules (HVD001-HVD005).
 
 The SPMD contract behind every backend this framework has (and the
 reference's coordinator protocol, controller.cc:74-447) is: **every rank
@@ -9,7 +9,10 @@ user/training code and the repo's own examples:
 
 HVD001  collective invoked under rank-dependent control flow
         (``if hvd.rank() == 0: hvd.broadcast(...)``) — only some ranks
-        submit it, the rest hang at the next collective.
+        submit it, the rest hang at the next collective. Since the
+        interprocedural upgrade this also catches a *helper* that
+        (transitively) issues a collective being called under the
+        guard — the exact refactor that used to blind the lexical rule.
 HVD002  collective name derived from iteration over an unordered
         container (a set) — iteration order differs per process, so
         ranks pair up different tensors under the same call index.
@@ -19,11 +22,18 @@ HVD003  unnamed collective inside a loop — auto-assigned names collide
         ambiguous.
 HVD004  ``process_set=`` differs between branches of one ``if`` — if the
         condition isn't globally uniform, member sets disagree about who
-        participates.
+        participates. Checked across call sites too: a helper whose
+        ``process_set`` parameter gets different arguments per branch is
+        the same bug one frame deeper.
+HVD005  collective ``name=`` derived from a rank-tainted value
+        (``name=f"g{hvd.rank()}"`` — directly, through locals, or
+        through a helper parameter): every rank submits a *different*
+        name at the same call index, the naming twin of HVD001.
 
-Heuristics are deliberately lexical (no cross-function dataflow): a
-false positive is one ``disable=... -- rationale`` suppression comment
-away, while a missed stall costs a debugging session on a live cluster.
+The dataflow lives in ``analysis/callgraph.py``; the graph is built once
+per lint run by the driver and attached as ``sf.graph``. A false
+positive is one ``disable=... -- rationale`` suppression comment away,
+while a missed stall costs a debugging session on a live cluster.
 """
 
 from __future__ import annotations
@@ -31,128 +41,73 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
+from horovod_tpu.analysis.callgraph import (
+    COLLECTIVE_NAMES, FOREIGN_ROOTS, NAME_ARG_POS, NAMED_OP_NAMES, RANK,
+    RANK_CALL_NAMES, CallGraph, _scope_walk, contains_rank_call,
+    is_collective_call, kwarg as _kwarg, name_argument as _name_argument,
+    terminal_name as _terminal_name,
+)
 from horovod_tpu.analysis.driver import Finding, SourceFile
 
-#: The eager collective API surface (ops/collectives.py) plus the
-#: high-level wrappers that submit collectives on the caller's behalf
-#: (optim/functions.py).
-COLLECTIVE_NAMES: Set[str] = {
-    "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
-    "broadcast", "reducescatter", "grouped_reducescatter", "alltoall",
-    "barrier",
-    "allreduce_async", "grouped_allreduce_async", "allgather_async",
-    "broadcast_async", "alltoall_async", "reducescatter_async",
-    "broadcast_object", "broadcast_parameters", "broadcast_variables",
-    "broadcast_optimizer_state", "allgather_object",
-}
-
-#: Ops whose reference auto-naming collides across loop iterations
-#: (HVD003), mapped to the 0-based POSITIONAL index of their `name`
-#: parameter (ops/collectives.py signatures; the frontends mirror
-#: them). The broadcast_* / *_object wrappers name their tensors
-#: internally and barrier takes no name.
-NAME_ARG_POS: Dict[str, Tuple[int, ...]] = {
-    "allreduce": (2,), "grouped_allreduce": (2,),
-    "allgather": (1,), "grouped_allgather": (1,),
-    "broadcast": (2,), "reducescatter": (2,),
-    "grouped_reducescatter": (2,), "alltoall": (2,),
-    "allreduce_async": (2,),
-    # torch's async wrapper takes name at position 1
-    # (frontends/torch.py), the core alias at 2 — accept either.
-    "grouped_allreduce_async": (1, 2),
-    "allgather_async": (1,), "broadcast_async": (2,),
-    "alltoall_async": (2,), "reducescatter_async": (2,),
-}
-NAMED_OP_NAMES: Set[str] = set(NAME_ARG_POS)
-
-#: Receivers whose methods share names with our API but are NOT Horovod
-#: collectives (np.broadcast, tf.broadcast_to's relatives, etc.).
-_FOREIGN_ROOTS: Set[str] = {
-    "np", "numpy", "jnp", "jax", "lax", "torch", "tf", "tensorflow",
-    "mx", "mxnet", "keras", "K",
-}
-
-#: Calls that return this process's identity — the seed of
-#: rank-dependent control flow.
-_RANK_CALL_NAMES: Set[str] = {
-    "rank", "local_rank", "cross_rank", "process_index",
-}
+# Legacy aliases: the collective-call model moved to callgraph.py when
+# it grew interprocedural consumers; these names stay importable here.
+_RANK_CALL_NAMES = RANK_CALL_NAMES
+_FOREIGN_ROOTS = FOREIGN_ROOTS
 
 
-def _terminal_name(func: ast.AST) -> Optional[str]:
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    return None
-
-
-def _root_name(func: ast.AST) -> Optional[str]:
-    node = func
-    while isinstance(node, ast.Attribute):
-        node = node.value
-    return node.id if isinstance(node, ast.Name) else None
-
-
-def is_collective_call(node: ast.AST) -> Optional[str]:
-    """The collective's op name if `node` is a Horovod collective call."""
-    if not isinstance(node, ast.Call):
-        return None
-    name = _terminal_name(node.func)
-    if name not in COLLECTIVE_NAMES:
-        return None
-    if isinstance(node.func, ast.Attribute) \
-            and _root_name(node.func) in _FOREIGN_ROOTS:
-        return None
-    return name
-
-
-def _contains_rank_call(node: ast.AST) -> bool:
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call) \
-                and _terminal_name(sub.func) in _RANK_CALL_NAMES:
-            return True
-    return False
+def _graph(sf: SourceFile) -> CallGraph:
+    """The lint run's call graph (driver attaches it; single-blob unit
+    runs build their own one-file graph on demand)."""
+    graph = getattr(sf, "graph", None)
+    if graph is None:
+        graph = CallGraph([sf])
+        sf.graph = graph
+    return graph
 
 
 def _walk_pruned(root: ast.stmt) -> Iterator[Tuple[ast.Call, str]]:
-    """Collective calls under `root`, pruning nested def/class bodies:
-    a ``def`` inside a rank-guard only runs if something calls it, and
-    that callsite is what the rule should (and does) anchor to."""
-    stack: List[ast.AST] = [root]
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)) and node is not root:
-            continue
+    """Collective calls under `root`, pruning nested def/class bodies
+    (callgraph._scope_walk): a ``def`` inside a rank-guard only runs if
+    something calls it, and that callsite is what the rule should (and
+    does) anchor to."""
+    for node in _scope_walk(root):
         op = is_collective_call(node)
         if op is not None:
-            yield node, op  # still recurse: grouped calls can nest args
-        stack.extend(ast.iter_child_nodes(node))
+            yield node, op  # grouped calls can nest args: walk recurses
 
 
-def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
-    for kw in call.keywords:
-        if kw.arg == name:
-            return kw.value
-    return None
+def _calls_pruned(root: ast.stmt) -> Iterator[ast.Call]:
+    """Every call under `root` with the same def/class pruning."""
+    for node in _scope_walk(root):
+        if isinstance(node, ast.Call):
+            yield node
 
 
-def _name_argument(call: ast.Call, op: str) -> Optional[ast.expr]:
-    """The expression passed as `name` — keyword or positional."""
-    expr = _kwarg(call, "name")
-    if expr is not None:
-        return expr
-    for pos in NAME_ARG_POS.get(op, ()):
-        if len(call.args) > pos \
-                and not isinstance(call.args[pos], ast.Starred):
-            return call.args[pos]
-    return None
+def _collectives_under_stmts(stmts: Iterable[ast.stmt]
+                             ) -> Iterator[Tuple[ast.Call, str]]:
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # see _walk_pruned: flag callsites, not def bodies
+        yield from _walk_pruned(stmt)
+
+
+def _calls_under_stmts(stmts: Iterable[ast.stmt]) -> Iterator[ast.Call]:
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield from _calls_pruned(stmt)
 
 
 # --------------------------------------------------------------- HVD001
 
+def _contains_rank_call(node: ast.AST) -> bool:
+    return contains_rank_call(node)
+
+
 def check_rank_dependent(sf: SourceFile) -> Iterator[Finding]:
+    graph = _graph(sf)
     for node in ast.walk(sf.tree):
         branches: List[List[ast.stmt]] = []
         desc = ""
@@ -165,7 +120,6 @@ def check_rank_dependent(sf: SourceFile) -> Iterator[Finding]:
             desc = "while"
         elif isinstance(node, ast.IfExp) \
                 and _contains_rank_call(node.test):
-            branches = []
             for side in (node.body, node.orelse):
                 op = is_collective_call(side)
                 if op is not None:
@@ -182,15 +136,21 @@ def check_rank_dependent(sf: SourceFile) -> Iterator[Finding]:
                     f"collective '{op}' under rank-dependent control "
                     f"flow ({desc} at line {node.lineno}): every rank "
                     f"must issue the same collectives in the same order")
-
-
-def _collectives_under_stmts(stmts: Iterable[ast.stmt]
-                             ) -> Iterator[Tuple[ast.Call, str]]:
-    for stmt in stmts:
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            continue  # see _walk_pruned: flag callsites, not def bodies
-        yield from _walk_pruned(stmt)
+            # Interprocedural: a call that lands in a linted function
+            # whose summary (transitively) issues collectives is the
+            # same bug one frame deeper — flag the callsite.
+            for call in _calls_under_stmts(branch):
+                effects = graph.call_effects(sf, call)
+                if not effects:
+                    continue
+                op, _ps, origin = effects[0]
+                callee = _terminal_name(call.func) or "<call>"
+                yield sf.finding(
+                    call, "HVD001",
+                    f"call to '{callee}' under rank-dependent control "
+                    f"flow ({desc} at line {node.lineno}) issues "
+                    f"collective '{op}' ({origin}): every rank must "
+                    f"issue the same collectives in the same order")
 
 
 # --------------------------------------------------------------- HVD002
@@ -261,26 +221,35 @@ def check_unnamed_in_loop(sf: SourceFile) -> Iterator[Finding]:
 
 # --------------------------------------------------------------- HVD004
 
-def _ps_repr(call: ast.Call) -> Optional[str]:
-    ps = _kwarg(call, "process_set")
-    if ps is None:
-        return None
-    return ast.dump(ps)
+def _ps_entries(stmts: Iterable[ast.stmt], sf: SourceFile,
+                graph: CallGraph
+                ) -> Iterator[Tuple[str, ast.Call, Optional[str]]]:
+    """(op, anchor call, process_set repr) for every collective a
+    branch issues — directly, or transitively through a resolvable
+    helper (with the helper's symbolic process_set substituted from
+    this call site's arguments)."""
+    for call, op in _collectives_under_stmts(stmts):
+        ps = _kwarg(call, "process_set")
+        yield op, call, (ast.dump(ps) if ps is not None else None)
+    for call in _calls_under_stmts(stmts):
+        for op, ps, _origin in graph.call_effects(sf, call):
+            yield op, call, ps
 
 
 def check_process_set_branches(sf: SourceFile) -> Iterator[Finding]:
+    graph = _graph(sf)
     for node in ast.walk(sf.tree):
         if not isinstance(node, ast.If) or not node.orelse:
             continue
-        body_ps: Dict[str, Tuple[ast.Call, Optional[str]]] = {}
-        for call, op in _collectives_under_stmts(node.body):
-            body_ps.setdefault(op, (call, _ps_repr(call)))
-        for call, op in _collectives_under_stmts(node.orelse):
+        body_ps: Dict[str, Tuple[ast.Call, Set[Optional[str]]]] = {}
+        for op, call, ps in _ps_entries(node.body, sf, graph):
+            anchor, seen = body_ps.setdefault(op, (call, set()))
+            seen.add(ps)
+        for op, call, ps in _ps_entries(node.orelse, sf, graph):
             if op not in body_ps:
                 continue
-            other_call, other_ps = body_ps[op]
-            this_ps = _ps_repr(call)
-            if this_ps != other_ps:
+            other_call, seen = body_ps[op]
+            if ps not in seen:
                 yield sf.finding(
                     call, "HVD004",
                     f"'{op}' uses a different process_set than the "
@@ -290,13 +259,72 @@ def check_process_set_branches(sf: SourceFile) -> Iterator[Finding]:
                     f"participates")
 
 
+# --------------------------------------------------------------- HVD005
+
+def _scopes(sf: SourceFile) -> Iterator[Optional[ast.AST]]:
+    """Module top level plus every (async) function/method — the taint
+    scopes. Async defs carry the same divergence bug class."""
+    yield None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scope_stmts(sf: SourceFile,
+                 scope: Optional[ast.AST]) -> List[ast.stmt]:
+    return (sf.tree.body if scope is None else scope.body)
+
+
+def check_rank_tainted_name(sf: SourceFile) -> Iterator[Finding]:
+    graph = _graph(sf)
+    for scope in _scopes(sf):
+        env = graph.taint_env(sf, scope)
+        for stmt in _scope_stmts(sf, scope):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # covered by its own taint scope
+            for call in _calls_pruned(stmt):
+                op = is_collective_call(call)
+                if op is not None:
+                    name_expr = _name_argument(call, op)
+                    if name_expr is not None \
+                            and env.rank_tainted(name_expr):
+                        yield sf.finding(
+                            call, "HVD005",
+                            f"collective '{op}' name derives from a "
+                            f"rank-dependent value: ranks submit "
+                            f"DIFFERENT names at the same call index "
+                            f"and pair up mismatched tensors — "
+                            f"collective names must be identical on "
+                            f"every rank")
+                    continue
+                for callee in graph.resolve(sf, call):
+                    for idx in sorted(callee.name_taint_params):
+                        arg = CallGraph._arg_for_param(callee, call, idx)
+                        if arg is not None and env.rank_tainted(arg):
+                            pname = (callee.params[idx]
+                                     if idx < len(callee.params)
+                                     else f"#{idx}")
+                            yield sf.finding(
+                                call, "HVD005",
+                                f"argument '{pname}' of "
+                                f"{callee.label()} flows into a "
+                                f"collective name and is "
+                                f"rank-dependent here: collective "
+                                f"names must be identical on every "
+                                f"rank")
+
+
 RULES = {
-    "HVD001": ("collective under rank-dependent control flow",
+    "HVD001": ("collective under rank-dependent control flow "
+               "(direct or through a helper call)",
                check_rank_dependent),
     "HVD002": ("collective named from iteration over an unordered "
                "container", check_unordered_naming),
     "HVD003": ("unnamed collective inside a loop (auto-name collision)",
                check_unnamed_in_loop),
-    "HVD004": ("process_set differs across branches",
-               check_process_set_branches),
+    "HVD004": ("process_set differs across branches (direct or across "
+               "call sites)", check_process_set_branches),
+    "HVD005": ("collective name derived from a rank-dependent value",
+               check_rank_tainted_name),
 }

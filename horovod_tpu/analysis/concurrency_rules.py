@@ -19,9 +19,11 @@ HVD102  ``threading.Thread(...)`` without an explicit ``daemon=``: an
         (``daemon=True``, or ``daemon=False`` plus a join path) and say
         so at the spawn site.
 HVD103  blocking call (``time.sleep``, socket/HTTP ops, ``Event.wait``,
-        ``serve_forever``, ``block_until_ready``) while lexically
-        holding a lock: every other thread needing that lock now waits
-        on the network/timer too — the shape of the PR 1 stall bugs.
+        ``serve_forever``, ``block_until_ready``, ``subprocess.run``,
+        ``Popen.wait``, timeout-less ``queue.Queue.get``/``put``) while
+        lexically holding a lock: every other thread needing that lock
+        now waits on the network/timer too — the shape of the PR 1
+        stall bugs.
 
 Lexical scope is the contract: lock handoffs through helper calls are
 invisible to these rules and should either be refactored or suppressed
@@ -39,13 +41,30 @@ from horovod_tpu.analysis.driver import Finding, SourceFile
 GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 
 #: Terminal callee names considered blocking for HVD103. `join` and
-#: `get` are deliberately absent (str.join / dict.get false positives).
+#: `get` are deliberately absent (str.join / dict.get false positives;
+#: `wait` covers `Popen.wait` along with Event/Condition waits).
 BLOCKING_NAMES: Set[str] = {
     "sleep", "urlopen", "wait", "accept", "recv", "recvfrom", "recv_into",
     "sendall", "connect", "create_connection", "getaddrinfo", "select",
     "serve_forever", "block_until_ready", "check_output", "check_call",
     "communicate",
 }
+
+#: (receiver root, terminal) pairs blocking only under that exact
+#: qualification — names too common to flag on any receiver.
+BLOCKING_QUALIFIED: Set[Tuple[str, str]] = {
+    ("subprocess", "run"), ("subprocess", "call"),
+}
+
+#: Queue-ish receiver names whose `.get(...)`/`.put(...)` block
+#: indefinitely unless a timeout is given. Matching is by receiver name
+#: (``self._queue.get()``, ``q.put(item)``) — dict/KV ``.get`` stays
+#: exempt because plain data receivers aren't named like queues.
+_QUEUEISH = ("queue", "_q", "q")
+
+
+def _queueish(name: str) -> bool:
+    return "queue" in name.lower() or name.lower() in _QUEUEISH
 
 
 def _terminal(expr: ast.AST) -> Optional[str]:
@@ -86,15 +105,24 @@ def _lockish(name: str) -> bool:
 # --------------------------------------------------------------- HVD101
 
 class _Annotation:
-    __slots__ = ("attr", "lock", "line", "owner")
+    __slots__ = ("attr", "lock", "line", "owner", "cls")
 
     def __init__(self, attr: str, lock: str, line: int,
-                 owner: Optional[ast.AST]) -> None:
+                 owner: Optional[ast.AST],
+                 cls: Optional[str] = None) -> None:
         self.attr = attr
         self.lock = lock
         self.line = line
         self.owner = owner  # the function/class scope that may touch it
         #                     unguarded (creation scope)
+        self.cls = cls  # enclosing class name — binds the annotation to
+        #                 a runtime class for hvdrace (analysis/race.py)
+
+    @property
+    def class_level(self) -> bool:
+        """True when the annotated state lives on the class itself
+        (assignment in the class body), not per-instance."""
+        return isinstance(self.owner, ast.ClassDef)
 
 
 def _assigned_names(stmt: ast.stmt) -> List[Tuple[str, bool]]:
@@ -126,12 +154,15 @@ def _collect_annotations(sf: SourceFile) -> List[_Annotation]:
     anns: List[_Annotation] = []
     bound: Set[int] = set()
 
-    def visit(node: ast.AST, scope: Optional[ast.AST]) -> None:
+    def visit(node: ast.AST, scope: Optional[ast.AST],
+              cls: Optional[str]) -> None:
         for child in ast.iter_child_nodes(node):
-            child_scope = scope
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)):
+            child_scope, child_cls = scope, cls
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 child_scope = child
+            elif isinstance(child, ast.ClassDef):
+                child_scope = child
+                child_cls = child.name
             if isinstance(child, (ast.Assign, ast.AnnAssign,
                                   ast.AugAssign)):
                 # The annotation comment may sit on any line the
@@ -141,11 +172,11 @@ def _collect_annotations(sf: SourceFile) -> List[_Annotation]:
                     if ln in lock_by_line and ln not in bound:
                         for name, _is_attr in _assigned_names(child):
                             anns.append(_Annotation(
-                                name, lock_by_line[ln], ln, scope))
+                                name, lock_by_line[ln], ln, scope, cls))
                             bound.add(ln)
-            visit(child, child_scope)
+            visit(child, child_scope, child_cls)
 
-    visit(sf.tree, None)
+    visit(sf.tree, None, None)
     return anns
 
 
@@ -229,6 +260,41 @@ def check_thread_daemon(sf: SourceFile) -> Iterator[Finding]:
 
 # --------------------------------------------------------------- HVD103
 
+def _has_timeout(call: ast.Call) -> bool:
+    """True when a queue get/put is bounded: a ``timeout=`` keyword, the
+    positional timeout slot (``get(block, timeout)`` /
+    ``put(item, block, timeout)``), or a non-blocking ``block=False``
+    (raises Empty/Full immediately — it cannot wait at all)."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if any(kw.arg == "block"
+           and isinstance(kw.value, ast.Constant)
+           and kw.value.value is False for kw in call.keywords):
+        return True
+    block_pos = 0 if _terminal(call.func) == "get" else 1
+    if len(call.args) > block_pos \
+            and isinstance(call.args[block_pos], ast.Constant) \
+            and call.args[block_pos].value is False:
+        return True
+    pos = 1 if _terminal(call.func) == "get" else 2
+    return len(call.args) > pos
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    """Why `call` can block indefinitely, or None."""
+    t = _terminal(call.func)
+    if t in BLOCKING_NAMES:
+        return f"'{t}(...)'"
+    if isinstance(call.func, ast.Attribute):
+        root = _terminal(call.func.value)
+        if root is not None and (root, t) in BLOCKING_QUALIFIED:
+            return f"'{root}.{t}(...)'"
+        if t in ("get", "put") and root is not None \
+                and _queueish(root) and not _has_timeout(call):
+            return f"queue '{root}.{t}(...)' without a timeout"
+    return None
+
+
 def check_blocking_under_lock(sf: SourceFile) -> Iterator[Finding]:
     def walk(node: ast.AST, held: Set[str]) -> Iterator[Finding]:
         for child in ast.iter_child_nodes(node):
@@ -242,11 +308,11 @@ def check_blocking_under_lock(sf: SourceFile) -> Iterator[Finding]:
                 if lock_names:
                     child_held = held | lock_names
             if isinstance(child, ast.Call) and held:
-                t = _terminal(child.func)
-                if t in BLOCKING_NAMES:
+                reason = _blocking_reason(child)
+                if reason is not None:
                     yield sf.finding(
                         child, "HVD103",
-                        f"blocking call '{t}(...)' while holding lock "
+                        f"blocking call {reason} while holding lock "
                         f"{sorted(held)}: every thread needing the lock "
                         f"now waits on the timer/network too — move the "
                         f"blocking work outside the critical section")

@@ -23,9 +23,11 @@ from __future__ import annotations
 import argparse
 import ast
 import dataclasses
+import json
 import pathlib
 import re
 import sys
+from collections import Counter
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 #: Suppression comment grammar (docs/static_analysis.md). A rule ID
@@ -59,6 +61,10 @@ class Finding:
 
     def render(self) -> str:
         return f"{self.path}:{self.line} {self.rule_id} {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule_id, "message": self.message}
 
 
 def parse_suppression(line: str) -> Optional[Tuple[Set[str], bool]]:
@@ -127,12 +133,26 @@ def registry() -> Dict[str, Tuple[str, object]]:
 
 def lint_source(text: str, path: str = "<string>",
                 select: Optional[Sequence[str]] = None,
-                ignore: Sequence[str] = ()) -> List[Finding]:
+                ignore: Sequence[str] = (),
+                graph: Optional[object] = None) -> List[Finding]:
     """Run the AST rule families over one source blob (unit-test surface).
 
     Returns surviving findings (suppressions applied), sorted by line.
+    `graph` is the lint run's shared CallGraph; a single-blob run builds
+    its own one-file graph.
     """
     sf = SourceFile(path, text)
+    return _lint_sf(sf, select=select, ignore=ignore, graph=graph)
+
+
+def _lint_sf(sf: SourceFile,
+             select: Optional[Sequence[str]] = None,
+             ignore: Sequence[str] = (),
+             graph: Optional[object] = None) -> List[Finding]:
+    if graph is None:
+        from horovod_tpu.analysis.callgraph import CallGraph
+        graph = CallGraph([sf])
+    sf.graph = graph
     reg = registry()
     wanted = {r.upper() for r in select} if select is not None else None
     ignored = {r.upper() for r in ignore}
@@ -181,6 +201,9 @@ def lint_paths(paths: Sequence[str],
         if not pathlib.Path(p).exists():
             findings.append(Finding(str(p), 1, "HVD999",
                                     "path does not exist"))
+    # Parse everything FIRST: the interprocedural rules need one call
+    # graph spanning every linted file before any rule runs.
+    sfs: List[SourceFile] = []
     for path in _iter_py_files(paths):
         rel = path
         if root is not None:
@@ -190,14 +213,18 @@ def lint_paths(paths: Sequence[str],
                 pass
         try:
             text = path.read_text(encoding="utf-8")
-            findings.extend(lint_source(text, str(rel), select=select,
-                                        ignore=ignore))
+            sfs.append(SourceFile(str(rel), text))
         except SyntaxError as e:
             findings.append(Finding(str(rel), e.lineno or 1, "HVD999",
                                     f"syntax error: {e.msg}"))
         except OSError as e:
             findings.append(Finding(str(rel), 1, "HVD999",
                                     f"unreadable: {e}"))
+    from horovod_tpu.analysis.callgraph import CallGraph
+    graph = CallGraph(sfs)
+    for sf in sfs:
+        findings.extend(_lint_sf(sf, select=select, ignore=ignore,
+                                 graph=graph))
     if env_rule and (select is None or "HVD-ENV" in
                      {s.upper() for s in select}) \
             and "HVD-ENV" not in {i.upper() for i in ignore}:
@@ -211,6 +238,58 @@ def lint_paths(paths: Sequence[str],
     findings = list(unique.values())
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
     return findings
+
+
+def _baseline_key(f: Finding) -> Tuple[str, str, str]:
+    """Baseline identity for a finding. Line numbers churn with every
+    unrelated edit, so they are excluded — both the anchor line and any
+    line references embedded in the message (normalized to 'N')."""
+    return (f.path, f.rule_id, re.sub(r"\d+", "N", f.message))
+
+
+def load_baseline(path: str) -> Counter:
+    """Multiset of accepted findings from a ``--format json`` dump (or a
+    bare JSON list of finding objects)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data.get("findings", []) if isinstance(data, dict) else data
+    # Shape errors must surface as ValueError so the CLI's 'unreadable
+    # baseline' exit-2 path catches them (not an AttributeError crash).
+    if not isinstance(entries, list) \
+            or not all(isinstance(e, dict) for e in entries):
+        raise ValueError(
+            "baseline must be a --format json dump (or a JSON list of "
+            "finding objects)")
+    keys = []
+    for e in entries:
+        keys.append((str(e.get("path", "")), str(e.get("rule", "")),
+                     re.sub(r"\d+", "N", str(e.get("message", "")))))
+    return Counter(keys)
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Counter) -> Tuple[List[Finding], int]:
+    """(new findings, count matched by the baseline). Multiplicity-aware:
+    a baseline entry absorbs at most as many findings as it was recorded
+    with, so a *new* duplicate of a baselined finding still gates."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    matched = 0
+    for f in findings:
+        key = _baseline_key(f)
+        if budget[key] > 0:
+            budget[key] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    return new, matched
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    """The ``--format json`` payload — also the baseline file format."""
+    return json.dumps(
+        {"findings": [f.as_dict() for f in findings],
+         "count": len(findings)}, indent=2, sort_keys=True) + "\n"
 
 
 def _record_metrics(findings: Sequence[Finding]) -> None:
@@ -244,6 +323,15 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--root", default=None,
                         help="repo root for HVD-ENV and relative paths "
                              "(default: auto-detected from this package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt",
+                        help="output format; json doubles as the "
+                             "--baseline file format")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="diff-aware mode: findings recorded in FILE "
+                             "(a --format json dump) are accepted; only "
+                             "NEW findings are printed and gate the exit "
+                             "code")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -269,13 +357,31 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
     ignore = [s.strip() for s in args.ignore.split(",") if s.strip()]
     findings = lint_paths(args.paths, select=select, ignore=ignore,
                           root=root, env_rule=not args.no_env)
+    matched = 0
+    if args.baseline is not None:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            # A broken baseline must fail the gate, not pass everything.
+            print(f"hvdlint: unreadable baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        findings, matched = apply_baseline(findings, baseline)
     _record_metrics(findings)
-    for f in findings:
-        print(f.render())
+    if args.fmt == "json":
+        sys.stdout.write(render_json(findings))
+    else:
+        for f in findings:
+            print(f.render())
     if findings:
-        print(f"hvdlint: {len(findings)} finding(s)", file=sys.stderr)
+        tag = " new" if args.baseline is not None else ""
+        print(f"hvdlint: {len(findings)}{tag} finding(s)"
+              + (f" ({matched} baselined)" if matched else ""),
+              file=sys.stderr)
         return 1
-    print("hvdlint: clean")
+    if args.fmt != "json":
+        print("hvdlint: clean"
+              + (f" ({matched} baselined)" if matched else ""))
     return 0
 
 

@@ -25,6 +25,8 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
+import numpy as np
+
 from horovod_tpu.core import topology
 
 
@@ -33,13 +35,23 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _to_saveable(tree: Any) -> Any:
+    """Orbax's StandardCheckpointer rejects numpy scalar types
+    (``np.int64(7)`` raises ``Unsupported type``): widen them to 0-d
+    ndarrays for the save; `restore` coerces them back through `like`."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x, tree)
+
+
 def save(path: str, tree: Any, *, all_ranks_barrier: bool = True) -> None:
     """Write a pytree checkpoint from rank 0 (reference convention:
     rank-0-only saves); other ranks wait at a barrier so the checkpoint
     is durable before anyone races ahead."""
     if topology.rank() == 0:
         cp = _checkpointer()
-        cp.save(os.path.abspath(path), tree, force=True)
+        cp.save(os.path.abspath(path), _to_saveable(tree), force=True)
         cp.wait_until_finished()
     if all_ranks_barrier and topology.size() > 1:
         from horovod_tpu.ops import collectives
@@ -48,16 +60,23 @@ def save(path: str, tree: Any, *, all_ranks_barrier: bool = True) -> None:
 
 def restore(path: str, like: Optional[Any] = None) -> Any:
     """Read a checkpoint on every rank. `like` (a pytree of arrays or
-    ShapeDtypeStructs) restores with matching structure/dtypes."""
+    ShapeDtypeStructs) restores with matching structure/dtypes; numpy
+    scalar leaves in `like` (``np.int64``) come back as the same scalar
+    type (post-restore coercion of the 0-d arrays `save` wrote)."""
     import jax
 
     cp = _checkpointer()
     target = None
     if like is not None:
         target = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
-            if hasattr(x, "shape") and hasattr(x, "dtype") else x, like)
-    return cp.restore(os.path.abspath(path), target)
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+            if hasattr(x, "dtype") else x, _to_saveable(like))
+    out = cp.restore(os.path.abspath(path), target)
+    if like is not None:
+        out = jax.tree_util.tree_map(
+            lambda l, r: type(l)(np.asarray(r)[()])
+            if isinstance(l, np.generic) else r, like, out)
+    return out
 
 
 def latest_step(root: str) -> Optional[int]:

@@ -83,6 +83,15 @@ HOROVOD_CHECK_COLLECTIVES_TIMEOUT = "HOROVOD_CHECK_COLLECTIVES_TIMEOUT"
 HOROVOD_NATIVE_KV_ADDR = "HOROVOD_NATIVE_KV_ADDR"
 HOROVOD_NATIVE_KV_PORT = "HOROVOD_NATIVE_KV_PORT"
 
+# hvdrace runtime lockset race detector (analysis/race.py,
+# docs/static_analysis.md). Read at horovod_tpu import time (the
+# instrumentation must precede any runtime instance), so like the
+# metrics gate these are parsed where they are used; the Config fields
+# exist so `hvd.init()`'s snapshot still shows the effective values.
+HOROVOD_RACE_CHECK = "HOROVOD_RACE_CHECK"
+HOROVOD_RACE_CHECK_FAIL = "HOROVOD_RACE_CHECK_FAIL"
+HOROVOD_RACE_CHECK_MAX_REPORTS = "HOROVOD_RACE_CHECK_MAX_REPORTS"
+
 # Metrics / telemetry (observability/metrics.py, docs/observability.md).
 HOROVOD_METRICS = "HOROVOD_METRICS"
 HOROVOD_METRICS_DUMP = "HOROVOD_METRICS_DUMP"
@@ -168,6 +177,11 @@ class Config:
     check_collectives_interval: int = 10
     check_collectives_window: int = 512
     check_collectives_timeout: float = 5.0
+    # hvdrace lockset detector (analysis/race.py) — enforcement is wired
+    # at import time; these mirror the env for the init() snapshot.
+    race_check: bool = False
+    race_check_fail: bool = False
+    race_check_max_reports: int = 100
     dynamic_process_sets: bool = False
 
     # Topology overrides (launcher-injected)
@@ -251,6 +265,10 @@ class Config:
                 HOROVOD_CHECK_COLLECTIVES_WINDOW, 512),
             check_collectives_timeout=_env_float(
                 HOROVOD_CHECK_COLLECTIVES_TIMEOUT, 5.0),
+            race_check=_env_bool(HOROVOD_RACE_CHECK),
+            race_check_fail=_env_bool(HOROVOD_RACE_CHECK_FAIL),
+            race_check_max_reports=_env_int(
+                HOROVOD_RACE_CHECK_MAX_REPORTS, 100),
             dynamic_process_sets=_env_bool(HOROVOD_DYNAMIC_PROCESS_SETS),
             rank=_env_or_mpi(HOROVOD_RANK, "HOROVOD_MPI_RANK_ENV"),
             size=opt_int(HOROVOD_SIZE),

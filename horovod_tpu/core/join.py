@@ -45,9 +45,11 @@ def join(process_set: Optional[ProcessSet] = None) -> int:
     consensus there is no ordering, so the max rank is reported).
     """
     st = topology.state()
-    st.joined = True
+    with st.lock:  # joined is guarded-by lock (topology._GlobalState)
+        st.joined = True
     out = collectives.allreduce(
         np.asarray([topology.rank()], np.int64), op=T.ReduceOp.MAX,
         process_set=process_set)
-    st.joined = False
+    with st.lock:
+        st.joined = False
     return int(np.asarray(out).reshape(-1)[0])

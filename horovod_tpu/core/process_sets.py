@@ -89,7 +89,7 @@ class ProcessSetTable:
     def __init__(self, topo_state) -> None:
         self._lock = threading.RLock()
         self._topo = topo_state
-        self._table: Dict[int, ProcessSet] = {}
+        self._table: Dict[int, ProcessSet] = {}  # guarded-by: _lock
         self._next_id = 1
         self._free_ids: List[int] = []
         # id 0 = global set over the full mesh
@@ -149,7 +149,7 @@ class ProcessSetTable:
             return sorted(self._table)
 
 
-def _table() -> ProcessSetTable:
+def _ps_table() -> ProcessSetTable:
     from horovod_tpu.core import topology
     t = topology.state().process_set_table
     assert t is not None
@@ -178,15 +178,15 @@ def add_process_set(ranks_or_ps) -> ProcessSet:
     _require_dynamic()
     ps = ranks_or_ps if isinstance(ranks_or_ps, ProcessSet) else ProcessSet(
         ranks_or_ps)
-    _table().register(ps)
+    _ps_table().register(ps)
     return ps
 
 
 def remove_process_set(ps: ProcessSet) -> None:
     """Deregister (reference process_sets.py:145)."""
     _require_dynamic()
-    _table().remove(ps)
+    _ps_table().remove(ps)
 
 
 def get_process_set(process_set_id: int) -> ProcessSet:
-    return _table().get(process_set_id)
+    return _ps_table().get(process_set_id)

@@ -66,7 +66,7 @@ class _GlobalState:
         self.timeline = None
         self.parameter_manager = None
         self.stall_inspector = None
-        self.joined = False
+        self.joined = False  # guarded-by: lock
 
     def reset(self) -> None:
         self.__init__()

@@ -241,14 +241,14 @@ def grouped_reducescatter(tensors, op=None,
 
 def size_op(process_set_id: int = 0, name=None):
     tf = _tf()
-    from horovod_tpu.core.process_sets import _table
+    from horovod_tpu.core.process_sets import _ps_table as _table
     k = _table().get(process_set_id).size() if process_set_id else size()
     return tf.constant(k, dtype=tf.int32, name=name)
 
 
 def process_set_included_op(process_set_id: int = 0, name=None):
     tf = _tf()
-    from horovod_tpu.core.process_sets import _table
+    from horovod_tpu.core.process_sets import _ps_table as _table
     # ProcessSet.included() handles both ranks=None (global membership →
     # always in) and multi-slot processes (intersects ALL local slot ranks,
     # not just the first).

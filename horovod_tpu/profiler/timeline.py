@@ -100,7 +100,10 @@ class Timeline:
                 self._native.close()
                 self._native = None
                 return
-            self._queue.put(None)
+        # Sentinel enqueued OUTSIDE the critical section (HVD103):
+        # _active is already False so nothing enqueues behind it, and
+        # the writer thread must never contend with a lock holder.
+        self._queue.put(None)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None

@@ -109,7 +109,8 @@ def test_span_state_thread_safe(tmp_path):
         t.start()
     for t in threads:
         t.join()
-    assert tl._pending_spans == {}  # nothing leaked
+    with tl._lock:  # honor the guarded-by contract (hvdrace-enforced)
+        assert tl._pending_spans == {}  # nothing leaked
     tl.stop()
     spans = [e for e in _load_events(path) if e.get("ph") == "X"]
     assert len(spans) == n_threads * n_iter
