@@ -11,6 +11,11 @@
 #                    (tests marked `faults`; see docs/resilience.md)
 #   make metrics     observability smoke: registry/exporter units + a
 #                    scraped 2-process elastic job (docs/observability.md)
+#   make doctor-smoke flight-recorder + hvddoctor: unit suite plus the
+#                    2-process chaos e2e (injected silent staller /
+#                    SIGKILL) asserting the doctor names the stalled
+#                    rank and the last-agreed collective
+#                    (docs/observability.md, docs/troubleshooting.md)
 #   make lint        hvdlint static analysis: collective-consistency +
 #                    concurrency rules + env-knob docs drift, gating on
 #                    findings NEW relative to the checked-in baseline
@@ -26,9 +31,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline metrics race
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline metrics race doctor-smoke
 
-test: lint test-unit test-multiprocess test-e2e chaos entry
+test: lint test-unit test-multiprocess test-e2e chaos doctor-smoke entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -53,6 +58,12 @@ metrics:
 	$(PYTEST) tests/test_metrics.py tests/test_metrics_e2e.py \
 	    tests/test_timeline.py
 
+# Flight recorder + hvddoctor (docs/observability.md): the unit suite
+# runs in tier 1 too; the e2e chaos jobs (faults marker) only run here.
+doctor-smoke:
+	$(PYTEST) tests/test_flight.py
+	$(PYTEST) tests/test_flight_e2e.py --run-faults -m faults
+
 lint:
 	$(PYTHON) -m horovod_tpu.analysis horovod_tpu/ examples/ \
 	    --baseline scripts/hvdlint_baseline.json
@@ -69,6 +80,7 @@ lint-baseline:
 race:
 	env HOROVOD_RACE_CHECK=1 $(PYTEST) tests/test_race.py \
 	    tests/test_timeline.py tests/test_metrics.py \
+	    tests/test_flight.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
 	    tests/test_hvdlint.py \
 	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache

@@ -63,6 +63,7 @@ HOROVOD_RACE_CHECK_MAX_REPORTS = "HOROVOD_RACE_CHECK_MAX_REPORTS"
 DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.profiler.timeline",
     "horovod_tpu.observability.metrics",
+    "horovod_tpu.observability.flight",
     "horovod_tpu.elastic.driver",
     "horovod_tpu.runner.rendezvous",
     "horovod_tpu.analysis.verifier",

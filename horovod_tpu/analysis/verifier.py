@@ -383,6 +383,18 @@ class FingerprintVerifier:
             f"'python -m horovod_tpu.analysis' on the training script "
             f"to find the rank-dependent call)")
         self.divergence = msg
+        # Divergence is a flight-dump trigger: every rank's ring holds
+        # the exact call sequence that disagreed, and the doctor can
+        # merge the dumps into the full cross-rank story
+        # (observability/flight.py; never let a broken dump mask the
+        # divergence itself).
+        try:
+            from horovod_tpu.observability import flight as _fl
+            _fl.record("divergence", msg)
+            _fl.dump("divergence")
+            msg += _fl.dump_hint()
+        except Exception:
+            pass
         raise CollectiveDivergenceError(msg)
 
     # -------------------------------------------------------------- stall

@@ -3,11 +3,21 @@
 Reference: horovod/common/logging.cc — C++ macro logger with levels TRACE..
 FATAL, optional timestamps, rank prefix, controlled by HOROVOD_LOG_LEVEL /
 HOROVOD_LOG_HIDE_TIME. Here it is a thin layer over the std logging module
-with the same env contract.
+with the same env contract, plus:
+
+* ``HOROVOD_LOG_FORMAT=json`` — one JSON object per line (ts, level,
+  rank, elastic round, message, optional exception), for log pipelines
+  that ingest structured records instead of scraping prefixes.
+* The rank/round context is resolved PER RECORD by a logging.Filter,
+  never captured at first emission: after an elastic reset re-assigns
+  this process a new rank (elastic/__init__.py `_reset` rewrites
+  HOROVOD_RANK and re-inits topology), the very next log line carries
+  the new rank and round.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -24,13 +34,48 @@ _LEVELS = {
 _logger: logging.Logger | None = None
 
 
-class _RankFilter(logging.Filter):
+class _ContextFilter(logging.Filter):
+    """Stamp each record with the CURRENT rank and elastic round.
+
+    Runs per record, so the prefix tracks elastic re-inits instead of
+    freezing at whatever the first emission saw.
+    """
+
     def filter(self, record: logging.LogRecord) -> bool:
         from horovod_tpu.core import topology
-        record.hvd_rank = topology.rank_or_none()
-        if record.hvd_rank is None:
-            record.hvd_rank = "-"
+        rank = topology.rank_or_none()
+        record.hvd_rank = "-" if rank is None else rank
+        record.hvd_round = os.environ.get("HOROVOD_ELASTIC_ROUND", "") or "-"
         return True
+
+
+class _JsonFormatter(logging.Formatter):
+    """HOROVOD_LOG_FORMAT=json: one structured object per line."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S")
+                  + f".{int(record.msecs):03d}",
+            "level": record.levelname.lower(),
+            "rank": getattr(record, "hvd_rank", "-"),
+            "round": getattr(record, "hvd_round", "-"),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj)
+
+
+def _make_formatter() -> logging.Formatter:
+    fmt_kind = os.environ.get("HOROVOD_LOG_FORMAT", "text").strip().lower()
+    if fmt_kind == "json":
+        return _JsonFormatter()
+    hide_time = os.environ.get("HOROVOD_LOG_HIDE_TIME", "").lower() in (
+        "1", "true", "yes")
+    fmt = "[%(levelname)s | rank %(hvd_rank)s] %(message)s" if hide_time \
+        else "%(asctime)s [%(levelname)s | rank %(hvd_rank)s] %(message)s"
+    return logging.Formatter(fmt)
 
 
 def get_logger() -> logging.Logger:
@@ -43,13 +88,19 @@ def get_logger() -> logging.Logger:
     logger.setLevel(level)
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)
-        hide_time = os.environ.get("HOROVOD_LOG_HIDE_TIME", "").lower() in (
-            "1", "true", "yes")
-        fmt = "[%(levelname)s | rank %(hvd_rank)s] %(message)s" if hide_time else \
-            "%(asctime)s [%(levelname)s | rank %(hvd_rank)s] %(message)s"
-        handler.setFormatter(logging.Formatter(fmt))
-        handler.addFilter(_RankFilter())
+        handler.setFormatter(_make_formatter())
+        handler.addFilter(_ContextFilter())
         logger.addHandler(handler)
         logger.propagate = False
     _logger = logger
-    return logger
+    return _logger
+
+
+def reset_for_tests() -> None:
+    """Drop the cached logger AND its handlers so the next get_logger()
+    re-reads HOROVOD_LOG_LEVEL / HOROVOD_LOG_FORMAT / _HIDE_TIME."""
+    global _logger
+    logger = logging.getLogger("horovod_tpu")
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    _logger = None

@@ -71,6 +71,17 @@ def _metrics():
     return _mx[1]
 
 
+def _flight_event(desc: str) -> None:
+    """Retry/breaker events feed the flight recorder's ring
+    (observability/flight.py) — failure-path only, never the success
+    path, so healthy control-plane traffic records nothing."""
+    try:
+        from horovod_tpu.observability import flight
+        flight.record("resilience", desc)
+    except Exception:
+        pass
+
+
 def is_transient(e: BaseException) -> bool:
     """Default retryable predicate: transport-level failures and HTTP 5xx.
 
@@ -194,6 +205,9 @@ class RetryPolicy:
                 except StopIteration:
                     if mx is not None:
                         mx["exhausted"].labels(policy=self.name).inc()
+                    _flight_event(f"retry policy '{self.name or 'inline'}' "
+                                  f"exhausted after {attempt} attempt(s): "
+                                  f"{e}")
                     raise RetryError(
                         f"retries exhausted after {attempt} attempt(s): "
                         f"{e}") from e
@@ -202,12 +216,19 @@ class RetryPolicy:
                     if remaining <= 0:
                         if mx is not None:
                             mx["exhausted"].labels(policy=self.name).inc()
+                        _flight_event(
+                            f"retry policy '{self.name or 'inline'}' "
+                            f"deadline {self.deadline}s exceeded after "
+                            f"{attempt} attempt(s): {e}")
                         raise RetryError(
                             f"retry deadline {self.deadline}s exceeded "
                             f"after {attempt} attempt(s): {e}") from e
                     delay = min(delay, remaining)
                 if mx is not None:
                     mx["retries"].labels(policy=self.name).inc()
+                _flight_event(f"retry policy '{self.name or 'inline'}' "
+                              f"attempt {attempt} failed ({e}); retrying "
+                              f"in {delay:.2f}s")
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
                 time.sleep(delay)
@@ -295,6 +316,7 @@ class CircuitBreaker:
             self._probing = False
         if reopened:
             _metrics()["breaker"].labels(state="closed").inc()
+            _flight_event("circuit breaker closed (probe succeeded)")
 
     def record_failure(self) -> None:
         with self._lock:
@@ -304,8 +326,11 @@ class CircuitBreaker:
             if self._failures >= self.failure_threshold:
                 opened = self._opened_at is None
                 self._opened_at = self._clock()
+            failures = self._failures
         if opened:
             _metrics()["breaker"].labels(state="open").inc()
+            _flight_event(f"circuit breaker opened after {failures} "
+                          f"consecutive failure(s)")
 
     def call(self, fn: Callable, *args, **kwargs):
         if not self.allow():

@@ -557,6 +557,14 @@ def _start_stall_watch(si, cfg: Config) -> None:
                             source="watcher").inc()
                 except Exception:
                     pass
+                try:
+                    from horovod_tpu.observability import flight as _fl
+                    _fl.record("stall",
+                               f"watcher: collective(s) "
+                               f"{', '.join(stalled)} stalled over "
+                               f"{cfg.stall_warning_seconds:.0f}s{who}")
+                except Exception:
+                    pass
                 get_logger().warning(
                     "One or more collectives stalled for over %.0fs: %s — "
                     "some ranks may not have reached them%s "
@@ -583,6 +591,13 @@ def _start_stall_watch(si, cfg: Config) -> None:
                     get_logger().error(
                         "Stall exceeded "
                         "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; aborting")
+                    # os._exit skips atexit — flush the flight recorder
+                    # NOW or the abort leaves no black box behind.
+                    try:
+                        from horovod_tpu.observability import flight as _fl
+                        _fl.dump("stall_abort")
+                    except Exception:
+                        pass
                     os._exit(1)
             _time.sleep(max(cfg.stall_warning_seconds / 2.0, 1.0))
 

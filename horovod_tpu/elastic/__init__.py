@@ -45,7 +45,10 @@ def _reset(state: State) -> None:
     driver kills removed workers; we let them leave on their own).
     """
     from horovod_tpu.core import topology
+    from horovod_tpu.observability import flight
 
+    flight.record("elastic", "reset: detaching state and leaving the "
+                  "current ring")
     state.to_host()
     notifier = worker_mod.get_notifier()
     topology.shutdown()
@@ -78,8 +81,13 @@ def _reset(state: State) -> None:
             os.environ["HOROVOD_COORDINATOR_ADDR"] = assignment["coord"]
         os.environ["HOROVOD_ELASTIC_ROUND"] = str(new_round)
         notifier.advance(new_round)
+        flight.set_round(new_round, assignment["rank"])
+        flight.record("elastic",
+                      f"adopted round {new_round}: rank="
+                      f"{assignment['rank']} size={assignment['size']}")
 
     topology.init()
+    flight.record("elastic", "re-initialized after reset")
 
 
 def run(func: Callable) -> Callable:
@@ -100,10 +108,23 @@ def run(func: Callable) -> Callable:
                 result = func(state, *args, **kwargs)
                 worker_mod.stop_notifier()
                 return result
-            except HorovodInternalError:
+            except HorovodInternalError as e:
+                # Dump before recovery tears the evidence down — unless
+                # the raising site (stall watchdog, comm-failure
+                # conversion) just dumped with its more specific
+                # trigger, which a re-dump would overwrite
+                # (observability/flight.py).
+                from horovod_tpu.observability import flight
+                flight.record("elastic",
+                              f"HorovodInternalError; restoring last "
+                              f"commit: {e}")
+                flight.dump_if_stale("internal_error")
                 state.restore()
                 skip_sync = False
             except HostsUpdatedInterrupt as e:
+                from horovod_tpu.observability import flight
+                flight.record("elastic", "HostsUpdatedInterrupt: host "
+                              "set changed; resetting")
                 skip_sync = bool(getattr(e, "skip_sync", False))
             _reset(state)
             state.on_reset()

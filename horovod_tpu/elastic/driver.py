@@ -261,6 +261,11 @@ class ElasticDriver:
             print(f"elastic: round {round_id}: slots="
                   f"{[(s.hostname, s.local_rank, s.rank) for s in slots]} "
                   f"survivors={len(survivors)}", file=sys.stderr)
+            from horovod_tpu.observability import flight
+            flight.record("elastic",
+                          f"launcher: round {round_id} with "
+                          f"{len(slots)} slot(s), {len(survivors)} "
+                          f"survivor(s)")
             if self.publish_fn is not None:
                 self.publish_fn(slots, round_id)
             self._workers = {}
@@ -304,6 +309,10 @@ class ElasticDriver:
             w = self._workers.pop(rank, None)
         if w is None:
             return
+        from horovod_tpu.observability import flight
+        flight.record("elastic",
+                      f"launcher: worker rank={rank} "
+                      f"({w.slot.hostname}) exited code={exit_code}")
         if exit_code == 0:
             self.registry.record_success(rank)
             with self._lock:
@@ -575,8 +584,23 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
         reset_limit=args.reset_limit,
         publish_fn=publisher.publish)
     driver.start()
+    rc = 1
     try:
-        return drive_elastic_loop(driver, args.elastic_timeout)
+        rc = drive_elastic_loop(driver, args.elastic_timeout)
+        return rc
     finally:
+        # Persist the flight tails workers pushed into the KV before the
+        # server (and the tails with it) disappears: a SIGKILL'd
+        # worker's only surviving record lives here. Then point the
+        # operator at the doctor when the job failed.
+        from horovod_tpu.observability import flight
+        tails = flight.persist_kv_tails(rdv)
+        flight_dir = os.environ.get(flight.FLIGHT_DIR_ENV, "")
+        if rc != 0 and flight_dir and (
+                tails or os.path.isdir(flight_dir)):
+            print(f"elastic: flight-recorder dumps are in {flight_dir}; "
+                  f"merge them with `python -m "
+                  f"horovod_tpu.observability.doctor --dir {flight_dir}`",
+                  file=sys.stderr)
         publisher.close()
         rdv.stop()

@@ -5,6 +5,11 @@ shell under HOROVOD_METRICS=0) and Prometheus text rendering.
 `export` — the background fan-out: rendezvous KV push (feeds the
 launcher's `/metrics` scrape route), periodic JSON dumps, and Chrome-
 trace counter tracks. See docs/observability.md for the metric catalog.
+`flight` — the always-on flight recorder: a bounded ring of structured
+runtime events per rank, dumped on stall/divergence/fatal-error/
+SIGUSR1/exit. `doctor` — `python -m horovod_tpu.observability.doctor`
+merges the per-rank dumps into one cross-rank postmortem
+(docs/observability.md, docs/troubleshooting.md).
 """
 
 from horovod_tpu.observability.metrics import (  # noqa: F401
@@ -13,4 +18,7 @@ from horovod_tpu.observability.metrics import (  # noqa: F401
 )
 from horovod_tpu.observability.export import (  # noqa: F401
     MetricsExporter, start_exporter, stop_exporter,
+)
+from horovod_tpu.observability.flight import (  # noqa: F401
+    FlightRecorder,
 )

@@ -1,0 +1,544 @@
+"""`hvddoctor`: merge per-rank flight-recorder dumps into one story.
+
+    python -m horovod_tpu.observability.doctor --dir /path/to/flight
+    python -m horovod_tpu.observability.doctor --kv host:port
+    python -m horovod_tpu.observability.doctor --dir D --json
+    python -m horovod_tpu.observability.doctor --dir D --trace out.json
+
+Inputs are the artifacts `observability/flight.py` leaves behind:
+
+* `<rank>.json` — a rank's full atomic dump (stall watchdog raise,
+  divergence, SIGUSR1, interpreter exit),
+* `kv-tail-rank-<r>.r<round>.json` — the compact tail the launcher
+  persisted from its rendezvous KV at job end (survives worker
+  SIGKILL),
+* a live rendezvous KV (`--kv`) — scraped directly while the job (or
+  its launcher) is still up.
+
+Elastic resets REUSE rank numbers, so everything is analyzed per
+`(elastic round, process set)`: a dump is attributed to the rank its
+process held *in that round* (the recorder tracks the mapping), and
+per-set call indices restart each round — cross-rank alignment is only
+meaningful within one. The merged report names, per round and process
+set (headline: the world set):
+
+* the **last collective every rank agreed on** (same op signature and
+  name at the same per-set call index on every participating rank),
+* the **first point of divergence** — either ranks issuing *different*
+  collectives at one call index, or ranks that *stopped* while peers
+  continued (the silent-staller shape),
+* **stragglers / missing ranks**, each with its last-known event and
+  (from full dumps) the blocked thread stacks,
+* per-process event tails, and optionally a Perfetto-compatible trace
+  (`--trace`) with one track per process.
+
+See docs/troubleshooting.md for a worked read-through of a report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from horovod_tpu.observability.flight import DUMP_VERSION, SCOPE
+
+#: process_set_id of the world set (core/process_sets.py registers it
+#: first) — the headline group of every report.
+WORLD_GROUP = 0
+
+
+def group_key(round_id: int, gid: int) -> str:
+    """JSON key for one (elastic round, process set) analysis."""
+    return f"r{round_id}-ps{gid}"
+
+
+class RankDump:
+    """One process's parsed dump (full or KV tail)."""
+
+    def __init__(self, body: Dict[str, Any], source: str,
+                 tail_only: bool) -> None:
+        self.body = body
+        self.source = source          # file path or kv key
+        self.tail_only = tail_only    # compact KV tail, not a full dump
+        self.rank: Optional[int] = body.get("rank")
+        self.size: Optional[int] = body.get("size")
+        self.trigger: str = body.get("trigger", "?")
+        self.events: List[list] = body.get("events", [])
+        self.stacks: Dict[str, List[str]] = body.get("stacks", {}) or {}
+        rnd = body.get("round")
+        if rnd is None:
+            v = str(body.get("elastic_round", "") or "")
+            rnd = int(v) if v.isdigit() else 0
+        self.round: int = int(rnd)
+        self.rounds: Dict[str, Any] = body.get("rounds", {}) or {}
+
+    # --------------------------------------------------------- identity
+    def process_id(self) -> Tuple:
+        """Stable identity of the emitting PROCESS — ranks are reused
+        across elastic rounds, (hostname, pid) is not."""
+        host = self.body.get("hostname") or ""
+        pid = self.body.get("pid")
+        if host or pid:
+            return (host, pid)
+        return (f"rank{self.rank}", None)
+
+    def rank_for_round(self, round_id: int) -> Optional[int]:
+        """The rank this process held in `round_id` (recorder-tracked;
+        falls back to the dump-time rank)."""
+        v = self.rounds.get(str(round_id), self.rank)
+        return None if v is None else int(v)
+
+    def ranks_seen(self) -> List[int]:
+        out = {int(v) for v in self.rounds.values() if v is not None}
+        if self.rank is not None:
+            out.add(self.rank)
+        return sorted(out)
+
+    # ------------------------------------------------------------ views
+    def collectives(self) -> Dict[Tuple[int, int],
+                                  Dict[int, Tuple[str, str, float]]]:
+        """{(round, group_id): {call_idx: (desc, name, wall_time)}}."""
+        out: Dict[Tuple[int, int], Dict[int, Tuple[str, str, float]]] = {}
+        for ev in self.events:
+            if len(ev) >= 7 and ev[2] == "collective":
+                rnd = int(ev[7]) if len(ev) >= 8 else self.round
+                out.setdefault((rnd, int(ev[5])), {})[int(ev[6])] = \
+                    (str(ev[3]), str(ev[4]), float(ev[1]))
+        return out
+
+    def last_event(self) -> Optional[list]:
+        return self.events[-1] if self.events else None
+
+    def tail(self, n: int) -> List[list]:
+        return self.events[-n:]
+
+
+def _parse_dump(raw: bytes, source: str, tail_only: bool
+                ) -> Optional[RankDump]:
+    try:
+        body = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(body, dict) or "events" not in body:
+        return None
+    if body.get("version", DUMP_VERSION) > DUMP_VERSION:
+        print(f"doctor: {source}: dump version {body.get('version')} is "
+              f"newer than this tool understands; skipping",
+              file=sys.stderr)
+        return None
+    return RankDump(body, source, tail_only)
+
+
+# ----------------------------------------------------------------- load
+
+def load_dir(d: str) -> List[RankDump]:
+    dumps: List[RankDump] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError as e:
+        print(f"doctor: cannot read --dir {d}: {e}", file=sys.stderr)
+        return dumps
+    for name in names:
+        if not name.endswith(".json") or ".tmp" in name:
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        dump = _parse_dump(raw, path, tail_only=name.startswith("kv-tail-"))
+        if dump is not None:
+            dumps.append(dump)
+    return dumps
+
+
+def load_kv(addr: str, port: int, max_ranks: int = 256,
+            max_rounds: int = 64) -> List[RankDump]:
+    """Scrape `flight/rank-<r>.r<round>` tails from a live rendezvous
+    server.
+
+    Rounds 0..current (read from the driver's `elastic/round` key when
+    present) are probed per rank with a consecutive-miss cutoff; once
+    any tail reveals the job size, exactly that rank range is covered.
+    """
+    from horovod_tpu.common.resilience import RetryPolicy
+    from horovod_tpu.runner.rendezvous import KVClient
+    kv = KVClient(addr, port, retry_policy=RetryPolicy(max_attempts=1),
+                  request_timeout=5.0)
+    top_round = 0
+    try:
+        raw = kv.get("elastic", "round", timeout=0.0)
+        if raw:
+            top_round = min(int(raw.decode()), max_rounds)
+    except Exception:
+        pass
+    dumps: List[RankDump] = []
+    known_size: Optional[int] = None
+    for rnd in range(top_round + 1):
+        misses = 0
+        r = 0
+        while r < max_ranks:
+            if known_size is not None and r >= known_size:
+                break
+            try:
+                raw = kv.get(SCOPE, f"rank-{r}.r{rnd}", timeout=0.0)
+            except Exception as e:
+                print(f"doctor: KV scrape failed at rank {r}: {e}",
+                      file=sys.stderr)
+                return dumps
+            if raw is None:
+                misses += 1
+                if known_size is None and misses >= 8:
+                    break
+            else:
+                misses = 0
+                dump = _parse_dump(raw, f"kv:{SCOPE}/rank-{r}.r{rnd}",
+                                   tail_only=True)
+                if dump is not None:
+                    dumps.append(dump)
+                    if dump.size and known_size is None:
+                        known_size = dump.size
+            r += 1
+        known_size = None  # sizes differ per round
+    return dumps
+
+
+def dedupe(dumps: List[RankDump]) -> List[RankDump]:
+    """Collapse redundant dumps, keeping non-overlapping evidence.
+
+    Full dumps: one per PROCESS — the biggest (a full atexit dump is a
+    superset of the same process's earlier full dumps). KV tails: one
+    per (process, round), and a tail is dropped against a full dump
+    only when that dump actually retains collectives from the tail's
+    round — a 64-event tail from an earlier round is NOT covered by a
+    later round's dump whose ring moved on."""
+    fulls: Dict[Tuple, RankDump] = {}
+    tails: Dict[Tuple, RankDump] = {}
+    for d in dumps:
+        if d.rank is None and not d.events:
+            continue
+        if d.tail_only:
+            key = d.process_id() + (d.round,)
+            cur = tails.get(key)
+            if cur is None or len(d.events) > len(cur.events):
+                tails[key] = d
+        else:
+            key = d.process_id()
+            cur = fulls.get(key)
+            if cur is None or len(d.events) > len(cur.events):
+                fulls[key] = d
+    kept: List[RankDump] = list(fulls.values())
+    for d in tails.values():
+        full = fulls.get(d.process_id())
+        if full is not None and any(rnd == d.round
+                                    for rnd, _ in full.collectives()):
+            continue  # the full dump still covers this round
+        kept.append(d)
+    return sorted(kept,
+                  key=lambda d: (d.rank if d.rank is not None else 1 << 30,
+                                 d.round))
+
+
+# ---------------------------------------------------------------- merge
+
+def analyze_group(round_id: int, gid: int, dumps: List[RankDump]
+                  ) -> Optional[Dict[str, Any]]:
+    """Cross-rank agreement analysis for one (round, process set)."""
+    calls: Dict[int, Dict[int, Tuple[str, str, float]]] = {}
+    for d in dumps:
+        c = d.collectives().get((round_id, gid))
+        if not c:
+            continue
+        label = d.rank_for_round(round_id)
+        if label is None:
+            continue
+        # Same (round, rank) from two processes should not survive
+        # dedupe; if it does, keep the fuller record.
+        if label not in calls or len(c) > len(calls[label]):
+            calls[label] = c
+    if not calls:
+        return None
+    last = {r: max(c) for r, c in calls.items()}
+    first = {r: min(c) for r, c in calls.items()}
+    # Only indices retained on EVERY member can be compared (the ring
+    # may have dropped older calls on busier ranks).
+    lo = max(first.values())
+    hi = min(last.values())
+    last_agreed: Optional[Tuple[int, str, str]] = None
+    divergence: Optional[Dict[str, Any]] = None
+    for i in range(lo, hi + 1):
+        entries = {r: c.get(i) for r, c in calls.items()}
+        if any(v is None for v in entries.values()):
+            continue  # a gap (pruned slot) — not comparable, not a lie
+        values = {(v[0], v[1]) for v in entries.values()}
+        if len(values) == 1:
+            desc, name = next(iter(values))
+            last_agreed = (i, desc, name)
+        else:
+            clusters: Dict[Tuple[str, str], List[int]] = {}
+            for r, v in entries.items():
+                clusters.setdefault((v[0], v[1]), []).append(r)
+            divergence = {
+                "call": i,
+                "issued": [{"ranks": sorted(rs), "desc": d_, "name": n_}
+                           for (d_, n_), rs in sorted(clusters.items())],
+            }
+            break
+    max_last = max(last.values())
+    stragglers = sorted(r for r, v in last.items() if v < max_last)
+    # Ranks the round should have had but which left no events at all.
+    sizes = [d.size for d in dumps
+             if d.size and d.round == round_id]
+    expected = max(sizes) if sizes else None
+    missing = sorted(set(range(expected)) - set(calls)) \
+        if expected is not None else []
+    return {
+        "round": round_id,
+        "group": gid,
+        "members": sorted(calls),
+        "calls_per_rank": {str(r): last[r] + 1 for r in sorted(last)},
+        "last_agreed": None if last_agreed is None else {
+            "call": last_agreed[0], "desc": last_agreed[1],
+            "name": last_agreed[2]},
+        "divergence": divergence,
+        "stragglers": stragglers,
+        "behind_by": {str(r): max_last - last[r] for r in stragglers},
+        "missing": missing,
+    }
+
+
+def merge(dumps: List[RankDump], tail: int = 8) -> Dict[str, Any]:
+    size = max((d.size for d in dumps if d.size), default=None)
+    seen_ranks: set = set()
+    for d in dumps:
+        seen_ranks.update(d.ranks_seen())
+    expected = size if size is not None else (max(seen_ranks) + 1
+                                              if seen_ranks else 0)
+    missing = sorted(set(range(expected)) - seen_ranks)
+    keys = set()
+    for d in dumps:
+        keys.update(d.collectives())
+    groups: Dict[str, Dict[str, Any]] = {}
+    for rnd, gid in sorted(keys):
+        res = analyze_group(rnd, gid, dumps)
+        if res is not None:
+            groups[group_key(rnd, gid)] = res
+    straggler_set = set()
+    for g in groups.values():
+        straggler_set.update(g["stragglers"])
+    report: Dict[str, Any] = {
+        "ranks_expected": expected,
+        "ranks_dumped": sorted(seen_ranks),
+        "tail_only_ranks": sorted(
+            {r for d in dumps if d.tail_only for r in d.ranks_seen()}),
+        "missing_ranks": missing,
+        "triggers": {f"{d.rank}@r{d.round}": d.trigger for d in dumps},
+        "groups": groups,
+        "per_rank": {},
+    }
+    for d in dumps:
+        info: Dict[str, Any] = {
+            "rank": d.rank,
+            "round": d.round,
+            "source": d.source,
+            "tail_only": d.tail_only,
+            "trigger": d.trigger,
+            "events_retained": len(d.events),
+            "events_dropped": d.body.get("dropped", 0),
+            "last_event": d.last_event(),
+            "tail": d.tail(tail),
+        }
+        if (set(d.ranks_seen()) & straggler_set) \
+                or d.trigger not in ("atexit", "tick"):
+            # The interesting processes keep their stacks in the report.
+            info["stacks"] = d.stacks
+        key = f"{d.rank}@r{d.round}"
+        while key in report["per_rank"]:
+            key += "'"
+        report["per_rank"][key] = info
+    return report
+
+
+# --------------------------------------------------------------- render
+
+def _fmt_event(ev: list) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(ev[1])) + \
+        f".{int((ev[1] % 1) * 1000):03d}"
+    if len(ev) >= 7 and ev[2] == "collective":
+        name = f" name={ev[4]}" if ev[4] else ""
+        return f"{ts} collective ps{ev[5]}#{ev[6]} {ev[3]}{name}"
+    return f"{ts} {ev[2]} {ev[3]}"
+
+
+def _group_label(g: Dict[str, Any]) -> str:
+    base = "world" if g["group"] == WORLD_GROUP \
+        else f"process set {g['group']}"
+    return base if g["round"] == 0 else f"round {g['round']} · {base}"
+
+
+def render(report: Dict[str, Any], tail: int = 8) -> str:
+    out: List[str] = []
+    add = out.append
+    add("hvddoctor: cross-rank flight-recorder postmortem")
+    add(f"  ranks: {report['ranks_expected']} expected, "
+        f"{len(report['per_rank'])} dump(s) loaded "
+        f"({len(report['tail_only_ranks'])} KV-tail-only)")
+    if report["missing_ranks"]:
+        add(f"  MISSING ranks (no dump, no tail — killed before any "
+            f"flush?): {report['missing_ranks']}")
+    trig = ", ".join(f"rank {k}: {t}"
+                     for k, t in report["triggers"].items())
+    add(f"  dump triggers: {trig}")
+    add("")
+    for _, g in sorted(report["groups"].items(),
+                       key=lambda kv: (kv[1]["round"], kv[1]["group"])):
+        add(f"[{_group_label(g)}] collective agreement "
+            f"(ranks {g['members']}, calls per rank "
+            f"{g['calls_per_rank']})")
+        la = g["last_agreed"]
+        if la is not None:
+            name = f" name={la['name']}" if la["name"] else ""
+            add(f"  last collective all ranks agreed on: call "
+                f"#{la['call']}: {la['desc']}{name}")
+        else:
+            add("  no call index was comparable across every rank "
+                "(windows did not overlap)")
+        if g["divergence"] is not None:
+            dv = g["divergence"]
+            add(f"  FIRST DIVERGENCE at call #{dv['call']}:")
+            for c in dv["issued"]:
+                name = f" name={c['name']}" if c["name"] else ""
+                add(f"    rank(s) {c['ranks']} issued {c['desc']}{name}")
+        if g["stragglers"]:
+            for r in g["stragglers"]:
+                add(f"  STRAGGLER rank {r}: stopped "
+                    f"{g['behind_by'][str(r)]} call(s) behind its peers")
+        if g["missing"]:
+            add(f"  rank(s) {g['missing']} recorded NO collectives in "
+                f"this round")
+        if g["divergence"] is None and not g["stragglers"] \
+                and not g["missing"]:
+            add("  all ranks in step at the end of the recorded window")
+        add("")
+    for key, info in report["per_rank"].items():
+        kind = "KV tail" if info["tail_only"] else "full dump"
+        rnd = "" if info["round"] == 0 else f" @ round {info['round']}"
+        add(f"rank {info['rank']}{rnd} ({kind}, "
+            f"trigger={info['trigger']}, "
+            f"{info['events_retained']} event(s) retained, "
+            f"{info['events_dropped']} dropped): {info['source']}")
+        last = info["last_event"]
+        if last:
+            add(f"  last event: {_fmt_event(last)}")
+        for ev in info["tail"][-tail:]:
+            add(f"    {_fmt_event(ev)}")
+        stacks = info.get("stacks") or {}
+        for tname, frames in sorted(stacks.items()):
+            if "MainThread" in tname or len(stacks) <= 2:
+                add(f"  stack [{tname}]:")
+                for ln in frames[-6:]:
+                    for piece in ln.splitlines():
+                        add(f"    {piece}")
+        add("")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------- trace
+
+def export_trace(dumps: List[RankDump], path: str) -> None:
+    """Perfetto/about:tracing export: one track (pid) per process,
+    every flight event as an instant at its wall-clock time."""
+    events: List[dict] = []
+    for i, d in enumerate(dumps):
+        # One track per PROCESS: rank numbers are reused across elastic
+        # rounds, so the track id must be unique per dump, not per rank.
+        track = i
+        label = f"rank {d.rank}" if d.rank is not None else d.source
+        if d.round:
+            label += f" (round {d.round})"
+        events.append({"ph": "M", "pid": track, "name": "process_name",
+                       "args": {"name": label}})
+        for ev in d.events:
+            name = (f"{ev[3]}" if len(ev) < 7
+                    else f"ps{ev[5]}#{ev[6]} {ev[3]}")
+            events.append({
+                "ph": "i", "s": "t", "pid": track, "tid": 0,
+                "ts": ev[1] * 1e6,  # epoch seconds -> us
+                "name": name,
+                "cat": ev[2],
+                "args": {"seq": ev[0]},
+            })
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"displayTimeUnit": "ms", "traceEvents": events}, f)
+    os.replace(tmp, path)
+
+
+# ------------------------------------------------------------------ cli
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.observability.doctor",
+        description="Merge per-rank flight-recorder dumps "
+                    "(HOROVOD_FLIGHT_DIR and/or the rendezvous KV) into "
+                    "one cross-rank postmortem report.")
+    p.add_argument("--dir", default=os.environ.get("HOROVOD_FLIGHT_DIR", ""),
+                   help="directory of per-rank dumps (<rank>.json) and "
+                        "persisted KV tails (default: $HOROVOD_FLIGHT_DIR)")
+    p.add_argument("--kv", default="", metavar="HOST:PORT",
+                   help="scrape flight tails from a live rendezvous "
+                        "server (HOROVOD_SECRET_KEY honored from env)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable report instead of text")
+    p.add_argument("--trace", default="", metavar="PATH",
+                   help="also write a Perfetto-compatible trace of every "
+                        "merged event (one track per process)")
+    p.add_argument("--tail", type=int, default=8,
+                   help="events shown per rank in the text report")
+    p.add_argument("--max-ranks", type=int, default=256,
+                   help="KV scrape probe ceiling when no dump names the "
+                        "job size")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    loaded: List[RankDump] = []
+    if args.dir:
+        loaded.extend(load_dir(args.dir))
+    if args.kv:
+        addr, _, port = args.kv.rpartition(":")
+        if not addr or not port.isdigit():
+            print(f"doctor: bad --kv '{args.kv}' (want HOST:PORT)",
+                  file=sys.stderr)
+            return 2
+        loaded.extend(load_kv(addr, int(port), max_ranks=args.max_ranks))
+    if not args.dir and not args.kv:
+        build_parser().print_help(sys.stderr)
+        return 2
+    dumps = dedupe(loaded)
+    if not dumps:
+        print("doctor: no flight dumps found (is HOROVOD_FLIGHT_DIR set "
+              "on the job, or the rendezvous server still up?)",
+              file=sys.stderr)
+        return 2
+    report = merge(dumps, tail=args.tail)
+    if args.trace:
+        export_trace(dumps, args.trace)
+        print(f"doctor: wrote merged trace to {args.trace}",
+              file=sys.stderr)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        print(render(report, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ telemetry must never take down training.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -150,6 +151,15 @@ class MetricsExporter:
             snap = snap or reg.snapshot(self.rank_fn())
             self._push(snap)
             self._timeline_counters(snap)
+            # Refresh this rank's flight-recorder KV tail on the same
+            # cadence (observability/flight.py): it is what survives in
+            # the launcher if this worker is SIGKILL'd before any dump
+            # trigger fires. Best-effort like every other sink.
+            try:
+                from horovod_tpu.observability import flight
+                flight.push_tail()
+            except Exception:
+                pass
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -210,6 +220,13 @@ def start_exporter(cfg: Config) -> Optional[MetricsExporter]:
             return None
         _exporter = MetricsExporter(cfg, rank_fn, timeline_fn)
         _exporter.start()
+        # Interpreter-exit flush: a short-lived or crashing job that
+        # never reaches hvd.shutdown() (or whose init failed after the
+        # exporter started — topology's atexit shutdown() returns early
+        # then) still leaves one final snapshot/KV push behind.
+        # stop_exporter is idempotent, so the normal shutdown path and
+        # this hook compose.
+        atexit.register(stop_exporter)
         return _exporter
 
 

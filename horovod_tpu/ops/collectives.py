@@ -54,6 +54,7 @@ from horovod_tpu.common.exceptions import (DuplicateNameError,
                                            HorovodTpuError)
 from horovod_tpu.core import topology
 from horovod_tpu.core.process_sets import ProcessSet, global_process_set
+from horovod_tpu.observability import flight as _flight
 
 _AXIS = "hvd"
 
@@ -143,6 +144,10 @@ class StallWatchdog:
                 if not warned and age >= self.warn_sec:
                     warned = True
                     _mx()["stall_warn"].labels(source="watchdog").inc()
+                    _flight.record(
+                        "stall", f"collective '{name}' stalled for "
+                        f"{age:.1f}s (warning threshold "
+                        f"{self.warn_sec:.0f}s)")
                     get_logger().warning(
                         "collective '%s' stalled for %.1fs "
                         "(HOROVOD_STALL_CHECK_TIME_SECONDS=%.0f)",
@@ -161,13 +166,24 @@ class StallWatchdog:
                         fp_context = _vf.stall_context()
                     except Exception:
                         fp_context = ""
+                    # The shutdown raise is exactly the moment the
+                    # flight recorder exists for: every rank's ring
+                    # still holds the calls leading into the hang.
+                    try:
+                        _flight.record(
+                            "stall", f"collective '{name}' stalled past "
+                            f"shutdown window {self.shutdown_sec:.0f}s")
+                        _flight.dump("stall_watchdog")
+                        flight_hint = _flight.dump_hint()
+                    except Exception:
+                        flight_hint = ""
                     raise HorovodInternalError(
                         f"collective '{name}' stalled past "
                         f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
                         f"{self.shutdown_sec:.0f}s"
                         + (f" (outstanding: {', '.join(stalled)})"
                            if stalled else "")
-                        + fp_context)
+                        + fp_context + flight_hint)
             if "error" in box:
                 raise box["error"]
             return box["value"]
@@ -221,6 +237,11 @@ def _execute(fn: Callable, *args):
         return fn(*args)
     except Exception as e:
         if elastic and is_comm_failure(e):
+            # Dump before converting: the elastic retry loop is about
+            # to tear the backend down, and this ring holds the calls
+            # leading into the peer failure.
+            _flight.record("error", f"collective execution failed: {e}")
+            _flight.dump("internal_error")
             raise HorovodInternalError(
                 f"collective execution failed: {e}") from e
         raise
@@ -1385,6 +1406,10 @@ def _consistency(desc: str, ps: ProcessSet,
       the rendezvous KV every N calls — asymptotically free, raises
       CollectiveDivergenceError naming the divergent rank and call.
     """
+    # Flight recorder first (observability/flight.py): one ring append
+    # per dispatched collective, reusing the descriptor this choke point
+    # already formatted — the always-on black box the doctor merges.
+    _flight.record_collective(ps.process_set_id, desc, name or "")
     from horovod_tpu.core import consistency as _cc
     from horovod_tpu.analysis import verifier as _vf
     checker = _cc.get()

@@ -236,6 +236,53 @@ def recover_trace(path: str) -> list:
     return data["traceEvents"] if isinstance(data, dict) else data
 
 
+def _main(argv=None) -> int:
+    """CLI: salvage a trace from a killed run without writing Python.
+
+        python -m horovod_tpu.profiler.timeline recover /tmp/tl.json
+        python -m horovod_tpu.profiler.timeline recover tl.json -o out.json
+
+    Repairs the (possibly mid-event-truncated) stream via
+    `recover_trace` and writes strict Chrome-trace JSON — to stdout by
+    default, or atomically to `-o/--output` (which may be the input
+    path itself to repair in place).
+    """
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.profiler.timeline",
+        description="Timeline maintenance commands.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rec = sub.add_parser(
+        "recover",
+        help="repair a truncated trace (SIGKILL'd/crashed run) into "
+             "strict JSON Perfetto/about:tracing accepts")
+    rec.add_argument("file", help="trace file written by HOROVOD_TIMELINE")
+    rec.add_argument("-o", "--output", default="",
+                     help="write the repaired trace here (atomic; "
+                          "default: stdout)")
+    args = p.parse_args(argv)
+    try:
+        events = recover_trace(args.file)
+    except (OSError, ValueError) as e:
+        print(f"timeline recover: cannot repair {args.file}: {e}",
+              file=sys.stderr)
+        return 1
+    doc = {"displayTimeUnit": "ms", "traceEvents": events}
+    if args.output:
+        tmp = f"{args.output}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, args.output)
+        print(f"timeline recover: {len(events)} event(s) -> "
+              f"{args.output}", file=sys.stderr)
+    else:
+        json.dump(doc, sys.stdout)
+        print()
+    return 0
+
+
 def start_jax_trace(log_dir: str) -> None:
     """Bridge to device-side profiling (jax.profiler / XPlane): the TPU
     counterpart of the reference's NVTX ranges (common/nvtx_op_range.cc)."""
@@ -246,3 +293,8 @@ def start_jax_trace(log_dir: str) -> None:
 def stop_jax_trace() -> None:
     import jax
     jax.profiler.stop_trace()
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

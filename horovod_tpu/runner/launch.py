@@ -611,12 +611,23 @@ def launch_static(np: int, host_spec: str, command: List[str],
     finally:
         for w in workers:
             w.terminate()
+        # Persist flight-recorder tails before the KV store vanishes: a
+        # SIGKILL'd worker's last pushed tail only survives in the
+        # launcher's memory (observability/flight.py).
+        from horovod_tpu.observability import flight
+        flight.persist_kv_tails(rdv)
         rdv.stop()
         if nkv is not None:
             nkv.stop()
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         print(f"horovodrun-tpu: workers failed: {bad}", file=sys.stderr)
+        flight_dir = os.environ.get(flight.FLIGHT_DIR_ENV, "")
+        if flight_dir and os.path.isdir(flight_dir):
+            print(f"horovodrun-tpu: flight-recorder dumps are in "
+                  f"{flight_dir}; merge them with `python -m "
+                  f"horovod_tpu.observability.doctor --dir {flight_dir}`",
+                  file=sys.stderr)
         # Report the ORIGINATING failure, not the -SIGTERM of siblings we
         # killed in response: prefer positive exit codes, then non-SIGTERM
         # signal deaths (mapped to 128+signum, the shell convention), then

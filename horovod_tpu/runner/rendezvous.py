@@ -202,6 +202,15 @@ class RendezvousServer:
         with self._handler.lock:
             return self._handler.store.get(f"{scope}/{key}")
 
+    def scope_items(self, scope: str) -> Dict[str, bytes]:
+        """Every key under `scope/` (key suffix -> value). Used by the
+        launcher at job end to persist the flight-recorder tails that
+        SIGKILL'd workers pushed (observability/flight.py)."""
+        pfx = f"{scope}/"
+        with self._handler.lock:
+            return {k[len(pfx):]: v for k, v in self._handler.store.items()
+                    if k.startswith(pfx)}
+
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -267,11 +276,23 @@ class KVClient:
     def _request(self, method: str, path: str, data: Optional[bytes]):
         return self.retry.call(self._request_once, method, path, data)
 
+    @staticmethod
+    def _flight(desc: str) -> None:
+        """KV ops are flight-recorder events (observability/flight.py);
+        the recorder suppresses its own flush traffic."""
+        try:
+            from horovod_tpu.observability import flight
+            flight.record("kv", desc)
+        except Exception:
+            pass
+
     def put(self, scope: str, key: str, value: bytes) -> None:
+        self._flight(f"PUT /{scope}/{key} ({len(value)}B)")
         self._request("PUT", f"/{scope}/{key}", value).read()
 
     def delete(self, scope: str, key: str) -> None:
         import urllib.error
+        self._flight(f"DELETE /{scope}/{key}")
         try:
             self._request("DELETE", f"/{scope}/{key}", None)
         except urllib.error.HTTPError as e:
@@ -290,6 +311,12 @@ class KVClient:
         """
         import time
         import urllib.error
+        if timeout > 0:
+            # Zero-timeout gets are background pollers (the elastic
+            # round watcher, verifier peer probes) ticking at sub-second
+            # cadence — recording those would evict the ring history
+            # that matters. Blocking gets are decisions worth keeping.
+            self._flight(f"GET /{scope}/{key} (timeout={timeout:.0f}s)")
         deadline = time.monotonic() + timeout
         delay = self.POLL_BASE
         while True:

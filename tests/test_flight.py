@@ -1,0 +1,522 @@
+"""Flight recorder + hvddoctor unit suite (ISSUE 5 tentpole).
+
+Covers the ring-buffer semantics, the dump triggers and artifact
+schema, the KV-tail push plumbing, the launcher-side tail persistence,
+and the doctor's cross-rank merge analysis (straggler naming,
+divergence clustering, missing ranks, KV-tail-only merging, Perfetto
+export). The e2e chaos paths live in tests/test_flight_e2e.py
+(`make doctor-smoke`).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.observability import doctor, flight
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture()
+def fresh(monkeypatch, tmp_path):
+    """Isolated recorder: clean env, fresh instance, restored after."""
+    for var in (flight.FLIGHT_ENV, flight.FLIGHT_DIR_ENV,
+                flight.FLIGHT_CAPACITY_ENV, flight.FLIGHT_KV_TAIL_ENV,
+                "HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_ELASTIC_ROUND"):
+        monkeypatch.delenv(var, raising=False)
+    flight.reset_for_tests()
+    yield monkeypatch
+    flight.reset_for_tests()
+
+
+# ------------------------------------------------------------------ ring
+
+def test_ring_wraps_and_counts_drops(fresh):
+    fresh.setenv(flight.FLIGHT_CAPACITY_ENV, "16")
+    rec = flight.get()
+    assert rec.capacity == 16
+    for i in range(40):
+        rec.record("kv", f"ev{i}")
+    events = rec.snapshot()
+    assert len(events) == 16
+    # Oldest retained is #24, newest #39 — strictly ordered.
+    assert [e[0] for e in events] == list(range(24, 40))
+    assert rec.stats()["recorded"] == 40
+    assert rec.stats()["dropped"] == 24
+
+
+def test_collective_events_carry_per_group_call_index(fresh):
+    rec = flight.get()
+    rec.record_collective(0, "allreduce(a)", "t0")
+    rec.record_collective(7, "allreduce(sub)", "s0")
+    rec.record_collective(0, "allreduce(b)", "t1")
+    evs = rec.snapshot()
+    assert [(e[5], e[6]) for e in evs] == [(0, 0), (7, 0), (0, 1)]
+    assert evs[2][3] == "allreduce(b)" and evs[2][4] == "t1"
+    assert [e[7] for e in evs] == [0, 0, 0]  # static job: round 0
+    assert rec.stats()["collective_calls"] == 3
+
+
+def test_set_round_restarts_call_indices_and_maps_ranks(fresh):
+    """Elastic resets reuse rank numbers: per-group call indices restart
+    each round and the recorder tracks which rank it held in each, so
+    the doctor can attribute multi-round dumps correctly."""
+    fresh.setenv("HOROVOD_RANK", "1")
+    rec = flight.get()
+    rec.record_collective(0, "allreduce(a)", "")
+    rec.record_collective(0, "allreduce(b)", "")
+    body1 = rec.payload("tick", stacks=False)   # stamps round 0 -> rank 1
+    assert body1["rounds"] == {"0": 1}
+    fresh.setenv("HOROVOD_RANK", "0")           # reset reassigned us
+    rec.set_round(2, 0)
+    rec.record_collective(0, "allreduce(c)", "")
+    evs = rec.snapshot()
+    assert [(e[6], e[7]) for e in evs] == [(0, 0), (1, 0), (0, 2)]
+    body2 = rec.payload("atexit", stacks=False)
+    assert body2["round"] == 2
+    assert body2["rounds"] == {"0": 1, "2": 0}
+
+
+def test_snapshot_tail_limits(fresh):
+    rec = flight.get()
+    for i in range(10):
+        rec.record("kv", f"ev{i}")
+    assert [e[3] for e in rec.snapshot(tail=3)] == ["ev7", "ev8", "ev9"]
+
+
+def test_disabled_recorder_is_noop_shell(fresh):
+    fresh.setenv(flight.FLIGHT_ENV, "0")
+    flight.reset_for_tests()
+    rec = flight.get()
+    flight.record("kv", "x")
+    flight.record_collective(0, "y", "")
+    assert rec.snapshot() == []
+    assert flight.dump("manual") is None
+    assert flight.dump_hint() == ""
+    assert not flight.push_tail()
+
+
+# ------------------------------------------------------------------ dump
+
+def test_dump_writes_atomic_rank_keyed_file(fresh, tmp_path):
+    d = tmp_path / "flight"
+    fresh.setenv(flight.FLIGHT_DIR_ENV, str(d))
+    fresh.setenv("HOROVOD_RANK", "3")
+    fresh.setenv("HOROVOD_SIZE", "8")
+    fresh.setenv("HOROVOD_ELASTIC_ROUND", "2")
+    flight.record_collective(0, "allreduce(x)", "g")
+    flight.record("stall", "something stalled")
+    path = flight.dump("stall_watchdog")
+    # elastic round 2 -> round-suffixed: rank numbers are reused across
+    # rounds and a later process must not clobber this evidence
+    assert path == str(d / "3.r2.json")
+    body = json.load(open(path))
+    assert body["rank"] == 3 and body["size"] == 8
+    assert body["elastic_round"] == "2"
+    assert body["trigger"] == "stall_watchdog"
+    assert body["version"] == flight.DUMP_VERSION
+    kinds = [e[2] for e in body["events"]]
+    assert kinds == ["collective", "stall"]
+    # a collective event carries (desc, name, group, per-group index)
+    ce = body["events"][0]
+    assert ce[3] == "allreduce(x)" and ce[4] == "g" \
+        and ce[5] == 0 and ce[6] == 0
+    assert any("MainThread" in k for k in body["stacks"])
+    # atomic: no temp litter
+    assert [f for f in os.listdir(d) if ".tmp" in f] == []
+    # the error-message pointer names the dump and the doctor
+    hint = flight.dump_hint()
+    assert str(path) in hint and "observability.doctor" in hint
+
+
+def test_dump_without_dir_still_safe(fresh):
+    flight.record("kv", "x")
+    assert flight.dump("manual", push_kv=False) is None
+    assert flight.dump_hint() == ""
+
+
+# --------------------------------------------------------------- kv tail
+
+class FakeKV:
+    def __init__(self, fail=False):
+        self.puts = []
+        self.fail = fail
+
+    def put(self, scope, key, value):
+        # A recording hook inside the push itself must be suppressed —
+        # this is exactly what the real KVClient instrumentation does.
+        flight.record("kv", f"PUT /{scope}/{key}")
+        if self.fail:
+            raise ConnectionError("kv down")
+        self.puts.append((scope, key, value))
+
+
+def test_push_tail_is_rank_keyed_bounded_and_self_suppressing(fresh):
+    fresh.setenv("HOROVOD_RANK", "1")
+    fresh.setenv(flight.FLIGHT_KV_TAIL_ENV, "5")
+    rec = flight.get()
+    rec._kv = FakeKV()
+    for i in range(20):
+        rec.record_collective(0, f"allreduce({i})", "")
+    before = rec.stats()["recorded"]
+    assert flight.push_tail("tick")
+    assert rec.stats()["recorded"] == before  # push recorded nothing
+    (scope, key, value), = rec._kv.puts
+    # round-keyed: a later round's tail must never clobber this one
+    assert scope == flight.SCOPE and key == "rank-1.r0"
+    body = json.loads(value.decode())
+    assert len(body["events"]) == 5  # tail-bounded
+    assert body["events"][-1][6] == 19
+    assert "stacks" not in body  # compact
+
+
+def test_push_tail_failure_is_swallowed(fresh):
+    fresh.setenv("HOROVOD_RANK", "0")
+    rec = flight.get()
+    rec._kv = FakeKV(fail=True)
+    rec.record("kv", "x")
+    assert not flight.push_tail()
+
+
+def test_push_tail_skipped_when_rank_unknown(fresh):
+    rec = flight.get()
+    rec._kv = FakeKV()
+    rec.record("kv", "x")
+    assert not flight.push_tail()
+    assert rec._kv.puts == []
+
+
+def test_persist_kv_tails_from_rendezvous_server(fresh, tmp_path):
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    rdv = RendezvousServer()
+    rdv.start()  # stop() blocks until serve_forever observes shutdown
+    try:
+        rdv.put(flight.SCOPE, "rank-0.r1", b'{"rank": 0, "events": []}')
+        rdv.put(flight.SCOPE, "rank-1.r1", b'{"rank": 1, "events": []}')
+        rdv.put("metrics", "rank-0", b"not a flight key")
+        out = tmp_path / "fl"
+        written = flight.persist_kv_tails(rdv, str(out))
+        assert sorted(os.path.basename(p) for p in written) == \
+            ["kv-tail-rank-0.r1.json", "kv-tail-rank-1.r1.json"]
+        assert json.load(open(out / "kv-tail-rank-1.r1.json"))["rank"] == 1
+    finally:
+        rdv.stop()
+
+
+def test_persist_kv_tails_noop_without_dir(fresh):
+    class Store:
+        def scope_items(self, scope):  # pragma: no cover - must not run
+            raise AssertionError("should not be queried")
+    assert flight.persist_kv_tails(Store(), "") == []
+
+
+# --------------------------------------------------------------- signals
+
+def test_sigusr1_triggers_dump(fresh, tmp_path):
+    d = tmp_path / "fl"
+    fresh.setenv(flight.FLIGHT_DIR_ENV, str(d))
+    fresh.setenv("HOROVOD_RANK", "0")
+    flight.get().record("kv", "before signal")
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.monotonic() + 5.0
+    path = d / "0.json"
+    while time.monotonic() < deadline and not path.exists():
+        time.sleep(0.05)
+    body = json.load(open(path))
+    assert body["trigger"] == "sigusr1"
+    assert any(e[3] == "before signal" for e in body["events"])
+
+
+# ------------------------------------------------------------- overhead
+
+def test_record_overhead_is_single_append_cheap(fresh):
+    """Loose ceiling on the hot path: 20k collective records in well
+    under a second (the acceptance bar is 'no measurable regression' on
+    a real allreduce, which costs 4-6 orders of magnitude more than one
+    append)."""
+    rec = flight.get()
+    desc = "allreduce(shape=(8, 1024),dtype=float32,op=2,ps=0)"
+    t0 = time.perf_counter()
+    for i in range(20000):
+        rec.record_collective(0, desc, "grad")
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"20k ring appends took {dt:.2f}s"
+
+
+# ---------------------------------------------------------------- doctor
+
+def _mk_dump(d, rank, size, calls, trigger="atexit", extra_events=(),
+             name_fn=lambda i: f"t{i}", desc_fn=None, tail_name=None,
+             round_id=0, host=None, pid=None):
+    """Write a synthetic dump for `rank` with `calls` world collectives."""
+    desc_fn = desc_fn or (
+        lambda i: f"allreduce(shape=({size}, 4),dtype=float32,op=2,ps=0)")
+    t0 = 1_700_000_000.0
+    events = []
+    seq = 0
+    for i in range(calls):
+        events.append([seq, t0 + 0.1 * i, "collective", desc_fn(i),
+                       name_fn(i), 0, i, round_id])
+        seq += 1
+    for kind, desc in extra_events:
+        events.append([seq, t0 + 0.1 * seq, kind, desc])
+        seq += 1
+    body = {"version": flight.DUMP_VERSION, "rank": rank, "size": size,
+            "elastic_round": str(round_id) if round_id else "",
+            "hostname": host or f"h{rank}",
+            "pid": pid if pid is not None else 1000 + rank,
+            "trigger": trigger, "wall_time": t0 + 99,
+            "round": round_id, "rounds": {str(round_id): rank},
+            "recorded": seq, "dropped": 0,
+            "collective_calls": calls, "events": events,
+            "stacks": {"MainThread-1": ["  File \"train.py\", line 10"]}}
+    fname = tail_name or f"{rank}.json"
+    with open(os.path.join(d, fname), "w") as f:
+        json.dump(body, f)
+    return body
+
+
+def test_doctor_names_straggler_and_last_agreed(tmp_path, capsys):
+    d = str(tmp_path)
+    _mk_dump(d, 0, 2, calls=12, trigger="stall_watchdog")
+    _mk_dump(d, 1, 2, calls=7, trigger="atexit")
+    assert doctor.main(["--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "STRAGGLER rank 1" in out
+    assert "5 call(s) behind" in out
+    assert "last collective all ranks agreed on: call #6" in out
+    assert "name=t6" in out
+
+
+def test_doctor_names_first_divergence_clusters(tmp_path, capsys):
+    d = str(tmp_path)
+    _mk_dump(d, 0, 2, calls=8)
+    _mk_dump(d, 1, 2, calls=8, desc_fn=lambda i: (
+        "broadcast(shape=(2, 4),dtype=float32,root=0,ps=0)" if i == 5
+        else "allreduce(shape=(2, 4),dtype=float32,op=2,ps=0)"))
+    assert doctor.main(["--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "FIRST DIVERGENCE at call #5" in out
+    assert "rank(s) [0] issued allreduce" in out
+    assert "rank(s) [1] issued broadcast" in out
+    assert "last collective all ranks agreed on: call #4" in out
+
+
+def test_doctor_reports_missing_ranks(tmp_path, capsys):
+    d = str(tmp_path)
+    _mk_dump(d, 0, 3, calls=4)
+    _mk_dump(d, 1, 3, calls=4)
+    assert doctor.main(["--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "MISSING ranks" in out and "[2]" in out
+
+
+def test_doctor_merges_kv_tail_only_rank(tmp_path, capsys):
+    d = str(tmp_path)
+    _mk_dump(d, 0, 2, calls=9, trigger="stall_watchdog")
+    _mk_dump(d, 1, 2, calls=5, trigger="tick",
+             tail_name="kv-tail-rank-1.json")
+    assert doctor.main(["--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "1 KV-tail-only" in out
+    assert "rank 1 (KV tail" in out
+    assert "STRAGGLER rank 1" in out
+
+
+def test_doctor_prefers_full_dump_over_same_process_tail(tmp_path):
+    d = str(tmp_path)
+    _mk_dump(d, 0, 2, calls=9)
+    _mk_dump(d, 0, 2, calls=3, tail_name="kv-tail-rank-0.json")
+    dumps = doctor.dedupe(doctor.load_dir(d))
+    assert len(dumps) == 1  # same (hostname, pid): one process
+    assert not dumps[0].tail_only
+    assert len(dumps[0].collectives()[(0, 0)]) == 9
+
+
+def test_doctor_attributes_multi_round_dump_to_per_round_ranks(tmp_path,
+                                                               capsys):
+    """The elastic aliasing case: rank numbers are REUSED across rounds.
+    The process that was rank 1 in round 1 becomes rank 0 in round 2
+    after its peer dies; the dead peer's round-1 tail must not be
+    confused with the survivor's round-2 life."""
+    d = str(tmp_path)
+    # Dead rank 0's last KV tail: 5 round-1 calls, then silence.
+    _mk_dump(d, 0, 2, calls=5, trigger="tick", round_id=1,
+             host="h-dead", pid=50,
+             tail_name="kv-tail-rank-0.r1.json")
+    # Survivor: dumped at exit as rank 0 of round 2 — but its body maps
+    # round 1 -> rank 1, and its round-1 events carry round tag 1.
+    body = _mk_dump(d, 0, 1, calls=4, trigger="atexit", round_id=2,
+                    host="h-live", pid=60)
+    body["rounds"] = {"1": 1, "2": 0}
+    t0 = 1_700_000_000.0
+    r1_events = [[100 + i, t0 + 0.1 * i, "collective",
+                  "allreduce(shape=(2, 4),dtype=float32,op=2,ps=0)",
+                  f"t{i}", 0, i, 1] for i in range(7)]
+    body["events"] = r1_events + body["events"]
+    with open(os.path.join(d, "0.json"), "w") as f:
+        json.dump(body, f)
+    assert doctor.main(["--dir", d]) == 0
+    out = capsys.readouterr().out
+    report = doctor.merge(doctor.dedupe(doctor.load_dir(d)))
+    r1 = report["groups"][doctor.group_key(1, doctor.WORLD_GROUP)]
+    # Round 1: dead rank 0 stalled against the survivor (then rank 1).
+    assert r1["members"] == [0, 1]
+    assert r1["stragglers"] == [0]
+    assert r1["last_agreed"]["call"] == 4
+    # Round 2: the survivor alone, now rank 0 — no straggler.
+    r2 = report["groups"][doctor.group_key(2, doctor.WORLD_GROUP)]
+    assert r2["members"] == [0] and r2["stragglers"] == []
+    assert "round 1 · world" in out and "STRAGGLER rank 0" in out
+
+
+def test_doctor_json_and_trace_outputs(tmp_path, capsys):
+    d = str(tmp_path)
+    _mk_dump(d, 0, 2, calls=6)
+    _mk_dump(d, 1, 2, calls=4)
+    trace = tmp_path / "merged.json"
+    assert doctor.main(["--dir", d, "--json", "--trace",
+                        str(trace)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    world = report["groups"][doctor.group_key(0, doctor.WORLD_GROUP)]
+    assert world["stragglers"] == [1]
+    assert world["last_agreed"]["call"] == 3
+    doc = json.load(open(trace))
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {0, 1}
+    assert any(e.get("cat") == "collective" for e in doc["traceEvents"])
+
+
+def test_doctor_exit_2_when_no_dumps(tmp_path, capsys):
+    assert doctor.main(["--dir", str(tmp_path)]) == 2
+    assert "no flight dumps" in capsys.readouterr().err
+
+
+def test_doctor_scrapes_live_kv(tmp_path, capsys, monkeypatch):
+    """--kv host:port reads tails straight off a live rendezvous
+    server (the poke-a-wedged-job path)."""
+    monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    rdv = RendezvousServer()
+    port = rdv.start()
+    try:
+        d = str(tmp_path)
+        b0 = _mk_dump(d, 0, 2, calls=6, trigger="tick")
+        b1 = _mk_dump(d, 1, 2, calls=2, trigger="tick")
+        rdv.put(flight.SCOPE, "rank-0.r0", json.dumps(b0).encode())
+        rdv.put(flight.SCOPE, "rank-1.r0", json.dumps(b1).encode())
+        assert doctor.main(["--kv", f"127.0.0.1:{port}"]) == 0
+        out = capsys.readouterr().out
+        assert "STRAGGLER rank 1" in out
+    finally:
+        rdv.stop()
+
+
+# ----------------------------------------------------- logging satellite
+
+@pytest.fixture()
+def fresh_logger(monkeypatch):
+    from horovod_tpu.common import hvd_logging
+    hvd_logging.reset_for_tests()
+    yield monkeypatch
+    monkeypatch.delenv("HOROVOD_LOG_FORMAT", raising=False)
+    hvd_logging.reset_for_tests()
+
+
+def _format_one(logger, msg):
+    handler = logger.handlers[0]
+    record = logger.makeRecord("horovod_tpu", 30, "f.py", 1, msg, (), None)
+    for flt in handler.filters:
+        flt.filter(record)
+    return handler.format(record)
+
+
+def test_log_format_json_carries_rank_and_round(fresh_logger):
+    fresh_logger.setenv("HOROVOD_LOG_FORMAT", "json")
+    fresh_logger.setenv("HOROVOD_ELASTIC_ROUND", "4")
+    from horovod_tpu.common import hvd_logging
+    logger = hvd_logging.get_logger()
+    obj = json.loads(_format_one(logger, "hello world"))
+    assert obj["msg"] == "hello world"
+    assert obj["level"] == "warning"
+    assert obj["round"] == "4"
+    assert "ts" in obj
+
+
+def test_log_rank_reevaluates_per_record(fresh_logger):
+    """The rank in the prefix must track topology across elastic
+    re-inits — resolved per record, never frozen at first emission."""
+    from horovod_tpu.common import hvd_logging
+    from horovod_tpu.core import topology
+    logger = hvd_logging.get_logger()
+    line1 = _format_one(logger, "before init")
+    assert "rank -" in line1
+    fresh_logger.setattr(topology, "rank_or_none", lambda: 5)
+    line2 = _format_one(logger, "after re-init")
+    assert "rank 5" in line2
+
+
+def test_log_text_format_unchanged_by_default(fresh_logger):
+    from horovod_tpu.common import hvd_logging
+    logger = hvd_logging.get_logger()
+    line = _format_one(logger, "plain")
+    assert "plain" in line and "[WARNING | rank" in line
+
+
+# ---------------------------------------------------- export satellite
+
+def test_exporter_flushes_final_snapshot_at_interpreter_exit(tmp_path):
+    """A job that dies between push intervals and never reaches
+    hvd.shutdown() still leaves a final metrics dump (atexit flush)."""
+    dump = tmp_path / "metrics-{rank}.json"
+    code = (
+        "import os\n"
+        "from horovod_tpu.common.config import Config\n"
+        "from horovod_tpu.observability import export, metrics\n"
+        "export.start_exporter(Config.from_env())\n"
+        "metrics.registry().counter('flight_test_total', 'x').inc(7)\n"
+        "# exit WITHOUT hvd.shutdown(): only atexit can flush this\n"
+    )
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_METRICS": "1",
+        "HOROVOD_METRICS_DUMP": str(dump),
+        # intervals far beyond the process lifetime: the loop cannot
+        # have flushed the post-start counter on its own schedule
+        "HOROVOD_METRICS_DUMP_INTERVAL": "9999",
+        "HOROVOD_METRICS_PUSH_INTERVAL": "9999",
+    })
+    subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                   cwd=REPO, timeout=180)
+    body = json.load(open(str(dump).format(rank=0)))
+    fam = body["families"]["flight_test_total"]
+    assert fam["series"][0]["value"] == 7
+
+
+# --------------------------------------------------- timeline satellite
+
+def test_timeline_recover_cli_repairs_truncated_trace(tmp_path):
+    """`python -m horovod_tpu.profiler.timeline recover` salvages a
+    SIGKILL-truncated trace without writing Python."""
+    trace = tmp_path / "tl.json"
+    trace.write_text(
+        '{"displayTimeUnit":"ms","traceEvents":[\n'
+        '{"ph": "X", "pid": 0, "ts": 1, "dur": 2, "name": "ALLREDUCE"},\n'
+        '{"ph": "X", "pid": 0, "ts": 5, "du')  # cut mid-event
+    out = tmp_path / "fixed.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.profiler.timeline",
+         "recover", str(trace), "-o", str(out)],
+        check=True, env=env, cwd=REPO, timeout=180)
+    doc = json.load(open(out))
+    assert doc["traceEvents"] == [
+        {"ph": "X", "pid": 0, "ts": 1, "dur": 2, "name": "ALLREDUCE"}]

@@ -1,0 +1,142 @@
+"""Flight recorder + hvddoctor end-to-end chaos suite (`make
+doctor-smoke`; ISSUE 5 acceptance).
+
+Real 2-process elastic jobs (the test_elastic_e2e harness) under the
+two failure shapes the recorder exists for:
+
+* an injected **silent staller** (tests/elastic_worker.py `stall` mode,
+  the PR 1 chaos scenario): one worker stops calling collectives
+  without crashing. The survivor's stall watchdog dumps; the doctor
+  must name the stalled rank and the last collective all ranks agreed
+  on.
+* a **hard worker kill** (`crash` mode, os._exit — no atexit, no
+  flush): the dead rank's only record is the compact tail it pushed to
+  the launcher's rendezvous KV, persisted at job end. The doctor must
+  merge the surviving dump with that tail.
+
+Host-order note: discovery hosts are sorted, so `127.0.0.1` (the
+injected-failure host in both jobs) is rank 0 of round 1 and
+`localhost` is rank 1; after recovery the survivor is re-assigned
+rank 0 of round 2 — exactly the rank-reuse aliasing the round-aware
+doctor analysis exists for.
+
+Marked `faults`: minutes of runtime, excluded from tier 1.
+"""
+
+import json
+import os
+
+import pytest
+
+from test_elastic_e2e import finish, start_job, wait_for_step, write_hosts
+
+from horovod_tpu.observability import doctor
+
+
+def _flight_env(flight_dir):
+    return {
+        "HOROVOD_FLIGHT_DIR": str(flight_dir),
+        # Tails must be fresh when a worker dies mid-step: push on a
+        # sub-second cadence instead of the 5s default.
+        "HOROVOD_METRICS_PUSH_INTERVAL": "0.2",
+    }
+
+
+def _run_doctor(flight_dir):
+    dumps = doctor.dedupe(doctor.load_dir(str(flight_dir)))
+    report = doctor.merge(dumps)
+    text = doctor.render(report)
+    return report, text
+
+
+@pytest.mark.faults
+def test_doctor_names_stalled_rank_and_last_agreed_collective(tmp_path):
+    """The ISSUE 5 acceptance bar: a silently-stalled rank must come out
+    of the doctor by name, with the last collective every rank
+    completed."""
+    flight_dir = tmp_path / "flight"
+    env = _flight_env(flight_dir)
+    env.update({
+        "ELASTIC_STALL_HOSTNAME": "127.0.0.1",
+        "ELASTIC_STALL_STEP": "5",
+        "ELASTIC_STALL_EXIT_AFTER": "8",
+        "HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "3",
+    })
+    proc, hosts_file, progress = start_job(tmp_path, "stall",
+                                           extra_env=env)
+    write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    wait_for_step(progress, 6, proc=proc)
+    write_hosts(hosts_file, "localhost:1")
+    out = finish(proc)
+    assert "STALLING host=127.0.0.1 step=5" in out, out
+
+    files = sorted(os.listdir(flight_dir))
+    # The survivor (rank 1 of round 1) dumped at the watchdog raise and
+    # its error message pointed at the dump.
+    assert "1.r1.json" in files, (files, out)
+    survivor_round1 = json.load(open(flight_dir / "1.r1.json"))
+    assert survivor_round1["trigger"] in ("stall_watchdog",
+                                          "internal_error"), survivor_round1
+    # (The watchdog's error message carries a pointer to that dump, but
+    # the elastic retry loop catches and RECOVERS from it here, so the
+    # pointer never reaches the job log — only fatal paths print it.)
+    # The silent staller (rank 0) never dumps — but its periodic KV
+    # tail survived in the launcher and was persisted at job end.
+    assert "kv-tail-rank-0.r1.json" in files, (files, out)
+
+    report, text = _run_doctor(flight_dir)
+    world1 = report["groups"][doctor.group_key(1, doctor.WORLD_GROUP)]
+    # Acceptance: the stalled rank is NAMED...
+    assert world1["members"] == [0, 1], text
+    assert world1["stragglers"] == [0], text
+    assert "STRAGGLER rank 0" in text, text
+    # ...and so is the last collective all ranks completed.
+    assert world1["last_agreed"] is not None, text
+    assert "allreduce" in world1["last_agreed"]["desc"], text
+    assert "last collective all ranks agreed on" in text, text
+    # The survivor's ring kept both the calls and the stall events.
+    kinds = {e[2] for e in survivor_round1["events"]}
+    assert "collective" in kinds and "stall" in kinds, kinds
+
+
+@pytest.mark.faults
+def test_doctor_merges_sigkilled_worker_kv_tail_with_survivor(tmp_path):
+    """A worker that dies via os._exit leaves no local dump — only the
+    tail it last pushed to the launcher's KV, which the launcher
+    persists at job end. The doctor must merge it with the survivor's
+    dump into one report."""
+    flight_dir = tmp_path / "flight"
+    env = _flight_env(flight_dir)
+    env.update({
+        "ELASTIC_CRASH_HOSTNAME": "127.0.0.1",
+        "ELASTIC_CRASH_STEP": "5",
+        # Give the dying worker a couple of push intervals per step.
+        "ELASTIC_STEP_SLEEP": "0.5",
+    })
+    proc, hosts_file, progress = start_job(tmp_path, "crash",
+                                           extra_env=env)
+    write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    wait_for_step(progress, 6, proc=proc)
+    write_hosts(hosts_file, "localhost:1")
+    out = finish(proc)
+    assert "CRASHING host=127.0.0.1 step=5" in out, out
+
+    files = sorted(os.listdir(flight_dir))
+    # Survivor's dump(s) + the killed rank 0's persisted round-1 tail.
+    assert "0.r2.json" in files, (files, out)
+    assert "kv-tail-rank-0.r1.json" in files, (files, out)
+
+    report, text = _run_doctor(flight_dir)
+    # The killed rank appears as a KV-tail-only process, merged with
+    # the survivor into one round-1 world analysis.
+    tails = [info for info in report["per_rank"].values()
+             if info["tail_only"]]
+    assert any(i["rank"] == 0 and i["round"] == 1 for i in tails), text
+    assert "(KV tail" in text, text
+    world1 = report["groups"][doctor.group_key(1, doctor.WORLD_GROUP)]
+    assert world1["members"] == [0, 1], text
+    assert world1["last_agreed"] is not None, text
+    assert world1["stragglers"] == [0], text
+    tail0 = json.load(open(flight_dir / "kv-tail-rank-0.r1.json"))
+    assert any(e[2] == "collective" for e in tail0["events"]), tail0
