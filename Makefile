@@ -31,9 +31,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline metrics race doctor-smoke
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline metrics race doctor-smoke fusion-smoke
 
-test: lint test-unit test-multiprocess test-e2e chaos doctor-smoke entry
+test: lint test-unit test-multiprocess test-e2e chaos doctor-smoke fusion-smoke entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -63,6 +63,13 @@ metrics:
 doctor-smoke:
 	$(PYTEST) tests/test_flight.py
 	$(PYTEST) tests/test_flight_e2e.py --run-faults -m faults
+
+# Fusion-cliff guard (docs/perf.md): interleaved threshold sweep on the
+# 8-rank virtual mesh asserting no >1.5x latency cliff between adjacent
+# bucket sizes (the r05 16-64MB regression the bucket cap + oversize
+# chunking fixed). Wall-clock — excluded from tier-1 via the perf marker.
+fusion-smoke:
+	$(PYTEST) tests/test_fusion_smoke.py --run-perf -m perf
 
 lint:
 	$(PYTHON) -m horovod_tpu.analysis horovod_tpu/ examples/ \

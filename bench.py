@@ -554,15 +554,17 @@ def _eager_cpu_mesh_child():
               "workload": f"grouped_allreduce of {len(_EAGER_SIZES)} "
                           f"tensors, {nbytes / 2**20:.1f} MB total"}
 
-    def measure(calls=4, reps=3):
+    def measure(calls=4, reps=3, fn=None):
         """Median-of-reps mean per-call ms. No tunnel here, so no slope
         gymnastics — a plain mean over pipelined calls with one sync is
         the true cost; the median across reps rejects host-load spikes."""
+        fn = fn or hvd.grouped_allreduce
+
         def one():
             outs = None
             t0 = time.perf_counter()
             for _ in range(calls):
-                outs = hvd.grouped_allreduce(tensors, op="sum")
+                outs = fn(tensors, op="sum")
             jax.block_until_ready(outs)
             return (time.perf_counter() - t0) / calls * 1e3
 
@@ -576,32 +578,55 @@ def _eager_cpu_mesh_child():
     # ~27% point drift from slow host-load variation between the runs;
     # interleaving the passes (1,4,16,64, 1,4,16,64, ...) exposes every
     # threshold to the same load profile, and each run's number is the
-    # median of its passes. ---
+    # median of its passes. Each pass measures BOTH dispatch paths:
+    # "grouped" (one XLA program for the whole set, buckets chunked to
+    # the cap — the cliff fix) and "overlapped" (bucketed_allreduce: one
+    # program per bucket, dispatched without blocking so transfers
+    # pipeline). r05's 16/64MB points were ~465-490ms vs ~230-250ms at
+    # 1-4MB; the cap + chunking must hold max_adjacent_ratio <= 1.5. ---
     thresholds = (1, 4, 16, 64)
     passes = 6
     samples = {mb: [] for mb in thresholds}
+    osamples = {mb: [] for mb in thresholds}
     for _ in range(passes):
         for mb in thresholds:
             cfg.fusion_threshold_bytes = mb * 1024 * 1024
             clear_compiled_cache()
             samples[mb].append(measure(reps=1))
+            osamples[mb].append(
+                measure(reps=1, fn=hvd.bucketed_allreduce))
     med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
     sweep = {
         "run1": {f"{mb}MB_ms": round(med(samples[mb][0::2]), 2)
                  for mb in thresholds},
         "run2": {f"{mb}MB_ms": round(med(samples[mb][1::2]), 2)
                  for mb in thresholds},
+        "overlapped": {
+            "run1": {f"{mb}MB_ms": round(med(osamples[mb][0::2]), 2)
+                     for mb in thresholds},
+            "run2": {f"{mb}MB_ms": round(med(osamples[mb][1::2]), 2)
+                     for mb in thresholds},
+        },
     }
     drift = max(abs(sweep["run1"][k] - sweep["run2"][k])
                 / max(sweep["run1"][k], 1e-9)
                 for k in sweep["run1"])
     sweep["max_run_to_run_drift_pct"] = round(drift * 100, 1)
-    from horovod_tpu.ops.fusion import plan_buckets
+    meds = [med(samples[mb]) for mb in thresholds]
+    sweep["max_adjacent_ratio"] = round(
+        max(max(a, b) / max(min(a, b), 1e-9)
+            for a, b in zip(meds, meds[1:])), 3)
+    from horovod_tpu.ops.fusion import effective_threshold, plan_buckets
+    sweep["bucket_cap_mb"] = cfg.bucket_cap_bytes / 2**20
+    # Buckets of the program each swept point actually compiles: the
+    # cap chunks 16/64MB requests down to the sweet spot.
     sweep["buckets_per_program"] = {
-        f"{mb}MB": len(plan_buckets([(s, "float32") for s in _EAGER_SIZES],
-                                    mb * 1024 * 1024))
+        f"{mb}MB": len(plan_buckets(
+            [(s, "float32") for s in _EAGER_SIZES],
+            effective_threshold(mb * 1024 * 1024, cfg.bucket_cap_bytes)))
         for mb in (1, 4, 16, 64)}
     result["fusion_sweep"] = sweep
+    result["lm_overlap"] = _lm_overlap_section(cfg)
 
     # --- autotune: start from the reference's own 64 MB default
     # (docs/tensor-fusion.rst), which the sweep above shows is WRONG for
@@ -609,7 +634,11 @@ def _eager_cpu_mesh_child():
     # small buckets — threshold sensitivity is exactly why the reference
     # ships an autotuner). The GP must discover the small-bucket region;
     # the playoff freeze then re-measures its argmax against the 64 MB
-    # start back-to-back and keeps the true winner. ---
+    # start back-to-back and keeps the true winner. The bucket cap is
+    # lifted for this section: it would silently clamp every >4MB sample
+    # to the sweet spot and flatten the very landscape the GP tunes over.
+    saved_cap = cfg.bucket_cap_bytes
+    cfg.bucket_cap_bytes = 0
     cfg.fusion_threshold_bytes = 64 * 1024 * 1024
     cfg.autotune_warmup_samples = 1
     cfg.autotune_steps_per_sample = 2
@@ -645,8 +674,82 @@ def _eager_cpu_mesh_child():
         "default_ms": round(default_ms, 2),
         "tuned_speedup_vs_default": round(default_ms / tuned_ms, 3),
         "playoff": pm.playoff_result,
+        "bucket_cap": "lifted for this section (would clamp the GP's "
+                      ">4MB samples)",
     }
+    cfg.bucket_cap_bytes = saved_cap
     print(json.dumps(result), flush=True)
+
+
+def _lm_overlap_section(cfg):
+    """Backward-overlapped bucketed reduction vs one giant fused psum on
+    the framework's OWN DP train step (optim.build_train_step →
+    reduce_gradients_in_jit), with a transformer-LM-shaped parameter set:
+    a tied 8 MB embedding (oversize → chunked across buckets) plus 6
+    residual FFN blocks. The giant-fused variant is exactly the pre-PR-6
+    program shape (one psum after the whole backward); the bucketed
+    variant chunks to the cap in reverse production order so XLA can run
+    bucket collectives while earlier layers still differentiate."""
+    import optax
+
+    from horovod_tpu.optim.optimizer import build_train_step
+
+    rng = np.random.default_rng(1)
+    D, F, V, NL = 256, 1024, 8192, 6
+    params = {"emb": jnp.asarray(
+        rng.standard_normal((V, D)) * 0.02, jnp.float32)}
+    for i in range(NL):
+        params[f"wi{i}"] = jnp.asarray(
+            rng.standard_normal((D, F)) * 0.02, jnp.float32)
+        params[f"wo{i}"] = jnp.asarray(
+            rng.standard_normal((F, D)) * 0.02, jnp.float32)
+
+    def loss_fn(p, batch):
+        tok, tgt = batch
+        h = p["emb"][tok]  # (B, S, D)
+        for i in range(NL):
+            h = h + jnp.tanh(h @ p[f"wi{i}"]) @ p[f"wo{i}"]
+        logits = h @ p["emb"].T  # tied unembedding
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], -1))
+
+    B, S = 16, 64
+    tok = jnp.asarray(rng.integers(0, V, (B, S)))
+    tgt = jnp.roll(tok, -1, axis=1)
+    opt = optax.sgd(0.01)
+    mb = 1024 * 1024
+
+    out = {}
+    variants = {"fused": (1 << 30, 0, False),
+                "bucketed": (4 * mb, 4 * mb, True)}
+    for label, (thresh, cap, rev) in variants.items():
+        cfg.fusion_threshold_bytes = thresh
+        cfg.bucket_cap_bytes = cap
+        cfg.bucket_reverse = rev
+        # donate=False: state is reused across timing reps below
+        step = build_train_step(loss_fn, opt, donate=False)
+        p = jax.tree_util.tree_map(jnp.copy, params)
+        o = opt.init(p)
+        for _ in range(3):
+            p, o, l = step(p, o, (tok, tgt))
+        jax.block_until_ready(l)
+
+        def run(n=6):
+            p2, o2 = p, o
+            t0 = time.perf_counter()
+            for _ in range(n):
+                p2, o2, l2 = step(p2, o2, (tok, tgt))
+            jax.block_until_ready(l2)
+            return (time.perf_counter() - t0) / n * 1e3
+
+        xs = sorted(run() for _ in range(3))
+        out[f"{label}_step_ms"] = round(xs[1], 2)
+    out["speedup_bucketed_vs_fused"] = round(
+        out["fused_step_ms"] / out["bucketed_step_ms"], 3)
+    out["config"] = (f"tied-emb LM shape V{V} D{D} F{F} L{NL} B{B} S{S} "
+                     f"f32 (~{(V * D + 2 * NL * D * F) * 4 / 2**20:.0f}MB "
+                     f"grads), 8-dev mesh")
+    return out
 
 
 def bench_eager_cpu_mesh(timeout=1500):
@@ -693,22 +796,39 @@ def _is_deterministic(e):
 
 def _section(name, fn, *args, retries=1, **kwargs):
     """Run one bench section, isolated: any failure is recorded in
-    _SECTION_ERRORS instead of killing the whole run, with one retry for
-    transient runtime errors (the r02 bench died on a single
-    'remote_compile: response body closed' tunnel hiccup and emitted
-    nothing — never again)."""
-    last = None
-    for attempt in range(retries + 1):
-        try:
-            return fn(*args, **kwargs)
-        except Exception as e:
-            last = e
-            print(f"[bench] section {name!r} attempt {attempt + 1} failed: "
-                  f"{_err_str(e)}", flush=True)
-            if _is_deterministic(e):
-                break
-            if attempt < retries:
-                time.sleep(2.0)  # let a wedged tunnel/device settle
+    _SECTION_ERRORS instead of killing the whole run (the r02 bench died
+    on a single 'remote_compile: response body closed' tunnel hiccup and
+    emitted nothing — never again).
+
+    Retries ride the resilience layer's RetryPolicy (PR 1,
+    common/resilience.py): jittered backoff between attempts, a per-
+    section deadline so a wedged tunnel can't eat the whole bench budget,
+    and HOROVOD_BENCH_RETRY_* env overrides. Deterministic failures
+    (OOM) are not retryable — re-running a 30-step bench into the same
+    wall wastes wall-clock.
+    """
+    import dataclasses
+
+    from horovod_tpu.common.resilience import RetryError, RetryPolicy
+
+    policy = dataclasses.replace(
+        RetryPolicy.from_env(
+            "HOROVOD_BENCH_RETRY", base_delay=2.0, max_delay=10.0,
+            jitter=0.25, deadline=600.0, name="bench_section"),
+        max_attempts=retries + 1,
+        retryable=lambda e: not _is_deterministic(e))
+
+    def on_retry(attempt, exc, delay):
+        print(f"[bench] section {name!r} attempt {attempt} failed: "
+              f"{_err_str(exc)}; retrying in {delay:.1f}s", flush=True)
+
+    try:
+        return policy.call(fn, *args, on_retry=on_retry, **kwargs)
+    except RetryError as e:
+        last = e.__cause__ or e
+    except Exception as e:
+        last = e
+    print(f"[bench] section {name!r} failed: {_err_str(last)}", flush=True)
     _SECTION_ERRORS[name] = _err_str(last)
     return None
 
@@ -873,6 +993,9 @@ def main():
     eager = _section("eager_cpu_mesh", bench_eager_cpu_mesh)
     fusion = eager.get("fusion_sweep") if eager else None
     autotune = eager.get("autotune") if eager else None
+    lm_overlap = eager.get("lm_overlap") if eager else None
+    if lm_overlap is not None:
+        lm_overlap["platform"] = eager["platform"]
     if fusion is not None:
         fusion["platform"] = eager["platform"]
         fusion["workload"] = eager["workload"]
@@ -904,6 +1027,7 @@ def main():
             "transformer_lm": tr,
             "bert_base_finetune": bert,
             "fusion_sweep_grouped_allreduce": fusion,
+            "lm_overlap_train_step": lm_overlap,
             "autotune": autotune,
             "flash_attention_s8192": flash,
             "section_errors": _SECTION_ERRORS or None,

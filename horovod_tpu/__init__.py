@@ -35,9 +35,11 @@ from horovod_tpu.core.process_sets import (  # noqa: F401
 )
 from horovod_tpu.ops.collectives import (  # noqa: F401
     allgather, allgather_async, allreduce, allreduce_async, alltoall,
-    alltoall_async, barrier, broadcast, broadcast_async, grouped_allgather,
-    grouped_allreduce, grouped_allreduce_async, grouped_reducescatter, poll,
-    reducescatter, reducescatter_async, synchronize,
+    alltoall_async, barrier, broadcast, broadcast_async,
+    bucketed_allreduce, bucketed_allreduce_async, bucket_overlap_stats,
+    grouped_allgather, grouped_allreduce, grouped_allreduce_async,
+    grouped_reducescatter, poll, reducescatter, reducescatter_async,
+    synchronize,
 )
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 from horovod_tpu.optim.optimizer import (  # noqa: F401

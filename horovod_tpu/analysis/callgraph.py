@@ -63,10 +63,12 @@ RANK_CALL_NAMES: Set[str] = {
 #: high-level wrappers that submit collectives on the caller's behalf
 #: (optim/functions.py).
 COLLECTIVE_NAMES: Set[str] = {
-    "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
+    "allreduce", "grouped_allreduce", "bucketed_allreduce", "allgather",
+    "grouped_allgather",
     "broadcast", "reducescatter", "grouped_reducescatter", "alltoall",
     "barrier",
-    "allreduce_async", "grouped_allreduce_async", "allgather_async",
+    "allreduce_async", "grouped_allreduce_async", "bucketed_allreduce_async",
+    "allgather_async",
     "broadcast_async", "alltoall_async", "reducescatter_async",
     "broadcast_object", "broadcast_parameters", "broadcast_variables",
     "broadcast_optimizer_state", "allgather_object",
@@ -79,6 +81,7 @@ COLLECTIVE_NAMES: Set[str] = {
 #: internally and barrier takes no name.
 NAME_ARG_POS: Dict[str, Tuple[int, ...]] = {
     "allreduce": (2,), "grouped_allreduce": (2,),
+    "bucketed_allreduce": (2,), "bucketed_allreduce_async": (2,),
     "allgather": (1,), "grouped_allgather": (1,),
     "broadcast": (2,), "reducescatter": (2,),
     "grouped_reducescatter": (2,), "alltoall": (2,),

@@ -181,9 +181,18 @@ class _BoolKnob(_Knob):
 
 
 def default_knobs(cfg=None) -> List[_Knob]:
+    # The GP explores the threshold only where it changes compiled
+    # programs: call sites apply effective_threshold = min(threshold,
+    # bucket_cap), so samples above the cap would all execute the
+    # IDENTICAL program — a flat plateau that degenerates the EI search
+    # and makes the "tuned" choice noise. Clamp the search ceiling to
+    # the cap (benchmarks that want the full range lift the cap first).
+    hi = 256 * _MB
+    if cfg is not None and getattr(cfg, "bucket_cap_bytes", 0) > 0:
+        hi = min(hi, max(int(cfg.bucket_cap_bytes), 2 * _MB))
     knobs: List[_Knob] = [
         _Log2Knob("fusion_threshold", "fusion_threshold_bytes",
-                  1 * _MB, 256 * _MB),
+                  1 * _MB, hi),
     ]
     # The hierarchical flag only does anything when an ici x dcn mesh is
     # configured (_hier_usable, ops/collectives.py:360) — on a flat
@@ -439,3 +448,168 @@ class ParameterManager:
         """The currently-applied knob values (the frozen best once
         `frozen` is True)."""
         return {k.name: k.get(self.cfg) for k in self.knobs}
+
+
+# --------------------------------------------------------------------------
+# Online bucket-size tuner (HOROVOD_BUCKET_AUTOTUNE; docs/perf.md)
+# --------------------------------------------------------------------------
+
+class OnlineBucketTuner:
+    """Move `fusion_threshold_bytes` to the measured per-bucket sweet spot,
+    online, with recompile-storm guards.
+
+    Where `ParameterManager` runs a general GP search over several knobs,
+    this tuner answers ONE question from data the bucket pipeline already
+    produces: which bucket SIZE moves the most bytes per second? It
+    consumes the per-bucket (wire bytes, wall seconds) samples behind the
+    `horovod_bucket_bytes`/`horovod_bucket_seconds` histograms
+    (ops/collectives.bucketed_allreduce profiling), folds them into log2
+    size classes, and periodically re-points the fusion threshold at the
+    best class's upper bound.
+
+    Every guard below exists to bound recompiles or prevent a rank split:
+
+    * proposals are quantized to powers of two within
+      [256 KB, HOROVOD_BUCKET_CAP (or 64 MB)] — a small finite set of
+      distinct thresholds (hence distinct compiled programs) per job;
+    * at most `bucket_autotune_max_adjustments` changes are ever applied,
+      then the tuner freezes; it also freezes after two consecutive
+      no-change decisions or after `max_windows` decision windows;
+    * a class needs `_MIN_SAMPLES` samples to be trusted, and the winner
+      must beat the current class by `_HYSTERESIS` to dethrone it;
+    * multi-process: rank 0 decides and broadcasts, every rank applies
+      the SAME value at the SAME step — decision windows are counted in
+      `update()` calls (one per optimizer step on every rank), so the
+      broadcast itself is a consistent collective. If thresholds ever
+      diverged anyway, the next dispatch descriptor (which embeds the
+      threshold + plan fingerprint) would differ across ranks and the
+      consistency checker / fingerprint verifier names the split instead
+      of the mismatched programs deadlocking.
+
+    No compiled-cache clear on a change: bucket cache keys include the
+    plan layout, so a new threshold misses and re-traces while the old
+    executables stay warm (and get LRU-evicted).
+    """
+
+    _MIN_T = 256 * 1024
+    _MIN_SAMPLES = 8
+    _HYSTERESIS = 0.10
+
+    def __init__(self, config):
+        self.cfg = config
+        self.enabled = bool(config.bucket_autotune)
+        self.interval = max(int(config.bucket_autotune_interval), 1)
+        self.max_adjustments = max(
+            int(config.bucket_autotune_max_adjustments), 0)
+        cap = config.bucket_cap_bytes if config.bucket_cap_bytes > 0 \
+            else 64 * _MB
+        self._max_t = max(int(cap), self._MIN_T)
+        self._classes: dict = {}  # log2(nbytes) -> [bytes, secs, count]
+        self._calls = 0
+        self._windows = 0
+        self.max_windows = 2 * self.max_adjustments + 4
+        self.adjustments = 0
+        self._no_change = 0
+        self._frozen = not self.enabled
+        self.history: List[int] = []
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    def record_bucket(self, nbytes: float, seconds: float) -> None:
+        """One profiled bucket's wire payload and wall time."""
+        if self._frozen or seconds <= 0 or nbytes <= 0:
+            return
+        c = int(math.log2(max(nbytes, 1)))
+        acc = self._classes.setdefault(c, [0.0, 0.0, 0])
+        acc[0] += nbytes
+        acc[1] += seconds
+        acc[2] += 1
+
+    def _rates(self) -> dict:
+        return {c: acc[0] / acc[1] for c, acc in self._classes.items()
+                if acc[2] >= self._MIN_SAMPLES and acc[1] > 0}
+
+    def _decide(self):
+        """Rank-0 decision: (new_threshold | None, freeze)."""
+        if self.adjustments >= self.max_adjustments \
+                or self._windows > self.max_windows:
+            return None, True
+        rates = self._rates()
+        if not rates:
+            return None, False
+        best_c = max(rates, key=lambda c: rates[c])
+        proposal = min(max(2 ** (best_c + 1), self._MIN_T), self._max_t)
+        eff = max(min(self.cfg.fusion_threshold_bytes, self._max_t),
+                  self._MIN_T)
+        # Buckets produced under threshold t fill to just under t, i.e.
+        # class floor(log2(t-1)) — NOT floor(log2(t))-1, which misses the
+        # incumbent for every non-power-of-two threshold and would skip
+        # the hysteresis guard entirely (re-pointing on the first trusted
+        # window regardless of merit).
+        cur_c = int(math.log2(max(eff - 1, 1)))
+        cur_rate = rates.get(cur_c, 0.0)
+        if best_c == cur_c or proposal == eff or \
+                (cur_rate > 0 and rates[best_c] <
+                 cur_rate * (1.0 + self._HYSTERESIS)):
+            self._no_change += 1
+            return None, self._no_change >= 2
+        self._no_change = 0
+        return proposal, self.adjustments + 1 >= self.max_adjustments
+
+    def update(self) -> bool:
+        """Advance the tuner; call once per optimizer step on EVERY rank.
+        Returns True when the threshold changed this step."""
+        if self._frozen:
+            return False
+        self._calls += 1
+        if self._calls % self.interval:
+            return False
+        self._windows += 1
+        import jax
+
+        if jax.process_count() > 1:
+            from horovod_tpu.core import topology
+            from horovod_tpu.optim.functions import broadcast_object
+            decision = self._decide() if topology.rank() == 0 else None
+            new_t, freeze = broadcast_object(decision, root_rank=0,
+                                             name="bucket_tuner_decision")
+        else:
+            new_t, freeze = self._decide()
+        changed = False
+        if new_t is not None and \
+                int(new_t) != int(self.cfg.fusion_threshold_bytes):
+            self.cfg.fusion_threshold_bytes = int(new_t)
+            self.adjustments += 1
+            self.history.append(int(new_t))
+            changed = True
+        if freeze:
+            self._frozen = True
+        self._observe(changed)
+        return changed
+
+    def _observe(self, changed: bool) -> None:
+        try:
+            from horovod_tpu.observability import metrics as m
+            reg = m.registry()
+            if reg.enabled:
+                reg.gauge("horovod_bucket_autotune_threshold_bytes",
+                          "Fusion threshold currently applied by the "
+                          "online bucket tuner").set(
+                              float(self.cfg.fusion_threshold_bytes))
+                reg.gauge("horovod_bucket_autotune_adjustments",
+                          "Threshold changes applied by the online "
+                          "bucket tuner").set(float(self.adjustments))
+                reg.gauge("horovod_bucket_autotune_frozen",
+                          "1 once the online bucket tuner froze").set(
+                              1.0 if self._frozen else 0.0)
+            if changed:
+                from horovod_tpu.observability import flight
+                flight.record(
+                    "autotune", f"bucket tuner moved fusion threshold to "
+                    f"{self.cfg.fusion_threshold_bytes} bytes "
+                    f"(adjustment {self.adjustments}/"
+                    f"{self.max_adjustments})")
+        except Exception:
+            pass  # telemetry must never break the tuner

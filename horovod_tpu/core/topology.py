@@ -65,6 +65,7 @@ class _GlobalState:
         self.process_set_table = None
         self.timeline = None
         self.parameter_manager = None
+        self.bucket_tuner = None
         self.stall_inspector = None
         self.joined = False  # guarded-by: lock
 
@@ -446,6 +447,11 @@ def init(process_sets: Optional[Sequence] = None,
         if cfg.autotune:
             from horovod_tpu.core.autotune import ParameterManager
             _state.parameter_manager = ParameterManager(cfg)
+        elif cfg.bucket_autotune:
+            # Mutually exclusive with the GP tuner: both mutate
+            # fusion_threshold_bytes and would fight over it.
+            from horovod_tpu.core.autotune import OnlineBucketTuner
+            _state.bucket_tuner = OnlineBucketTuner(cfg)
         if not cfg.stall_check_disable:
             try:
                 from horovod_tpu import native as native_mod

@@ -353,6 +353,15 @@ def merge(dumps: List[RankDump], tail: int = 8) -> Dict[str, Any]:
             "last_event": d.last_event(),
             "tail": d.tail(tail),
         }
+        # Bucket-scheduler evidence (ops/collectives.bucketed_allreduce):
+        # profiled buckets that ran far past their call's median are
+        # recorded as SLOW `bucket` events — surface the most recent so a
+        # perf postmortem names the slow bucket, not just the slow step.
+        slow = [ev for ev in d.events
+                if len(ev) >= 4 and ev[2] == "bucket"
+                and str(ev[3]).startswith("SLOW")]
+        if slow:
+            info["slow_buckets"] = slow[-3:]
         if (set(d.ranks_seen()) & straggler_set) \
                 or d.trigger not in ("atexit", "tick"):
             # The interesting processes keep their stacks in the report.
@@ -435,6 +444,8 @@ def render(report: Dict[str, Any], tail: int = 8) -> str:
         last = info["last_event"]
         if last:
             add(f"  last event: {_fmt_event(last)}")
+        for ev in info.get("slow_buckets", []):
+            add(f"  SLOW BUCKET: {_fmt_event(ev)}")
         for ev in info["tail"][-tail:]:
             add(f"    {_fmt_event(ev)}")
         stacks = info.get("stacks") or {}

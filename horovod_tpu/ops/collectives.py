@@ -748,15 +748,16 @@ def grouped_allreduce(tensors: Sequence[Any],
             outs = _execute(fn, *[jnp.asarray(t) for t in tensors])
         return list(outs)
     gs, stackeds = _lift_group(tensors, ps)
+    from horovod_tpu.ops import fusion
+    eff_thresh = fusion.effective_threshold(cfg.fusion_threshold_bytes,
+                                            cfg.bucket_cap_bytes)
     key = ("gar", tuple((g.shape, str(g.dtype)) for g in gs), int(rop),
            ps.cache_token, float(prescale_factor), float(postscale_factor),
-           cfg.fusion_threshold_bytes, cfg.disable_group_fusion,
+           eff_thresh, cfg.bucket_reverse, cfg.disable_group_fusion,
            hm is not None,
            bool(cfg.adasum_halving) and rop == T.ReduceOp.ADASUM)
 
     def build() -> Callable:
-        from horovod_tpu.ops import fusion
-
         mesh_ = hm if hm is not None else ps.mesh
         spec = _HIER_SPEC if hm is not None else P(_AXIS)
         if hm is not None:
@@ -771,7 +772,8 @@ def grouped_allreduce(tensors: Sequence[Any],
             if cfg.disable_group_fusion or rop in (T.ReduceOp.ADASUM,):
                 return tuple(reduce_one(b) for b in blocks)
             return fusion.fused_reduce_blocks(
-                blocks, reduce_one, cfg.fusion_threshold_bytes)
+                blocks, reduce_one, eff_thresh,
+                reverse=cfg.bucket_reverse)
 
         fn = jax.shard_map(body, mesh=mesh_,
                            in_specs=(spec,) * len(gs),
@@ -788,6 +790,250 @@ def grouped_allreduce(tensors: Sequence[Any],
                      arrays=tuple(gs), ntensors=len(gs)):
         outs = _execute(fn, *gs)
     return [_from_global(o, s) for o, s in zip(outs, stackeds)]
+
+
+# --------------------------------------------------------------------------
+# Bucketed, pipelined allreduce (the backward-overlap path; docs/perf.md)
+# --------------------------------------------------------------------------
+
+class _BucketStats:
+    """Cross-thread bucket-scheduler accounting (dispatch counters + the
+    last measured overlap fraction, read by metrics/tests)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.dispatched = 0  # guarded-by: _lock
+        self.profiled_calls = 0  # guarded-by: _lock
+        self.last_overlap: float = 0.0  # guarded-by: _lock
+
+    def record(self, n_buckets: int, overlap) -> None:
+        with self._lock:
+            self.dispatched += n_buckets
+            if overlap is not None:
+                self.profiled_calls += 1
+                self.last_overlap = float(overlap)
+
+    def snapshot(self) -> Tuple[int, int, float]:
+        with self._lock:
+            return self.dispatched, self.profiled_calls, self.last_overlap
+
+
+_bucket_stats = _BucketStats()
+# Per-thread (nbytes, seconds) samples of the most recent PROFILED call —
+# thread-local on purpose: concurrent callers must not splice each other's
+# timing vectors, and the consumer (the optimizer's tuner hook) reads it
+# on the same thread right after its own call returns.
+_bucket_tls = threading.local()
+
+
+def last_bucket_timings() -> List[Tuple[int, float]]:
+    """(global_payload_bytes, seconds) per bucket of this thread's most
+    recent profiled `bucketed_allreduce` (empty if that call ran fully
+    async). Feeds the online bucket tuner (core/autotune.py)."""
+    return list(getattr(_bucket_tls, "timings", ()))
+
+
+def bucket_overlap_stats() -> Tuple[int, int, float]:
+    """(buckets_dispatched, profiled_calls, last_overlap_fraction)."""
+    return _bucket_stats.snapshot()
+
+
+def bucketed_allreduce(tensors: Sequence[Any],
+                       average: Optional[bool] = None,
+                       name: Optional[str] = None,
+                       op: Any = None,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0,
+                       process_set: Optional[ProcessSet] = None,
+                       profile: Optional[bool] = None) -> List[jax.Array]:
+    """Reduce a group of tensors as independently dispatched fusion buckets.
+
+    Where `grouped_allreduce` compiles the whole group into ONE XLA
+    program (every bucket's psum fenced by the same program boundary),
+    this path compiles one program PER bucket and dispatches them
+    back-to-back without blocking: JAX's async dispatch keeps several
+    buckets' ICI transfers in flight concurrently — the role of the
+    reference's background thread draining the fusion buffer
+    (operations.cc RunLoopOnce), and the eager counterpart of the
+    in-jit overlap `reduce_gradients_in_jit` gets from the XLA scheduler.
+    Oversize tensors are chunked across buckets (ops/fusion.py) and
+    reassembled here.
+
+    `profile=True` (or HOROVOD_BUCKET_PROFILE=1) forces completion of
+    each bucket and records per-bucket wall times plus an
+    `overlap_fraction` estimate (1 - wall_window / sum_of_bucket_spans,
+    i.e. the fraction of in-flight time shared with another bucket) —
+    the samples the online bucket tuner and the
+    `horovod_overlap_fraction` gauge consume.
+
+    Falls back to `grouped_allreduce` where per-bucket dispatch cannot
+    help: single tensor, Adasum (never fused), hierarchical meshes,
+    HOROVOD_DISABLE_GROUP_FUSION, HOROVOD_BUCKET_PIPELINE=0, or the
+    replicated fast path.
+    """
+    ps = _resolve_ps(process_set)
+    rop = _normalize_op(average, op)
+    if not tensors:
+        return []
+    cfg = topology.state().config
+    hm = _hier_usable(ps) if (cfg.hierarchical_allreduce
+                              and rop in (T.ReduceOp.SUM,
+                                          T.ReduceOp.AVERAGE)) else None
+    if (len(tensors) == 1 or rop == T.ReduceOp.ADASUM
+            or cfg.disable_group_fusion or hm is not None
+            or not cfg.bucket_pipeline
+            or _replicated_fast_ok(ps, rop, hm, tensors)):
+        _bucket_tls.timings = ()
+        return grouped_allreduce(
+            tensors, name=name, op=rop, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=ps)
+    from horovod_tpu.ops import fusion
+
+    k = ps.size()
+    gs, stackeds = _lift_group(tensors, ps)
+    eff = fusion.effective_threshold(cfg.fusion_threshold_bytes,
+                                     cfg.bucket_cap_bytes)
+    metas = [(tuple(g.shape[1:]), str(g.dtype)) for g in gs]
+    plan = fusion.plan_buckets(metas, eff, reverse=cfg.bucket_reverse)
+    # The descriptor embeds the effective threshold AND the plan
+    # fingerprint: ranks whose bucket thresholds diverged (a broken tuner
+    # sync) dispatch visibly different descriptors, so the consistency
+    # checker / fingerprint verifier name the divergence instead of the
+    # mismatched programs deadlocking.
+    _consistency(
+        f"bucketed_allreduce(n={len(gs)},shapes="
+        f"{[tuple(g.shape) for g in gs]},op={int(rop)},thresh={eff},"
+        f"plan={fusion.plan_signature(plan)},ps={ps.process_set_id})",
+        ps, name=name or "bucketed_allreduce")
+    if profile is None:
+        profile = cfg.bucket_profile
+    base = name or "bucketed_allreduce"
+    tl = topology.state().timeline
+    records = []  # (bucket, members, layout, outs)
+    launches: List[float] = []
+    with _instrument(base, "ALLREDUCE", arrays=tuple(gs),
+                     ntensors=len(gs)):
+        for bi, bucket in enumerate(plan):
+            members: List[int] = []
+            pos_of: Dict[int, int] = {}
+            layout: List[Tuple[int, int, int, bool]] = []
+            for it in bucket.items:
+                if it.index not in pos_of:
+                    pos_of[it.index] = len(members)
+                    members.append(it.index)
+                whole = it.start == 0 and it.size == int(
+                    np.prod(gs[it.index].shape[1:], dtype=np.int64))
+                layout.append((pos_of[it.index], it.start, it.size, whole))
+            lay = tuple(layout)
+            key = ("bar",
+                   tuple((tuple(gs[i].shape), str(gs[i].dtype))
+                         for i in members),
+                   lay, int(rop), ps.cache_token,
+                   float(prescale_factor), float(postscale_factor))
+            first_build = key not in _cache._cache
+
+            def build(lay=lay, nmem=len(members)) -> Callable:
+                def body(*blocks):
+                    segs = [blocks[pos].reshape(1, -1)[:, s:s + n]
+                            for pos, s, n, _w in lay]
+                    fused = segs[0] if len(segs) == 1 \
+                        else jnp.concatenate(segs, axis=1)
+                    red = _apply_reduce(fused, rop, k, prescale_factor,
+                                        postscale_factor)
+                    outs, off = [], 0
+                    for pos, _s, n, whole in lay:
+                        piece = red[:, off:off + n]
+                        outs.append(piece.reshape(blocks[pos].shape)
+                                    if whole else piece)
+                        off += n
+                    return tuple(outs) if len(lay) > 1 else outs[0]
+
+                specs_out = (P(_AXIS),) * len(lay) if len(lay) > 1 \
+                    else P(_AXIS)
+                fn = jax.shard_map(body, mesh=ps.mesh,
+                                   in_specs=(P(_AXIS),) * nmem,
+                                   out_specs=specs_out, check_vma=False)
+                return jax.jit(fn)
+
+            fn = _cache.get_or_build(key, build)
+            if first_build:
+                # One ring event per DISTINCT bucket program (not per
+                # dispatch — steady-state steps must not evict the
+                # collective history hvddoctor merges).
+                _flight.record(
+                    "bucket", f"{base} b{bi}/{len(plan)} "
+                    f"{bucket.nbytes >> 10}KB x{len(bucket.items)} "
+                    f"{bucket.dtype} (new program)")
+            if tl is not None:
+                tl.span_begin(f"{base}/b{bi}", "ALLREDUCE")
+            launches.append(time.perf_counter())
+            outs = _execute(fn, *[gs[i] for i in members])
+            if tl is not None:
+                tl.span_end(f"{base}/b{bi}", "ALLREDUCE")
+            if len(layout) == 1:
+                outs = (outs,)
+            records.append((bucket, members, layout, outs))
+        timings: List[Tuple[int, float]] = []
+        overlap = None
+        if profile and records:
+            completes: List[float] = []
+            for bi, (_, _, _, outs) in enumerate(records):
+                # The complete half of the per-bucket track: the launch
+                # span above covers dispatch; this WAIT span ends when
+                # the bucket's collective actually finished, so a trace
+                # shows the in-flight windows overlapping.
+                if tl is not None:
+                    tl.span_begin(f"{base}/b{bi}", "WAIT_FOR_DATA")
+                jax.block_until_ready(outs)
+                if tl is not None:
+                    tl.span_end(f"{base}/b{bi}", "WAIT_FOR_DATA")
+                completes.append(time.perf_counter())
+            spans = [c - l for l, c in zip(launches, completes)]
+            total = completes[-1] - launches[0]
+            ssum = sum(spans)
+            if len(spans) > 1 and ssum > 0:
+                overlap = max(0.0, min(1.0, 1.0 - total / ssum))
+            # Wire (per-rank) bucket bytes, the quantity the fusion
+            # threshold bounds — what the bucket tuner's size classes key on.
+            timings = [(rec[0].nbytes, s)
+                       for rec, s in zip(records, spans)]
+            if len(spans) > 1:
+                med = sorted(spans)[len(spans) // 2]
+                for bi, (rec, s) in enumerate(zip(records, spans)):
+                    if med > 0 and s > 3.0 * med and s > 0.005:
+                        _flight.record(
+                            "bucket",
+                            f"SLOW {base} b{bi}/{len(plan)} "
+                            f"{rec[0].nbytes >> 10}KB took {s * 1e3:.1f}ms "
+                            f"(median {med * 1e3:.1f}ms)")
+        _bucket_tls.timings = tuple(timings)
+        from horovod_tpu.observability import metrics as _m
+        if _m.registry().enabled:
+            mx = _mx()
+            mx["bucket_n"].inc(len(plan))
+            for bucket in plan:
+                mx["bucket_bytes"].observe(bucket.nbytes * k)
+            for _, s in timings:
+                mx["bucket_secs"].observe(s)
+            if overlap is not None:
+                mx["overlap"].set(overlap)
+        _bucket_stats.record(len(plan), overlap)
+    results: List[Optional[jax.Array]] = [None] * len(gs)
+    chunk_map: List[List[Tuple[int, jax.Array]]] = [[] for _ in gs]
+    for _, members, layout, outs in records:
+        for (pos, start, _n, whole), o in zip(layout, outs):
+            if whole:
+                results[members[pos]] = o
+            else:
+                chunk_map[members[pos]].append((start, o))
+    for i, g in enumerate(gs):
+        if results[i] is None:
+            parts = [p for _, p in
+                     sorted(chunk_map[i], key=lambda t: t[0])]
+            flat = parts[0] if len(parts) == 1 \
+                else jnp.concatenate(parts, axis=1)
+            results[i] = flat.reshape(g.shape).astype(g.dtype)
+    return [_from_global(r, s) for r, s in zip(results, stackeds)]
 
 
 def broadcast(tensor: Any, root_rank: int,
@@ -1282,6 +1528,7 @@ def poll(handle: Any) -> bool:
 # reference API parity (horovod/torch/mpi_ops.py allreduce_async etc.).
 allreduce_async = allreduce
 grouped_allreduce_async = grouped_allreduce
+bucketed_allreduce_async = bucketed_allreduce
 allgather_async = allgather
 broadcast_async = broadcast
 alltoall_async = alltoall
@@ -1480,6 +1727,23 @@ def _mx():
                 "horovod_compile_cache_total",
                 "Compiled-executable cache lookups",
                 labelnames=("event",)),
+            "bucket_n": reg.counter(
+                "horovod_bucket_dispatch_total",
+                "Fusion buckets dispatched by the pipelined allreduce"),
+            "bucket_bytes": reg.histogram(
+                "horovod_bucket_bytes",
+                "Global payload bytes per dispatched fusion bucket",
+                buckets=m.SIZE_BUCKETS),
+            "bucket_secs": reg.histogram(
+                "horovod_bucket_seconds",
+                "Per-bucket launch-to-complete wall time (profiled "
+                "bucketed_allreduce calls only)",
+                buckets=m.TIME_BUCKETS),
+            "overlap": reg.gauge(
+                "horovod_overlap_fraction",
+                "Estimated fraction of bucket in-flight time shared with "
+                "another bucket (1 - wall_window / sum_of_bucket_spans; "
+                "profiled calls only)"),
             "stall_warn": reg.counter(
                 "horovod_stall_warnings_total",
                 "Stall warnings", labelnames=("source",)),

@@ -62,9 +62,55 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class ThresholdedCompressor(Compressor):
+    """Apply `inner` only to tensors of at least `min_bytes`.
+
+    The bucket-pipeline wiring for "compress large messages": wire-time
+    scales with payload so the multi-MB gradients (the ones the fusion
+    buckets chunk) ride bf16/fp16, while the long tail of small
+    bias/norm gradients — where cast overhead beats any transfer saving
+    and precision matters most — keeps full precision. Buckets are
+    planned on the COMPRESSED dtypes (compression runs before
+    ops/fusion.plan_buckets in both the in-jit and eager paths), so
+    compressed and uncompressed gradients land in separate same-dtype
+    buckets.
+    """
+
+    def __init__(self, inner=None, min_bytes: int = 1 << 20):
+        self.inner = inner if inner is not None else BF16Compressor
+        self.min_bytes = int(min_bytes)
+
+    def compress(self, tensor: jax.Array) -> Tuple[jax.Array, Any]:
+        import numpy as np
+        dtype = getattr(tensor, "dtype", None)
+        if dtype is None:
+            tensor = jnp.asarray(tensor)
+            dtype = tensor.dtype
+        nbytes = int(np.prod(np.shape(tensor), dtype=np.int64)) * \
+            jnp.dtype(dtype).itemsize
+        if nbytes >= self.min_bytes:
+            return self.inner.compress(tensor)
+        return tensor, None
+
+    def decompress(self, tensor: jax.Array, ctx: Any) -> jax.Array:
+        return self.inner.decompress(tensor, ctx)
+
+
 class Compression:
     """Option namespace (reference compression.py:66-74)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+    @staticmethod
+    def thresholded(inner=None, min_bytes: int = 1 << 20
+                    ) -> ThresholdedCompressor:
+        """`inner` (default bf16) for tensors ≥ `min_bytes`, identity
+        below — the recommended large-message setting for the bucketed
+        gradient path (docs/perf.md)."""
+        return ThresholdedCompressor(inner, min_bytes)
+
+
+# Prebuilt large-message compressor: bf16 on the wire for ≥1 MB tensors.
+Compression.bf16_large = ThresholdedCompressor(BF16Compressor, 1 << 20)

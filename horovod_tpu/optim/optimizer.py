@@ -66,17 +66,41 @@ def reduce_gradients_in_jit(grads: Any,
                             compression=Compression.none,
                             fusion_threshold_bytes: Optional[int] = None,
                             num_ranks: Optional[int] = None,
-                            gradient_predivide_factor: float = 1.0) -> Any:
+                            gradient_predivide_factor: float = 1.0,
+                            reverse_bucket_order: Optional[bool] = None
+                            ) -> Any:
     """Cross-replica gradient reduction for use inside shard_map'd code.
 
     Buckets the gradient pytree and emits one psum per bucket — the compiled
     counterpart of the fusion buffer + grouped allreduce path
-    (controller.cc FuseResponses + EnqueueTensorAllreduces).
+    (controller.cc FuseResponses + EnqueueTensorAllreduces). Two properties
+    give XLA's scheduler room to run each bucket's ICI transfer
+    concurrently with the remaining backward compute (docs/perf.md;
+    pinned by tests/test_overlap_hlo.py):
+
+    * oversize gradients are CHUNKED across ≤-threshold buckets instead
+      of forming one giant payload (the wire cap is
+      min(fusion_threshold, HOROVOD_BUCKET_CAP) when the threshold comes
+      from config; an explicit `fusion_threshold_bytes` is used as-is),
+    * buckets are packed in REVERSE leaf order by default
+      (`reverse_bucket_order`, HOROVOD_BUCKET_REVERSE), aligning each
+      bucket with a contiguous span of early-available gradients — the
+      backward pass produces the LAST layer's gradients first, so the
+      first bucket's psum is ready while earlier layers are still
+      differentiating (torch-DDP bucket ordering, Li et al. VLDB 2020).
     """
     thresh = fusion_threshold_bytes
     if thresh is None:
-        thresh = (topology.state().config.fusion_threshold_bytes
-                  if topology.is_initialized() else 64 * 1024 * 1024)
+        if topology.is_initialized():
+            cfg = topology.state().config
+            thresh = fusion.effective_threshold(cfg.fusion_threshold_bytes,
+                                                cfg.bucket_cap_bytes)
+        else:
+            thresh = 4 * 1024 * 1024
+    reverse = reverse_bucket_order
+    if reverse is None:
+        reverse = (topology.state().config.bucket_reverse
+                   if topology.is_initialized() else True)
     k = num_ranks if num_ranks is not None else lax.axis_size(axis)
     pre, post, rop = _scale_factors(op, k, gradient_predivide_factor)
 
@@ -107,7 +131,8 @@ def reduce_gradients_in_jit(grads: Any,
     if rop == T.ReduceOp.ADASUM:
         reduced = tuple(reduce_block(b) for b in blocks)
     else:
-        reduced = fusion.fused_reduce_blocks(blocks, reduce_block, thresh)
+        reduced = fusion.fused_reduce_blocks(blocks, reduce_block, thresh,
+                                             reverse=reverse)
     out_leaves = [compression.decompress(r[0], c)
                   for r, c in zip(reduced, ctxs)]
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
@@ -186,22 +211,49 @@ class DistributedOptimizer:
         L = collectives._local_member_count(self.process_set)
         stacked = [collectives._is_stacked(t, self.process_set, L)
                    for t in tensors]
-        pm = topology.state().parameter_manager
+        st = topology.state()
+        pm = st.parameter_manager
+        cfg = st.config
         # Instrumentation only while actively tuning: once frozen, the
         # block_until_ready sync would permanently defeat async dispatch.
         tuning = pm is not None and not pm.frozen
+        # Per-bucket dispatch (docs/perf.md): each bucket's collective
+        # launches independently so transfers pipeline across buckets;
+        # Adasum keeps the grouped path (never fused).
+        use_buckets = cfg.bucket_pipeline and rop != T.ReduceOp.ADASUM
+        bt = st.bucket_tuner if use_buckets else None
+        bt_active = bt is not None and not bt.frozen
         t0 = time.perf_counter() if tuning else 0.0
-        reduced = collectives.grouped_allreduce(
-            tensors, op=rop, prescale_factor=pre, postscale_factor=post,
-            process_set=self.process_set)
+        if use_buckets:
+            reduced = collectives.bucketed_allreduce(
+                tensors, op=rop, prescale_factor=pre, postscale_factor=post,
+                process_set=self.process_set,
+                # Force per-bucket completion timing while either tuner is
+                # live (the pm path blocks right below anyway).
+                profile=True if (bt_active or tuning) else None)
+        else:
+            reduced = collectives.grouped_allreduce(
+                tensors, op=rop, prescale_factor=pre, postscale_factor=post,
+                process_set=self.process_set)
+        if bt_active:
+            for nb, sec in collectives.last_bucket_timings():
+                bt.record_bucket(nb, sec)
+            # May adjust cfg.fusion_threshold_bytes — rank 0 decides and
+            # broadcasts, so every rank's NEXT plan (and compiled
+            # programs) agree; no cache clear needed, the bucket cache
+            # keys include the plan layout.
+            bt.update()
         if tuning:
             jax.block_until_ready(reduced)
             nbytes = sum(int(np.prod(np.shape(t))) * np.dtype(
                 getattr(t, "dtype", np.float32)).itemsize for t in tensors)
             pm.record(nbytes, time.perf_counter() - t0)
-            # No cache clear on change: the grouped-allreduce cache key
-            # includes fusion_threshold_bytes, so a new threshold simply
-            # misses and re-traces while other executables stay warm.
+            # No cache clear on change: the grouped/bucketed cache keys
+            # include the EFFECTIVE (cap-clamped) threshold, so a new
+            # threshold simply misses and re-traces while other
+            # executables stay warm — and the GP's search ceiling is
+            # clamped to the cap (default_knobs), so its samples always
+            # land where programs actually differ.
             pm.update()
         # Reduced per-rank rows are identical; collapse stacked inputs to a
         # single copy so updates apply to the (replicated) parameters.

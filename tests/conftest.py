@@ -29,6 +29,9 @@ def pytest_addoption(parser):
     parser.addoption(
         "--run-faults", action="store_true", default=False,
         help="run the chaos/fault-injection suite (make chaos)")
+    parser.addoption(
+        "--run-perf", action="store_true", default=False,
+        help="run wall-clock perf smoke tests (make fusion-smoke)")
 
 
 def pytest_configure(config):
@@ -37,16 +40,27 @@ def pytest_configure(config):
         "faults: end-to-end chaos tests driving elastic jobs under injected "
         "faults (HOROVOD_FAULT_SPEC); minutes of runtime, so excluded from "
         "tier-1 — run via `make chaos` or --run-faults")
+    config.addinivalue_line(
+        "markers",
+        "perf: wall-clock perf smoke tests (fusion-cliff monotonicity on "
+        "the virtual mesh); load-sensitive, so excluded from tier-1 — run "
+        "via `make fusion-smoke` or --run-perf")
 
 
 def pytest_collection_modifyitems(config, items):
-    if config.getoption("--run-faults"):
-        return
-    skip = pytest.mark.skip(
-        reason="chaos suite: run with `make chaos` (pytest --run-faults)")
+    skips = []
+    if not config.getoption("--run-faults"):
+        skips.append(("faults", pytest.mark.skip(
+            reason="chaos suite: run with `make chaos` "
+                   "(pytest --run-faults)")))
+    if not config.getoption("--run-perf"):
+        skips.append(("perf", pytest.mark.skip(
+            reason="perf smoke: run with `make fusion-smoke` "
+                   "(pytest --run-perf)")))
     for item in items:
-        if "faults" in item.keywords:
-            item.add_marker(skip)
+        for marker, skip in skips:
+            if marker in item.keywords:
+                item.add_marker(skip)
 
 
 # hvdrace gate (`make race`, docs/static_analysis.md): when the suite

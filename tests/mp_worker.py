@@ -276,6 +276,85 @@ def scenario_barrier(hvd, rank, size):
         check(dt > 0.5, f"barrier returned too early on rank {rank}: {dt}")
 
 
+def scenario_bucketed(hvd, rank, size):
+    """Pipelined bucketed allreduce over real multi-process collectives,
+    including an oversize tensor chunked across buckets and a mixed-in
+    int tensor (separate same-dtype bucket)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.common.types import ReduceOp
+    from horovod_tpu.core.topology import raw_state
+
+    cfg = raw_state().config
+    saved = (cfg.fusion_threshold_bytes, cfg.bucket_cap_bytes)
+    cfg.fusion_threshold_bytes = 1 << 20
+    cfg.bucket_cap_bytes = 1 << 20
+    try:
+        tensors = [
+            jnp.full((300000,), float(rank + 1), jnp.float32),  # 1.2MB
+            jnp.full((3, 3), float(rank * 10), jnp.float32),
+            jnp.arange(8, dtype=jnp.int32) + rank,
+        ]
+        outs = hvd.bucketed_allreduce(tensors, op=ReduceOp.SUM,
+                                      name="mp_bucketed")
+        tot = sum(r + 1 for r in range(size))
+        np.testing.assert_allclose(np.asarray(outs[0]),
+                                   np.full((300000,), float(tot)),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(outs[1]),
+            np.full((3, 3), 10.0 * sum(range(size))), rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(outs[2]),
+            np.arange(8) * size + sum(range(size)))
+    finally:
+        cfg.fusion_threshold_bytes, cfg.bucket_cap_bytes = saved
+
+
+def scenario_bucket_tuner_sync(hvd, rank, size):
+    """Online bucket tuner through the real DistributedOptimizer on 2
+    processes: rank 0 decides, the decision broadcasts, adjustments stay
+    bounded, and every rank ends on the SAME threshold. The launcher's
+    consistency checker (HOROVOD_CONSISTENCY_CHECK default-on here) is
+    the enforcement: bucketed_allreduce's descriptor embeds the
+    effective threshold + plan fingerprint, so a rank split would raise
+    TensorShapeMismatchError instead of passing."""
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.core.autotune import OnlineBucketTuner
+    from horovod_tpu.core.topology import raw_state
+
+    st = raw_state()
+    cfg = st.config
+    cfg.bucket_autotune = True
+    cfg.bucket_autotune_interval = 4
+    cfg.bucket_autotune_max_adjustments = 2
+    st.bucket_tuner = OnlineBucketTuner(cfg)
+    params = {"emb": jnp.ones((400, 400), jnp.float32),
+              "b": jnp.ones((32,), jnp.float32)}
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01))
+    state = opt.init(params)
+    grads = {k: jnp.full(v.shape, float(rank + 1))
+             for k, v in params.items()}
+    for _ in range(cfg.bucket_autotune_interval *
+                   (st.bucket_tuner.max_windows + 1)):
+        params, state = opt.step(grads, params, state)
+        if st.bucket_tuner.frozen:
+            break
+    tuner = st.bucket_tuner
+    check(tuner.frozen, "bucket tuner never froze")
+    check(tuner.adjustments <= cfg.bucket_autotune_max_adjustments,
+          f"unbounded adjustments: {tuner.adjustments}")
+    got = hvd.allgather(
+        np.asarray([[float(cfg.fusion_threshold_bytes)]]),
+        name="tuner_thresholds")
+    vals = set(float(v) for v in np.asarray(got).ravel())
+    check(len(vals) == 1, f"ranks disagree on tuned threshold: {vals}")
+    st.bucket_tuner = None
+    cfg.bucket_autotune = False
+
+
 def scenario_autotune_sync(hvd, rank, size):
     """Multi-process autotune broadcast path (autotune.py:212-230)."""
     from horovod_tpu.core.autotune import ParameterManager
@@ -405,6 +484,8 @@ SCENARIOS = {
     "consistency_gather_mismatch": scenario_consistency_gather_mismatch,
     "allreduce": scenario_allreduce,
     "grouped": scenario_grouped,
+    "bucketed": scenario_bucketed,
+    "bucket_tuner_sync": scenario_bucket_tuner_sync,
     "broadcast": scenario_broadcast,
     "allgather_uneven": scenario_allgather_uneven,
     "alltoall": scenario_alltoall,

@@ -1,0 +1,416 @@
+"""Bucketed, backward-overlapped gradient pipeline (ISSUE 6, docs/perf.md):
+
+* `plan_buckets` edge cases — mixed dtypes interleaved, oversize-tensor
+  chunking (the 16-64 MB cliff fix), empty input, ordering stability,
+  reverse (backward-production) packing, tiny-threshold compatibility.
+* `bucketed_allreduce` correctness on the 8-device mesh, chunk
+  reassembly, per-bucket timings/overlap stats, fallbacks.
+* `ops/compression.py` round trips (bf16/fp16 dtype restoration,
+  thresholded large-message wrapper) and allreduce-mean correctness
+  under compression, including the acceptance check that a compressed
+  training run's loss trajectory tracks the uncompressed one.
+* `OnlineBucketTuner` decision logic: moves to the measured sweet spot,
+  bounded adjustments, hysteresis, freeze.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.common.config import Config
+from horovod_tpu.core.autotune import OnlineBucketTuner
+from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops.fusion import (Bucket, BucketItem, effective_threshold,
+                                    fused_reduce_blocks, plan_buckets,
+                                    plan_signature)
+
+MB = 1 << 20
+
+
+def covered(plan, metas):
+    """index -> covered element count, asserting chunks are disjoint."""
+    seen = {}
+    for b in plan:
+        for it in b.items:
+            key = (it.index, it.start)
+            assert key not in seen, f"duplicate chunk {key}"
+            seen[key] = it.size
+    out = {}
+    for (idx, _), size in seen.items():
+        out[idx] = out.get(idx, 0) + size
+    return out
+
+
+# ---------------------------------------------------------------- planning
+
+def test_plan_empty():
+    assert plan_buckets([], MB) == []
+
+
+def test_plan_mixed_dtypes_interleaved():
+    """Interleaved f32/i32 tensors land in per-dtype buckets; within a
+    dtype, submission order is preserved."""
+    metas = [((100,), "float32"), ((100,), "int32"),
+             ((100,), "float32"), ((100,), "int32"),
+             ((100,), "float32")]
+    plan = plan_buckets(metas, MB)
+    assert len(plan) == 2
+    by_dtype = {b.dtype: [it.index for it in b.items] for b in plan}
+    assert by_dtype == {"float32": [0, 2, 4], "int32": [1, 3]}
+
+
+def test_plan_oversize_tensor_chunks():
+    """A tensor over the threshold is SPLIT into ≤-threshold near-equal
+    chunks instead of forming its own oversized bucket (the cliff fix:
+    the old rule `max(threshold, nbytes)` let a 64 MB tensor rebuild
+    exactly the giant payload the threshold exists to prevent)."""
+    metas = [((16 * 1024 * 1024,), "float32")]  # 64 MB
+    plan = plan_buckets(metas, 4 * MB)
+    assert len(plan) == 16
+    assert all(b.nbytes <= 4 * MB for b in plan)
+    sizes = [b.items[0].size for b in plan]
+    assert max(sizes) - min(sizes) <= 1  # near-equal
+    assert covered(plan, metas) == {0: 16 * 1024 * 1024}
+
+
+def test_plan_chunk_remainder_packs_with_neighbors():
+    """The oversize tensor's chunks and a following small tensor share
+    buckets under the same greedy rule — no wasted singleton buckets."""
+    metas = [((1500000,), "float32"),  # 6 MB -> 2 chunks of 3 MB at 4 MB
+             ((100000,), "float32")]   # 0.4 MB rides with a 3 MB chunk
+    plan = plan_buckets(metas, 4 * MB)
+    assert len(plan) == 2
+    assert covered(plan, metas) == {0: 1500000, 1: 100000}
+    assert all(b.nbytes <= 4 * MB for b in plan)
+
+
+def test_plan_tiny_threshold_keeps_one_bucket_per_tensor():
+    """Pathological thresholds (tests use 1- and 8-byte thresholds to
+    force per-tensor buckets) must not explode into per-element chunks:
+    chunk granularity floors at 1 MB."""
+    metas = [((16,), "float32")] * 4
+    plan = plan_buckets(metas, 8)
+    assert len(plan) == 4
+    assert [b.items[0].index for b in plan] == [0, 1, 2, 3]
+
+
+def test_plan_ordering_stable_and_reverse():
+    metas = [((10,), "float32"), ((20,), "float32"), ((30,), "float32")]
+    p1 = plan_buckets(metas, 16)  # too small to fuse: one bucket each
+    p2 = plan_buckets(metas, 16)
+    assert p1 == p2  # deterministic
+    assert plan_signature(p1) == plan_signature(p2)
+    fwd = [b.items[0].index for b in p1]
+    rev = [b.items[0].index
+           for b in plan_buckets(metas, 16, reverse=True)]
+    assert fwd == [0, 1, 2] and rev == [2, 1, 0]
+    assert plan_signature(p1) != plan_signature(
+        plan_buckets(metas, 16, reverse=True))
+
+
+def test_plan_reverse_packs_last_leaves_first():
+    """Reverse packing puts the LAST leaves (the backward pass's first
+    finished gradients) in bucket 0 — the torch-DDP production-order
+    rule that lets XLA overlap bucket collectives with remaining
+    backward compute."""
+    metas = [((100,), "float32")] * 6
+    plan = plan_buckets(metas, 2 * 400 + 8, reverse=True)
+    first = [it.index for it in plan[0].items]
+    assert first[0] == 5 and sorted(first, reverse=True) == first
+
+
+def test_effective_threshold_cap():
+    assert effective_threshold(64 * MB, 4 * MB) == 4 * MB
+    assert effective_threshold(2 * MB, 4 * MB) == 2 * MB
+    assert effective_threshold(64 * MB, 0) == 64 * MB
+
+
+def test_bucket_accessors():
+    b = Bucket("float32", 4, (BucketItem(0, 0, 10), BucketItem(1, 0, 6)))
+    assert b.elems == 16 and b.nbytes == 64
+
+
+def test_fused_reduce_blocks_reassembles_chunks():
+    """Trace-level check (no mesh needed): a chunked tensor comes back
+    bit-identical through the split/reduce/concat path."""
+    blocks = [jnp.arange(600000, dtype=jnp.float32)[None],
+              jnp.arange(100, dtype=jnp.float32)[None]]
+    outs = fused_reduce_blocks(blocks, lambda b: b * 2.0, MB)
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.asarray(blocks[0]) * 2.0)
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.asarray(blocks[1]) * 2.0)
+
+
+# ----------------------------------------------------- eager bucketed path
+
+def _stacked(hvd, shape, fill):
+    return np.stack([np.full(shape, fill(r), np.float32)
+                     for r in range(hvd.size())])
+
+
+def test_bucketed_allreduce_matches_grouped(hvd, monkeypatch):
+    from horovod_tpu.core import topology
+    from horovod_tpu.ops import collectives as C
+
+    monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "1")
+    cfg = topology.state().config
+    monkeypatch.setattr(cfg, "fusion_threshold_bytes", MB)
+    monkeypatch.setattr(cfg, "bucket_cap_bytes", MB)
+    xs = [_stacked(hvd, (300000,), lambda r: r + 1.0),  # 1.2MB: chunks
+          _stacked(hvd, (64,), lambda r: 2.0 * r),
+          (_stacked(hvd, (8,), lambda r: r) * 1).astype(np.int32)]
+    outs = hvd.bucketed_allreduce(xs, op=hvd_mod.Sum, profile=True)
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o)[0], x.sum(0), rtol=1e-5)
+    # profiled call left per-bucket timings + overlap stats behind
+    timings = C.last_bucket_timings()
+    assert len(timings) >= 3  # 2+ chunks of the big tensor + others
+    assert all(nb > 0 and sec >= 0 for nb, sec in timings)
+    dispatched, profiled, overlap = C.bucket_overlap_stats()
+    assert dispatched >= len(timings) and profiled >= 1
+    assert 0.0 <= overlap <= 1.0
+
+
+def test_bucketed_allreduce_average(hvd, monkeypatch):
+    from horovod_tpu.core import topology
+
+    monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "1")
+    cfg = topology.state().config
+    monkeypatch.setattr(cfg, "fusion_threshold_bytes", 512)
+    xs = [_stacked(hvd, (16,), lambda r: float(r)) for _ in range(3)]
+    outs = hvd.bucketed_allreduce(xs)  # default AVERAGE
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o)[0], x.mean(0), rtol=1e-5)
+
+
+def test_bucketed_allreduce_single_tensor_falls_back(hvd, monkeypatch):
+    monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "1")
+    x = _stacked(hvd, (32,), lambda r: r + 1.0)
+    (out,) = hvd.bucketed_allreduce([x], op=hvd_mod.Sum)
+    np.testing.assert_allclose(np.asarray(out)[0], x.sum(0), rtol=1e-5)
+
+
+def test_bucketed_allreduce_empty(hvd):
+    assert hvd.bucketed_allreduce([]) == []
+
+
+# ------------------------------------------------------------- compression
+
+def test_compression_round_trip_dtype_restoration():
+    for comp, wire in ((Compression.bf16, jnp.bfloat16),
+                       (Compression.fp16, jnp.float16)):
+        x = jnp.linspace(-3, 3, 64, dtype=jnp.float32)
+        wired, ctx = comp.compress(x)
+        assert wired.dtype == wire and ctx == jnp.float32
+        back = comp.decompress(wired, ctx)
+        assert back.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   rtol=2e-2, atol=2e-2)
+        # non-float tensors pass through untouched
+        i = jnp.arange(8, dtype=jnp.int32)
+        wired_i, ctx_i = comp.compress(i)
+        assert wired_i.dtype == jnp.int32 and ctx_i is None
+        assert comp.decompress(wired_i, ctx_i).dtype == jnp.int32
+
+
+def test_thresholded_compressor_large_messages_only():
+    comp = Compression.thresholded(Compression.bf16, min_bytes=1024)
+    small = jnp.ones((16,), jnp.float32)        # 64 B: full precision
+    big = jnp.ones((1024,), jnp.float32)        # 4 KB: compressed
+    ws, cs = comp.compress(small)
+    wb, cb = comp.compress(big)
+    assert ws.dtype == jnp.float32 and cs is None
+    assert wb.dtype == jnp.bfloat16 and cb == jnp.float32
+    assert comp.decompress(wb, cb).dtype == jnp.float32
+    assert comp.decompress(ws, cs).dtype == jnp.float32
+    # the prebuilt large-message default exists and gates at 1 MB
+    assert Compression.bf16_large.min_bytes == MB
+
+
+def test_grouped_allreduce_mean_under_compression(hvd):
+    """Allreduce-mean correctness when gradients ride the wire in bf16:
+    the eager DistributedOptimizer path compresses per-leaf before
+    bucketing and restores dtype after."""
+    from horovod_tpu.optim.optimizer import DistributedOptimizer
+
+    opt = DistributedOptimizer(optax.sgd(0.0),
+                               compression=Compression.bf16)
+    grads = {"w": _stacked(hvd, (256,), lambda r: (r + 1) / 8.0)}
+    out = opt._allreduce_grads(grads)
+    assert out["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               grads["w"].mean(0), rtol=2e-2, atol=2e-2)
+
+
+def test_loss_trajectory_matches_uncompressed(hvd):
+    """ISSUE 6 acceptance: a short training run with bf16-compressed
+    gradient buckets tracks the uncompressed loss trajectory within
+    tolerance (the compression path is numerically sound end to end)."""
+    from horovod_tpu.optim.optimizer import build_train_step
+
+    rng = np.random.default_rng(0)
+    base = {"w1": jnp.asarray(rng.standard_normal((32, 64)) * 0.1,
+                              jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((64, 1)) * 0.1,
+                              jnp.float32)}
+    x = jnp.asarray(rng.standard_normal((32, 32)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((32, 1)), jnp.float32)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        h = jnp.tanh(xb @ p["w1"])
+        return jnp.mean((h @ p["w2"] - yb) ** 2)
+
+    def run(compression):
+        step = build_train_step(loss_fn, optax.sgd(0.05),
+                                compression=compression, donate=False)
+        p = jax.tree_util.tree_map(jnp.copy, base)
+        o = optax.sgd(0.05).init(p)
+        losses = []
+        for _ in range(10):
+            p, o, l = step(p, o, (x, y))
+            losses.append(float(l))
+        return np.asarray(losses)
+
+    ref = run(Compression.none)
+    comp = run(Compression.bf16)
+    assert ref[-1] < ref[0]  # actually trained
+    np.testing.assert_allclose(comp, ref, rtol=5e-2, atol=5e-3)
+
+
+def test_compression_with_adasum_in_jit(hvd):
+    """Adasum interplay: the unfused Adasum path still compresses on the
+    wire and restores dtype (reduce_gradients_in_jit compress →
+    adasum_reduce_block → decompress)."""
+    from horovod_tpu.common import types as T
+    from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.core import topology
+
+    mesh = topology.mesh()
+    k = hvd_mod.size()
+
+    def body(g):
+        return reduce_gradients_in_jit(g, op=T.ReduceOp.ADASUM,
+                                       compression=Compression.bf16,
+                                       num_ranks=k)
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                               out_specs=P(), check_vma=False))
+    g = {"w": jnp.linspace(-1, 1, 128, dtype=jnp.float32)}
+    out = fn(g)
+    assert out["w"].dtype == jnp.float32
+    # identical contributions: adasum of equal vectors is the vector
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.asarray(g["w"]), rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------- bucket tuner
+
+def _cfg(**kw):
+    kw.setdefault("bucket_autotune", True)
+    kw.setdefault("bucket_autotune_interval", 2)
+    kw.setdefault("bucket_autotune_max_adjustments", 3)
+    return Config(**kw)
+
+
+def _feed(tuner, nbytes, rate, n=10):
+    """n samples of `nbytes`-sized buckets at `rate` bytes/sec."""
+    for _ in range(n):
+        tuner.record_bucket(nbytes, nbytes / rate)
+
+
+def test_bucket_tuner_moves_to_sweet_spot():
+    cfg = _cfg(fusion_threshold_bytes=64 * MB, bucket_cap_bytes=64 * MB)
+    t = OnlineBucketTuner(cfg)
+    _feed(t, 32 * MB, 1e8)   # big buckets: slow (the cliff)
+    _feed(t, 2 * MB, 5e8)    # 2-4 MB class: fast
+    t.update()
+    changed = t.update()  # window boundary (interval=2)
+    assert changed and cfg.fusion_threshold_bytes == 4 * MB
+    assert t.adjustments == 1 and t.history == [4 * MB]
+
+
+def test_bucket_tuner_bounded_adjustments_and_freeze():
+    cfg = _cfg(fusion_threshold_bytes=64 * MB, bucket_cap_bytes=0,
+               bucket_autotune_max_adjustments=2)
+    t = OnlineBucketTuner(cfg)
+    # adversarial feed: a different class "wins" every window
+    rates = [(MB, 5e8), (8 * MB, 9e8), (2 * MB, 2e9), (16 * MB, 8e9),
+             (4 * MB, 3e10), (32 * MB, 9e10)]
+    changes = 0
+    for nb, rate in rates:
+        _feed(t, nb, rate, n=16)
+        t.update()
+        changes += int(t.update())
+        if t.frozen:
+            break
+    assert t.frozen
+    assert t.adjustments <= 2 and changes <= 2
+
+
+def test_bucket_tuner_hysteresis_keeps_incumbent():
+    """A challenger within 10% of the incumbent class must NOT trigger a
+    recompile."""
+    cfg = _cfg(fusion_threshold_bytes=4 * MB, bucket_cap_bytes=64 * MB)
+    t = OnlineBucketTuner(cfg)
+    _feed(t, 3 * MB, 1.00e9)   # incumbent class (threshold 4MB -> ~4MB
+    _feed(t, 1 * MB, 1.05e9)   # buckets); challenger only 5% better
+    t.update()
+    assert not t.update()
+    assert cfg.fusion_threshold_bytes == 4 * MB
+    # two consecutive no-change decisions freeze the tuner
+    t.update()
+    t.update()
+    assert t.frozen
+
+
+def test_bucket_tuner_hysteresis_non_pow2_threshold():
+    """Regression (review finding): with a non-power-of-two threshold the
+    incumbent class is floor(log2(t-1)) — the old floor(log2(t))-1 lookup
+    missed it, skipped the hysteresis guard, and re-pointed the threshold
+    on the first trusted window regardless of merit."""
+    cfg = _cfg(fusion_threshold_bytes=3 * MB, bucket_cap_bytes=64 * MB)
+    t = OnlineBucketTuner(cfg)
+    _feed(t, 3 * MB - 4096, 1.00e9)  # incumbent: ~3MB buckets, class 21
+    _feed(t, 1 * MB, 1.05e9)         # challenger only 5% better
+    t.update()
+    assert not t.update()            # hysteresis holds: no recompile
+    assert cfg.fusion_threshold_bytes == 3 * MB
+
+
+def test_bucket_tuner_quantizes_and_clamps_to_cap():
+    cfg = _cfg(fusion_threshold_bytes=512 * 1024, bucket_cap_bytes=2 * MB)
+    t = OnlineBucketTuner(cfg)
+    _feed(t, 400 * 1024, 1e7)
+    _feed(t, 24 * MB, 9e9)  # winner proposes 32MB -> clamped to the cap
+    t.update()
+    assert t.update()
+    assert cfg.fusion_threshold_bytes == 2 * MB
+
+
+def test_bucket_tuner_disabled_is_frozen():
+    t = OnlineBucketTuner(Config())
+    assert t.frozen and not t.update()
+
+
+def test_gp_knob_ceiling_clamped_to_bucket_cap():
+    """Regression (review finding): with the bucket cap active, GP
+    samples above the cap all execute the identical program (call sites
+    min() the threshold) — a flat plateau that degenerates the EI
+    search. The knob's ceiling must follow the cap; lifting the cap
+    restores the full range (what the bench autotune section does)."""
+    import math
+
+    from horovod_tpu.core.autotune import default_knobs
+
+    assert default_knobs(Config(bucket_cap_bytes=4 * MB))[0].hi == \
+        math.log2(4 * MB)
+    assert default_knobs(Config(bucket_cap_bytes=0))[0].hi == \
+        math.log2(256 * MB)

@@ -1,0 +1,71 @@
+"""Fusion-cliff smoke (`make fusion-smoke`, docs/perf.md).
+
+ISSUE 6 acceptance: per-bucket latency across swept fusion thresholds is
+monotone-ish on the 8-rank virtual mesh — no >1.5x cliff between adjacent
+bucket sizes, where r05 measured ~2x from 4 MB to 16 MB. The shipped fix
+is the bucket cap + oversize chunking: 16/64 MB requests compile to the
+same ≤-cap bucket programs as 4 MB, so the cliff cannot reappear without
+this test naming the adjacent pair that regressed.
+
+Wall-clock and load-sensitive by nature, so the sweep interleaves passes
+(every threshold sees the same host-load profile) and takes medians, and
+the whole module rides the `perf` marker — excluded from tier-1, run by
+`make fusion-smoke` in CI.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd_mod
+
+MB = 1 << 20
+
+# ~12 MB mixed gradient set: conv-ish bodies + a small-tensor tail, the
+# same regime as the bench sweep but ~half the bytes for CI speed.
+_SIZES = [(512, 512, 3, 3)] + [(256, 256, 3, 3)] * 2 + \
+    [(128, 128, 3, 3)] * 2 + [(512,)] * 40 + [(256,)] * 40
+
+pytestmark = pytest.mark.perf
+
+
+def test_fusion_sweep_no_adjacent_cliff(hvd, monkeypatch):
+    from horovod_tpu.core import topology
+    from horovod_tpu.ops.collectives import clear_compiled_cache
+
+    monkeypatch.setenv("HOROVOD_NO_REPLICATED_FAST", "1")
+    cfg = topology.state().config
+    tensors = [jnp.ones(s, jnp.float32) for s in _SIZES]
+
+    def measure(calls=3):
+        outs = None
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            outs = hvd_mod.grouped_allreduce(tensors, op="sum",
+                                             name="fusion_smoke")
+        jax.block_until_ready(outs)
+        return (time.perf_counter() - t0) / calls * 1e3
+
+    thresholds = (1, 4, 16, 64)
+    passes = 5
+    samples = {mb: [] for mb in thresholds}
+    for p in range(passes):
+        for mb in thresholds:
+            monkeypatch.setattr(cfg, "fusion_threshold_bytes", mb * MB)
+            clear_compiled_cache()
+            measure(calls=1)  # compile + settle
+            if p == 0:
+                measure(calls=1)
+            samples[mb].append(measure())
+    med = {mb: float(np.median(xs)) for mb, xs in samples.items()}
+    ratios = {
+        f"{a}MB->{b}MB": max(med[a], med[b]) / max(min(med[a], med[b]), 1e-9)
+        for a, b in zip(thresholds, thresholds[1:])}
+    worst = max(ratios.values())
+    assert worst <= 1.5, (
+        f"fusion cliff between adjacent bucket sizes: {ratios} "
+        f"(medians {med} ms) — did the bucket cap/chunking regress?")

@@ -52,6 +52,24 @@ def test_autotune_broadcast_multiprocess(tmp_path):
         assert f"MP_WORKER_OK autotune_sync rank={rank}" in text
 
 
+def test_bucketed_allreduce_multiprocess(tmp_path):
+    text = run_scenarios(2, "bucketed", tmp_path)
+    for rank in range(2):
+        assert f"MP_WORKER_OK bucketed rank={rank}" in text
+
+
+def test_bucket_tuner_threshold_sync(tmp_path):
+    """ISSUE 6 acceptance: the online bucket tuner adjusts the fusion
+    threshold during a run with a bounded number of recompiles, and
+    every rank applies the SAME value — enforced live by the launcher's
+    consistency checker, since bucketed_allreduce's dispatch descriptor
+    embeds the effective threshold and plan fingerprint (a rank split
+    would raise TensorShapeMismatchError, failing the launch)."""
+    text = run_scenarios(2, "bucket_tuner_sync", tmp_path)
+    for rank in range(2):
+        assert f"MP_WORKER_OK bucket_tuner_sync rank={rank}" in text
+
+
 def test_worker_failure_propagates(tmp_path):
     """A worker that dies must fail the whole launch with its exit code
     (reference: gloo_run terminates all workers when one fails)."""

@@ -47,10 +47,12 @@ def _entry_instructions(hlo_text):
 
 def _dp_step(mesh, axes, width=4096):
     """A 6-layer MLP DP train step through the framework's in-jit
-    reduction, one psum bucket per layer (tiny threshold). Layers are
-    32 MB so the buckets survive XLA's all-reduce combiner — smaller
-    grads get merged into one tupled all-reduce, which is the combiner
-    doing its job but leaves nothing to interleave."""
+    reduction, one psum bucket per layer (threshold just above one
+    32 MB layer: each layer fills a bucket alone, and no layer is big
+    enough to chunk). Layers are 32 MB so the buckets survive XLA's
+    all-reduce combiner — smaller grads get merged into one tupled
+    all-reduce, which is the combiner doing its job but leaves nothing
+    to interleave."""
     from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
 
     nlayer = 6
@@ -66,7 +68,7 @@ def _dp_step(mesh, axes, width=4096):
 
         g = jax.grad(loss)(p)
         g = reduce_gradients_in_jit(g, axis=axes, num_ranks=8,
-                                    fusion_threshold_bytes=1)
+                                    fusion_threshold_bytes=33 * 2**20)
         return jax.tree_util.tree_map(
             lambda a, b: (a - 0.1 * b).astype(a.dtype), p, g)
 
