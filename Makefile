@@ -16,6 +16,11 @@
 #                    SIGKILL) asserting the doctor names the stalled
 #                    rank and the last-agreed collective
 #                    (docs/observability.md, docs/troubleshooting.md)
+#   make perf-gate   perfscope CI sentinel: emit StepProfiles from the
+#                    synthetic workloads and gate them against the
+#                    checked-in scripts/perf_baseline.json (structure
+#                    assertions on CPU hosts; numeric tolerances only
+#                    under HOROVOD_PERF_GATE_NUMERIC=1 — docs/perf.md)
 #   make lint        hvdlint static analysis: collective-consistency +
 #                    concurrency rules + env-knob docs drift, gating on
 #                    findings NEW relative to the checked-in baseline
@@ -31,9 +36,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline metrics race doctor-smoke fusion-smoke
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline metrics race doctor-smoke fusion-smoke perf-gate
 
-test: lint test-unit test-multiprocess test-e2e chaos doctor-smoke fusion-smoke entry
+test: lint test-unit test-multiprocess test-e2e chaos doctor-smoke fusion-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -58,11 +63,22 @@ metrics:
 	$(PYTEST) tests/test_metrics.py tests/test_metrics_e2e.py \
 	    tests/test_timeline.py
 
-# Flight recorder + hvddoctor (docs/observability.md): the unit suite
-# runs in tier 1 too; the e2e chaos jobs (faults marker) only run here.
+# Flight recorder + hvddoctor (docs/observability.md): the unit suites
+# run in tier 1 too; the e2e chaos jobs (faults marker) only run here.
+# test_perfscope_e2e rides along: its slow-input straggler e2e is a
+# doctor acceptance (the perf section names the rank + dominant phase).
 doctor-smoke:
-	$(PYTEST) tests/test_flight.py
-	$(PYTEST) tests/test_flight_e2e.py --run-faults -m faults
+	$(PYTEST) tests/test_flight.py tests/test_perfscope.py
+	$(PYTEST) tests/test_flight_e2e.py tests/test_perfscope_e2e.py \
+	    --run-faults -m faults
+
+# perfscope CI sentinel (docs/perf.md): emit StepProfiles from the
+# synthetic CPU workloads and compare against the checked-in baseline.
+# Structure-only on CPU hosts; arm HOROVOD_PERF_GATE_NUMERIC=1 on a
+# dedicated perf host to enforce the step-time tolerance bands too.
+perf-gate:
+	$(PYTHON) scripts/perf_gate.py --run \
+	    --baseline scripts/perf_baseline.json
 
 # Fusion-cliff guard (docs/perf.md): interleaved threshold sweep on the
 # 8-rank virtual mesh asserting no >1.5x latency cliff between adjacent
@@ -87,7 +103,7 @@ lint-baseline:
 race:
 	env HOROVOD_RACE_CHECK=1 $(PYTEST) tests/test_race.py \
 	    tests/test_timeline.py tests/test_metrics.py \
-	    tests/test_flight.py \
+	    tests/test_flight.py tests/test_perfscope.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
 	    tests/test_hvdlint.py \
 	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache

@@ -30,31 +30,20 @@ from horovod_tpu.models import resnet
 from horovod_tpu.models import transformer as tfm
 from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
 from horovod_tpu.parallel.mesh import MeshSpec, build_mesh
+from horovod_tpu.profiler import flops as F
+from horovod_tpu.profiler import perfscope as pscope
 
 BASELINE_PER_CHIP = 1656.8 / 16  # images/sec/GPU, reference docs/benchmarks.rst:40-42
 
-# Peak dense bf16 TFLOP/s per chip by device kind (public specs). The
-# tunnel to this image's chip measures ~157 TFLOP/s on an 8k matmul, so
-# MFU against the spec peak is conservative.
-_PEAK_TFLOPS = {
-    "TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5litepod": 197.0,
-    "TPU v5": 459.0, "TPU v5p": 459.0, "TPU v6 lite": 918.0,
-    "TPU v6e": 918.0,
-}
-
 
 def peak_flops_per_chip():
-    env = os.environ.get("HOROVOD_BENCH_PEAK_TFLOPS")
-    if env:
-        return float(env) * 1e12
-    kind = jax.devices()[0].device_kind
-    for name, tf in _PEAK_TFLOPS.items():
-        if kind.startswith(name):
-            return tf * 1e12
-    return None  # unknown chip / CPU: omit MFU
+    """Peak dense bf16 FLOP/s (profiler/flops.py owns the spec table;
+    HOROVOD_BENCH_PEAK_TFLOPS overrides). None on unknown chip / CPU."""
+    return F.peak_flops_per_chip()
 
 
-def _scan_timed(local_body, state, chain, reps, warmup=2):
+def _scan_timed(local_body, state, chain, reps, warmup=2,
+                flops_out=None, profile_out=None, profile_steps=3):
     """Time `chain` training steps chained inside ONE compiled program
     (lax.scan), returning seconds per step via a latency-cancelling slope.
 
@@ -66,10 +55,38 @@ def _scan_timed(local_body, state, chain, reps, warmup=2):
     compute), so timing 1 call vs R calls and taking the slope
     (t_R − t_1)/((R−1)·chain) cancels the fixed cost exactly with a
     single compile. All arrays ride in the carry — closure-captured
-    constants are re-shipped through the tunnel on every call."""
-    body = jax.jit(lambda s: lax.scan(
+    constants are re-shipped through the tunnel on every call.
+
+    `flops_out` (dict): filled with the XLA cost-analysis FLOPs of the
+    compiled program, per step (`program_flops_per_step`, per
+    participating device — the SPMD module is per-device code). The
+    program is compiled ONCE via AOT lower+compile and that same
+    executable is what gets timed, so the cost analysis is free and
+    describes exactly the program that ran (profiler/flops.py).
+    HOROVOD_PERFSCOPE_XLA_FLOPS=0 skips it (hand-constant fallbacks
+    take over, docs/perf.md).
+
+    `profile_out` (dict): filled with a perfscope summary
+    (`{"summary": ...}`) from `profile_steps` individually-synced extra
+    calls — per-step wall percentiles plus the dispatch /
+    device_compute phase split. Synced calls pay the fixed tunnel
+    round-trip the slope cancels, so these walls sit ABOVE the slope
+    number; they are the observed per-step distribution, not the
+    marginal cost."""
+    jbody = jax.jit(lambda s: lax.scan(
         lambda c, _: (local_body(c), ()), s, None, length=chain)[0],
         donate_argnums=(0,))  # alias carry in/out: no double-buffered params
+    body = jbody
+    if flops_out is not None and F.xla_flops_enabled():
+        try:
+            compiled = jbody.lower(state).compile()
+            total = F.compiled_cost_flops(compiled)
+            if total:
+                flops_out["program_flops_per_step"] = total / chain
+                flops_out["source"] = "xla"
+            body = compiled  # reuse: one compile for analysis AND timing
+        except Exception:
+            body = jbody  # AOT path unavailable: timing still works
 
     def sync(s):
         # block + read back a DERIVED SCALAR of the first leaf: the tiny
@@ -106,19 +123,66 @@ def _scan_timed(local_body, state, chain, reps, warmup=2):
         if slope > 0:
             best = min(best, slope)
         fallback = min(fallback, tn / ((1 + extra) * chain))
+    if profile_out is not None:
+        ps = pscope.get()
+        ps.reset()
+        for _ in range(max(profile_steps, 2)):
+            # weight=chain: one call is `chain` training steps — the
+            # scope divides wall and phases back to per-step.
+            with ps.step(weight=chain):
+                state = body(state)
+                with ps.phase("device_compute"):
+                    sync(state)
+        s = ps.summary()
+        if s:
+            profile_out["summary"] = s
     # all slopes non-positive (residual warmup/jitter): report the
     # amortized per-step time — an UPPER bound (includes ~1/(1+extra) of
     # the fixed tunnel cost), never a negative rate
     return best if best != float("inf") else fallback
 
 
+def _perf_stamp(r, name, flops_info, prof, fallback_flops_per_step):
+    """Attach the section's StepProfile (docs/perf.md) to its result
+    dict: per-step wall percentiles, the perfscope phase breakdown, and
+    MFU with its source — "xla" when the FLOPs came from cost analysis
+    of the program that actually ran, "fallback" when only the hand
+    constants (profiler/flops.py) were available.
+
+    Convention note: the StepProfile compares XLA FLOPs against the
+    "flops" (mul+add) fallback convention; the section's legacy `mfu`
+    field keeps the historical MAC-based constants for round-over-round
+    BENCH comparability (flops.py module docstring)."""
+    if r is None:
+        return r
+    xla = flops_info.get("program_flops_per_step")
+    flops_per_step, source = F.pick_flops(xla, fallback_flops_per_step)
+    sp = {"name": name, "perfscope": pscope.SUMMARY_VERSION}
+    summary = prof.get("summary") or {}
+    sp.update(summary)
+    sp["model_flops_per_step"] = flops_per_step
+    sp["mfu_source"] = source
+    if xla and fallback_flops_per_step:
+        sp["xla_vs_fallback_flops_ratio"] = round(
+            xla / fallback_flops_per_step, 3)
+    peak = F.peak_flops_per_chip()
+    wall = summary.get("wall") or {}
+    mean = wall.get("mean_s")
+    if peak and flops_per_step and mean:
+        sp["peak_flops_per_chip"] = peak
+        sp["mfu"] = round(flops_per_step / mean / peak, 4)
+    r["perfscope"] = sp
+    r["mfu_source"] = source
+    if wall:
+        r["step_time_percentiles_ms"] = {
+            k: round(wall[f"{k}_s"] * 1e3, 2)
+            for k in ("mean", "p50", "p95", "max")}
+    return r
+
+
 # --------------------------------------------------------------------------
 # ResNet-50 (the reference's own headline model)
 # --------------------------------------------------------------------------
-
-# Forward GFLOP/image @224 (torchvision multiply-add convention, matching
-# the 4.1 GFLOP ResNet-50 number the roofline doc uses); training step ≈ 3×.
-_RESNET_FWD_GFLOPS = {50: 4.1, 101: 7.8, 152: 11.5}
 
 
 def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
@@ -160,13 +224,18 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
 
     state = (params, stats, opt_state, images, labels, jnp.zeros(()))
     chain = max(steps // 3, 1)
+    flops_info, prof = {}, {}
     sec_per_step = _scan_timed(body, state, chain=chain,
-                               reps=3, warmup=max(warmup // 2, 1))
+                               reps=3, warmup=max(warmup // 2, 1),
+                               flops_out=flops_info, profile_out=prof)
 
     ips = batch / sec_per_step
-    # Training FLOPs ≈ 3× forward (fwd + 2×bwd).
-    flops_per_img = _RESNET_FWD_GFLOPS[depth] * 3e9 if not on_cpu else None
-    return {
+    # Training FLOPs ≈ 3× forward. MAC convention (flops.py) — the
+    # historical BENCH numbers; the StepProfile compares XLA against
+    # the mul+add variant.
+    flops_per_img = F.resnet_train_flops_per_image(depth, "macs") \
+        if not on_cpu else None
+    r = {
         "images_per_sec_per_chip": round(ips / k, 2),
         "per_chip_batch": per_chip_batch,
         "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
@@ -174,6 +243,12 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
         "model_flops_per_image": flops_per_img,
         "timing": f"slope over calls of a {chain}-step device-side scan",
     }
+    # CPU smoke shrinks the image to 32px — the @224 constants would be
+    # ~50x off there, so the fallback (and the vs-XLA ratio) is TPU-only.
+    return _perf_stamp(
+        r, f"resnet{depth}", flops_info, prof,
+        None if on_cpu else
+        F.resnet_train_flops_per_image(depth, "flops") * per_chip_batch)
 
 
 def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
@@ -221,14 +296,23 @@ def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
         return (p, s, o, im, lb, l)
 
     state = (params, stats, opt_state, images, labels, jnp.zeros(()))
+    flops_info, prof = {}, {}
     sec = _scan_timed(body, state, chain=max(steps // 3, 1), reps=3,
-                      warmup=warmup)
-    # Inception V3 fwd @299 ≈ 5.73 GFLOP/img (torchvision multiply-add
-    # convention, same as the ResNet numbers) → training step ≈ 3×.
-    return {"images_per_sec_per_chip": round(b / sec, 2),
-            "per_chip_batch": b, "image_size": img,
-            "step_ms": round(sec * 1e3, 2),
-            "model_flops_per_image": 17.2e9 if not on_cpu else None}
+                      warmup=warmup, flops_out=flops_info,
+                      profile_out=prof)
+    # Inception V3 fwd @299 ≈ 5.73 GMAC/img (torchvision convention,
+    # flops.py) → training step ≈ 3×.
+    r = {"images_per_sec_per_chip": round(b / sec, 2),
+         "per_chip_batch": b, "image_size": img,
+         "step_ms": round(sec * 1e3, 2),
+         "model_flops_per_image":
+             F.inception_v3_train_flops_per_image("macs")
+             if not on_cpu else None}
+    # @299 constants vs the 80px CPU smoke: fallback is TPU-only.
+    return _perf_stamp(
+        r, "inception_v3", flops_info, prof,
+        None if on_cpu else
+        F.inception_v3_train_flops_per_image("flops") * b)
 
 
 # --------------------------------------------------------------------------
@@ -335,13 +419,17 @@ def bench_vgg16(mesh, k, steps=12, warmup=2):
         return (p, o, im, lb, l)
 
     state = (params, opt_state, images, labels, jnp.zeros(()))
+    flops_info, prof = {}, {}
     sec = _scan_timed(body, state, chain=max(steps // 3, 1), reps=3,
-                      warmup=warmup)
-    # VGG-16 fwd @224 ≈ 15.5 GFLOP/img → fwd+bwd ≈ 46.4 GFLOP/img
-    return {"images_per_sec_per_chip": round(b / sec, 2),
-            "per_chip_batch": b, "image_size": img,
-            "step_ms": round(sec * 1e3, 2),
-            "model_flops_per_image": 46.4e9}
+                      warmup=warmup, flops_out=flops_info,
+                      profile_out=prof)
+    # VGG-16 fwd @224 ≈ 15.5 GMAC/img (flops.py) → train ≈ 3×.
+    r = {"images_per_sec_per_chip": round(b / sec, 2),
+         "per_chip_batch": b, "image_size": img,
+         "step_ms": round(sec * 1e3, 2),
+         "model_flops_per_image": F.vgg16_train_flops_per_image("macs")}
+    return _perf_stamp(r, "vgg16", flops_info, prof,
+                       F.vgg16_train_flops_per_image("flops") * b)
 
 
 def bench_transformer(on_cpu, steps, warmup):
@@ -377,27 +465,31 @@ def bench_transformer(on_cpu, steps, warmup):
 
     state = (params, opt_state, tokens, targets, jnp.zeros(()))
     chain = max(steps // 3, 1)
+    flops_info, prof = {}, {}
     sec = _scan_timed(body, state, chain=chain, reps=3,
-                      warmup=max(warmup // 2, 1))
+                      warmup=max(warmup // 2, 1), flops_out=flops_info,
+                      profile_out=prof)
     dt, steps = sec * steps, steps  # keep downstream arithmetic unchanged
 
-    # Analytical model FLOPs (the standard 6N + attention accounting):
-    # matmul params (non-embedding) N ≈ layers·(4·D² attn + 2·D·F ffn),
-    # fwd+bwd ≈ 6·N per token; attention scores+values fwd+bwd ≈
-    # 12·L·S·D per token (causal halves it → 6·L·S·D).
-    D, F, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
-    n_matmul = L * (4 * D * D + 2 * D * F)
-    flops_tok = 6 * n_matmul + 6 * L * seq * D + 6 * D * V  # + unembed
+    # Analytical model FLOPs: the standard 6N + attention accounting
+    # (profiler/flops.py; PaLM appendix B) — counts mul+add separately,
+    # so directly comparable with the XLA cost analysis (remat makes the
+    # XLA number HIGHER: recomputed forwards are real executed FLOPs).
+    flops_tok = F.transformer_train_flops_per_token(
+        cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab, seq)
     toks = batch * seq
     tps = toks * steps / dt
-    return {
+    r = {
         "tokens_per_sec_per_chip": round(tps, 1),
-        "config": f"L{L} D{D} F{F} H{cfg.n_heads} S{seq} B{batch} "
-                  f"V{V} bf16",
+        "config": f"L{cfg.n_layers} D{cfg.d_model} F{cfg.d_ff} "
+                  f"H{cfg.n_heads} S{seq} B{batch} V{cfg.vocab} bf16",
         "step_ms": round(dt / steps * 1e3, 2),
         "model_flops_per_token": flops_tok,
-        "params_m": round((n_matmul + 2 * D * V) / 1e6, 1),
+        "params_m": round(F.transformer_matmul_params(
+            cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab) / 1e6, 1),
     }
+    return _perf_stamp(r, "transformer_lm", flops_info, prof,
+                       flops_tok * toks)
 
 
 def _slope_ms(run, k, reps=2):
@@ -472,6 +564,13 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
         return opt.step(g, params, state)[1], l
 
     out = {}
+    # Model FLOPs for the StepProfile: XLA cost analysis of the jitted
+    # fwd+bwd when available (one extra compile of a small program),
+    # else the analytic 6N fallback.
+    xla_flops = F.jit_cost_flops(grad_fn, params) \
+        if F.xla_flops_enabled() else None
+    fallback_flops = F.transformer_train_flops_per_token(
+        cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab, seq) * batch * seq
     for name, opt in (("adasum", dist_opt), ("predivide", pre_opt)):
         state = opt.init(params)
         for _ in range(warmup):
@@ -499,6 +598,23 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
         dt = _slope_ms(run, steps) / 1e3
         out[f"{name}_samples_per_sec"] = round(batch / dt, 2)
         out[f"{name}_step_ms"] = round(dt * 1e3, 2)
+        if name == "adasum":
+            # perfscope sampling on the eager migration path: explicit
+            # synced steps so the auto-hooked DistributedOptimizer
+            # phases (comms / optimizer) land inside them.
+            ps = pscope.get()
+            ps.reset()
+            for _ in range(2 if on_cpu else 3):
+                with ps.step():
+                    state, l = one(opt, state)
+                    with ps.phase("device_compute"):
+                        jax.block_until_ready(state)
+            s = ps.summary()
+            prof = {"summary": s} if s else {}
+            _perf_stamp(out, "bert_base_finetune",
+                        {"program_flops_per_step": xla_flops}
+                        if xla_flops else {},
+                        prof, fallback_flops)
     out["config"] = f"L{cfg.n_layers} D{cfg.d_model} H{cfg.n_heads} " \
                     f"S{seq} B{batch} (BERT-base shape)"
     return out
@@ -907,7 +1023,8 @@ def main():
         deadline = time.monotonic() + budget
         while True:
             health = _section("device_health", _device_health, retries=0)
-            if health is None or health["matmul_tflops"] >= 80.0 \
+            if health is None \
+                    or health["matmul_tflops"] >= F.HEALTHY_MATMUL_TFLOPS \
                     or time.monotonic() >= deadline:
                 break
             print(f"[bench] device degraded "
@@ -915,7 +1032,8 @@ def main():
                   f"{health['fixed_call_latency_ms']:.0f} ms/call tunnel "
                   f"latency); waiting 90s", flush=True)
             time.sleep(90)
-    degraded = bool(health and health["matmul_tflops"] < 80.0)
+    degraded = bool(health
+                    and health["matmul_tflops"] < F.HEALTHY_MATMUL_TFLOPS)
     measured = health["matmul_tflops"] * 1e12 if health else None
 
     def stamp(r, name):

@@ -85,6 +85,16 @@ def metrics_text() -> str:
     return m.registry().render(topology.rank_or_none())
 
 
+def perfscope():
+    """The process-wide step-phase profiler (profiler/perfscope.py,
+    docs/perf.md): delimit steps with `with hvd.perfscope().step():` and
+    mark host input waits with `.phase("input_wait")`; comms, compile
+    and optimizer time are attributed automatically through
+    `DistributedOptimizer`. A no-op shell under HOROVOD_PERFSCOPE=0."""
+    from horovod_tpu.profiler import perfscope as _ps
+    return _ps.get()
+
+
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
     """Start runtime timeline capture (reference: operations.cc:1077)."""
     from horovod_tpu.profiler.timeline import Timeline

@@ -62,6 +62,7 @@ HOROVOD_RACE_CHECK_MAX_REPORTS = "HOROVOD_RACE_CHECK_MAX_REPORTS"
 #: detector is enabled — the multithreaded coordination core.
 DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.profiler.timeline",
+    "horovod_tpu.profiler.perfscope",
     "horovod_tpu.observability.metrics",
     "horovod_tpu.observability.flight",
     "horovod_tpu.elastic.driver",

@@ -24,6 +24,17 @@ def _env_bool(name: str, default: bool = False) -> bool:
     return v.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _env_on(name: str, default: bool) -> bool:
+    """Like _env_bool, but an empty/whitespace value also keeps the
+    default — the convention for the always-on subsystem gates
+    (HOROVOD_FLIGHT, HOROVOD_PERFSCOPE), where `VAR=` in a wrapper
+    script must not silently disable the subsystem."""
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
     if v is None or not v.strip():

@@ -82,6 +82,12 @@ def _reset(state: State) -> None:
         os.environ["HOROVOD_ELASTIC_ROUND"] = str(new_round)
         notifier.advance(new_round)
         flight.set_round(new_round, assignment["rank"])
+        # Drop the perfscope window too: ranks are reassigned across
+        # rounds, and the next KV push keys by the NEW (rank, round) —
+        # carried-over samples would attribute the old round's phases
+        # to a rank that never ran them (profiler/perfscope.py).
+        from horovod_tpu.profiler import perfscope
+        perfscope.get().reset()
         flight.record("elastic",
                       f"adopted round {new_round}: rank="
                       f"{assignment['rank']} size={assignment['size']}")

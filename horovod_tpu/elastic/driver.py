@@ -592,9 +592,13 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
         # Persist the flight tails workers pushed into the KV before the
         # server (and the tails with it) disappears: a SIGKILL'd
         # worker's only surviving record lives here. Then point the
-        # operator at the doctor when the job failed.
+        # operator at the doctor when the job failed. The perfscope
+        # step-time summaries ride the same exit path (doctor's perf
+        # section, profiler/perfscope.py).
         from horovod_tpu.observability import flight
+        from horovod_tpu.profiler import perfscope
         tails = flight.persist_kv_tails(rdv)
+        perfscope.persist_kv_summaries(rdv)
         flight_dir = os.environ.get(flight.FLIGHT_DIR_ENV, "")
         if rc != 0 and flight_dir and (
                 tails or os.path.isdir(flight_dir)):

@@ -32,6 +32,15 @@ set (headline: the world set):
 * per-process event tails, and optionally a Perfetto-compatible trace
   (`--trace`) with one track per process.
 
+When perfscope step-time summaries are present (`perf-rank-<r>.json`
+files persisted by the launcher, or the live `perf/` KV scope — see
+profiler/perfscope.py), the report gains a **perf section**: per-rank
+mean/p95 step time with its phase breakdown, and straggler attribution
+by *local* time (wall minus peer-wait phases — in a synchronous job
+every rank's wall time matches; only the split names the culprit), each
+straggler tagged with its dominant phase (`input_wait`, `dispatch`,
+`optimizer`, ...).
+
 See docs/troubleshooting.md for a worked read-through of a report.
 """
 
@@ -157,15 +166,16 @@ def load_dir(d: str) -> List[RankDump]:
     return dumps
 
 
-def load_kv(addr: str, port: int, max_ranks: int = 256,
-            max_rounds: int = 64) -> List[RankDump]:
-    """Scrape `flight/rank-<r>.r<round>` tails from a live rendezvous
-    server.
+def _scan_kv(addr: str, port: int, scope: str, parse_fn,
+             max_ranks: int = 256, max_rounds: int = 64) -> List:
+    """Probe `<scope>/rank-<r>.r<round>` keys on a live rendezvous
+    server (shared by the flight-tail and perf-summary scrapes).
 
     Rounds 0..current (read from the driver's `elastic/round` key when
     present) are probed per rank with a consecutive-miss cutoff; once
-    any tail reveals the job size, exactly that rank range is covered.
-    """
+    any record reveals the job size, exactly that rank range is
+    covered. `parse_fn(raw, source)` returns a parsed record (with an
+    optional `size` attribute/key) or None."""
     from horovod_tpu.common.resilience import RetryPolicy
     from horovod_tpu.runner.rendezvous import KVClient
     kv = KVClient(addr, port, retry_policy=RetryPolicy(max_attempts=1),
@@ -177,7 +187,7 @@ def load_kv(addr: str, port: int, max_ranks: int = 256,
             top_round = min(int(raw.decode()), max_rounds)
     except Exception:
         pass
-    dumps: List[RankDump] = []
+    out: List = []
     known_size: Optional[int] = None
     for rnd in range(top_round + 1):
         misses = 0
@@ -186,26 +196,184 @@ def load_kv(addr: str, port: int, max_ranks: int = 256,
             if known_size is not None and r >= known_size:
                 break
             try:
-                raw = kv.get(SCOPE, f"rank-{r}.r{rnd}", timeout=0.0)
+                raw = kv.get(scope, f"rank-{r}.r{rnd}", timeout=0.0)
             except Exception as e:
                 print(f"doctor: KV scrape failed at rank {r}: {e}",
                       file=sys.stderr)
-                return dumps
+                return out
             if raw is None:
                 misses += 1
                 if known_size is None and misses >= 8:
                     break
             else:
                 misses = 0
-                dump = _parse_dump(raw, f"kv:{SCOPE}/rank-{r}.r{rnd}",
-                                   tail_only=True)
-                if dump is not None:
-                    dumps.append(dump)
-                    if dump.size and known_size is None:
-                        known_size = dump.size
+                rec = parse_fn(raw, f"kv:{scope}/rank-{r}.r{rnd}")
+                if rec is not None:
+                    out.append(rec)
+                    size = rec.size if hasattr(rec, "size") \
+                        else rec.get("size")
+                    if size and known_size is None:
+                        known_size = size
             r += 1
         known_size = None  # sizes differ per round
-    return dumps
+    return out
+
+
+def load_kv(addr: str, port: int, max_ranks: int = 256,
+            max_rounds: int = 64) -> List[RankDump]:
+    """Scrape `flight/rank-<r>.r<round>` tails from a live rendezvous
+    server."""
+    return _scan_kv(
+        addr, port, SCOPE,
+        lambda raw, src: _parse_dump(raw, src, tail_only=True),
+        max_ranks=max_ranks, max_rounds=max_rounds)
+
+
+def load_perf_dir(d: str) -> List[Dict[str, Any]]:
+    """Parse the perfscope summaries the launcher persisted
+    (`perf-rank-<r>.r<round>.json`, profiler/perfscope.py)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("perf-") or not name.endswith(".json") \
+                or ".tmp" in name:
+            continue
+        try:
+            with open(os.path.join(d, name), "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        rec = _parse_perf(raw, name)
+        if rec is not None:
+            out.append(rec)
+    return out
+
+
+def _parse_perf(raw: bytes, source: str) -> Optional[Dict[str, Any]]:
+    try:
+        body = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not (isinstance(body, dict) and body.get("perfscope")
+            and body.get("summary")):
+        return None
+    from horovod_tpu.profiler.perfscope import SUMMARY_VERSION
+    try:
+        version = int(body["perfscope"])
+    except (TypeError, ValueError):
+        version = SUMMARY_VERSION + 1
+    if version > SUMMARY_VERSION:
+        # Same contract as _parse_dump: a newer schema's field shapes
+        # are unknown — skipping beats crashing the whole analysis or
+        # electing stragglers from misread fields.
+        print(f"doctor: {source}: perf summary version "
+              f"{body.get('perfscope')} is newer than this tool "
+              f"understands; skipping", file=sys.stderr)
+        return None
+    return body
+
+
+def load_perf_kv(addr: str, port: int, max_ranks: int = 256,
+                 max_rounds: int = 64) -> List[Dict[str, Any]]:
+    """Scrape `perf/rank-<r>.r<round>` summaries from a live rendezvous
+    server (same probing shape as the flight-tail scrape)."""
+    from horovod_tpu.profiler.perfscope import SCOPE as PERF_SCOPE
+    return _scan_kv(addr, port, PERF_SCOPE, _parse_perf,
+                    max_ranks=max_ranks, max_rounds=max_rounds)
+
+
+def dedupe_perf(summaries: List[Dict[str, Any]]
+                ) -> List[Dict[str, Any]]:
+    """One summary per (rank, round) — keep the one covering the most
+    steps (summaries are cumulative, so more steps = later)."""
+    best: Dict[Tuple, Dict[str, Any]] = {}
+    for s in summaries:
+        if s.get("rank") is None:
+            continue
+        key = (int(s["rank"]), int(s.get("round", 0) or 0))
+        cur = best.get(key)
+        if cur is None or (s.get("summary", {}).get("steps", 0)
+                           > cur.get("summary", {}).get("steps", 0)):
+            best[key] = s
+    return [best[k] for k in sorted(best)]
+
+
+#: A rank is a perf straggler when its local step time exceeds the
+#: cross-rank median by this factor (and by an absolute floor that
+#: keeps microsecond-scale noise from electing one).
+PERF_STRAGGLER_RATIO = 1.25
+PERF_STRAGGLER_FLOOR_S = 0.005
+
+
+def analyze_perf(summaries: List[Dict[str, Any]]
+                 ) -> Optional[Dict[str, Any]]:
+    """Cross-rank straggler attribution from perfscope summaries.
+
+    Compares each rank's *local* mean step time (wall minus peer-wait
+    phases): in a synchronous data-parallel job the wall time of every
+    rank converges to the slowest one's — the fast ranks just park the
+    difference in `comms` — so only local time separates the rank that
+    *causes* the step time from the ranks that wait for it. Stragglers
+    are named with their dominant local phase (the ISSUE 7 acceptance:
+    a slow input pipeline comes out as `input_wait`)."""
+    if not summaries:
+        return None
+    rounds: Dict[int, Dict[int, Dict[str, Any]]] = {}
+    for s in summaries:
+        rounds.setdefault(int(s.get("round", 0) or 0), {})[
+            int(s["rank"])] = s
+    out_rounds: Dict[str, Any] = {}
+    stragglers: List[Dict[str, Any]] = []
+    for rnd in sorted(rounds):
+        ranks = rounds[rnd]
+        per_rank: Dict[str, Any] = {}
+        locals_: Dict[int, float] = {}
+        for r in sorted(ranks):
+            sm = ranks[r].get("summary", {})
+            wall = sm.get("wall", {})
+            local = float(sm.get("local_mean_s") or 0.0)
+            locals_[r] = local
+            per_rank[str(r)] = {
+                "steps": sm.get("steps"),
+                "mean_step_s": wall.get("mean_s"),
+                "p95_step_s": wall.get("p95_s"),
+                "local_mean_s": local,
+                "dominant_phase": sm.get("dominant_phase"),
+                "dominant_local_phase": sm.get("dominant_local_phase"),
+                "phase_fractions": sm.get("phase_fractions", {}),
+                "mfu": sm.get("mfu"),
+                "mfu_source": sm.get("mfu_source"),
+            }
+        vals = sorted(locals_.values())
+        # LOWER median: with 2 ranks the upper-middle element IS the
+        # straggler's own value, which could never exceed itself.
+        med = vals[(len(vals) - 1) // 2]
+        rnd_stragglers = []
+        if len(locals_) > 1:
+            for r, local in sorted(locals_.items()):
+                if local > med * PERF_STRAGGLER_RATIO \
+                        and local - med > PERF_STRAGGLER_FLOOR_S:
+                    entry = {
+                        "round": rnd,
+                        "rank": r,
+                        "local_mean_s": local,
+                        "slowdown_vs_median": (local / med) if med > 0
+                        else None,
+                        "dominant_phase":
+                            per_rank[str(r)]["dominant_local_phase"],
+                    }
+                    rnd_stragglers.append(entry)
+                    stragglers.append(entry)
+        out_rounds[f"r{rnd}"] = {
+            "round": rnd,
+            "ranks": per_rank,
+            "median_local_s": med,
+            "stragglers": rnd_stragglers,
+        }
+    return {"rounds": out_rounds, "stragglers": stragglers}
 
 
 def dedupe(dumps: List[RankDump]) -> List[RankDump]:
@@ -312,7 +480,8 @@ def analyze_group(round_id: int, gid: int, dumps: List[RankDump]
     }
 
 
-def merge(dumps: List[RankDump], tail: int = 8) -> Dict[str, Any]:
+def merge(dumps: List[RankDump], tail: int = 8,
+          perf: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
     size = max((d.size for d in dumps if d.size), default=None)
     seen_ranks: set = set()
     for d in dumps:
@@ -339,6 +508,7 @@ def merge(dumps: List[RankDump], tail: int = 8) -> Dict[str, Any]:
         "missing_ranks": missing,
         "triggers": {f"{d.rank}@r{d.round}": d.trigger for d in dumps},
         "groups": groups,
+        "perf": analyze_perf(dedupe_perf(perf)) if perf else None,
         "per_rank": {},
     }
     for d in dumps:
@@ -434,6 +604,40 @@ def render(report: Dict[str, Any], tail: int = 8) -> str:
                 and not g["missing"]:
             add("  all ranks in step at the end of the recorded window")
         add("")
+    perf = report.get("perf")
+    if perf:
+        add("[perf] step-time summaries (perfscope; local = wall minus "
+            "peer-wait phases)")
+        for _, rd in sorted(perf["rounds"].items(),
+                            key=lambda kv: kv[1]["round"]):
+            rnd = "" if rd["round"] == 0 else f" round {rd['round']}"
+            for r, info in sorted(rd["ranks"].items(),
+                                  key=lambda kv: int(kv[0])):
+                mean = info.get("mean_step_s")
+                p95 = info.get("p95_step_s")
+                mfu = info.get("mfu")
+                line = (f"  rank {r}{rnd}: "
+                        f"{(mean or 0) * 1e3:.1f} ms/step mean "
+                        f"(p95 {(p95 or 0) * 1e3:.1f} ms), local "
+                        f"{info['local_mean_s'] * 1e3:.1f} ms, dominant "
+                        f"phase {info.get('dominant_phase')}")
+                if mfu is not None:
+                    line += (f", mfu {mfu:.3f} "
+                             f"({info.get('mfu_source')})")
+                add(line)
+            for s in rd["stragglers"]:
+                ratio = s["slowdown_vs_median"]
+                # None when the median local time is 0 (degenerate
+                # summaries) — the straggler is still worth naming.
+                by = f"{ratio:.2f}x the median local step time" \
+                    if ratio is not None else \
+                    "the only rank with local step time"
+                add(f"  PERF STRAGGLER rank {s['rank']}{rnd}: {by}; "
+                    f"dominant phase: {s['dominant_phase']}")
+            if not rd["stragglers"] and len(rd["ranks"]) > 1:
+                add(f"  no perf straggler{rnd}: local step times within "
+                    f"{PERF_STRAGGLER_RATIO}x of the median")
+        add("")
     for key, info in report["per_rank"].items():
         kind = "KV tail" if info["tail_only"] else "full dump"
         rnd = "" if info["round"] == 0 else f" @ round {info['round']}"
@@ -520,8 +724,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     loaded: List[RankDump] = []
+    perf: List[Dict[str, Any]] = []
     if args.dir:
         loaded.extend(load_dir(args.dir))
+        perf.extend(load_perf_dir(args.dir))
     if args.kv:
         addr, _, port = args.kv.rpartition(":")
         if not addr or not port.isdigit():
@@ -529,16 +735,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
         loaded.extend(load_kv(addr, int(port), max_ranks=args.max_ranks))
+        perf.extend(load_perf_kv(addr, int(port),
+                                 max_ranks=args.max_ranks))
     if not args.dir and not args.kv:
         build_parser().print_help(sys.stderr)
         return 2
     dumps = dedupe(loaded)
-    if not dumps:
+    if not dumps and not perf:
         print("doctor: no flight dumps found (is HOROVOD_FLIGHT_DIR set "
               "on the job, or the rendezvous server still up?)",
               file=sys.stderr)
         return 2
-    report = merge(dumps, tail=args.tail)
+    report = merge(dumps, tail=args.tail, perf=perf)
     if args.trace:
         export_trace(dumps, args.trace)
         print(f"doctor: wrote merged trace to {args.trace}",

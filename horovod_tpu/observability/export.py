@@ -160,6 +160,15 @@ class MetricsExporter:
                 flight.push_tail()
             except Exception:
                 pass
+            # Same cadence for the perfscope step-time summary
+            # (profiler/perfscope.py): the launcher persists the perf/
+            # scope at job end, giving hvddoctor its straggler-with-
+            # dominant-phase perf section.
+            try:
+                from horovod_tpu.profiler import perfscope
+                perfscope.push_summary()
+            except Exception:
+                pass
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> None:

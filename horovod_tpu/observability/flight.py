@@ -88,11 +88,7 @@ class _Suppress:
         return False
 
 
-def _env_on(name: str, default: bool) -> bool:
-    v = os.environ.get(name)
-    if v is None or not v.strip():
-        return default
-    return v.strip().lower() in ("1", "true", "yes", "on")
+from horovod_tpu.common.config import _env_on  # one copy of the gate parse
 
 
 class FlightRecorder:

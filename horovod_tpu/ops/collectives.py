@@ -55,6 +55,7 @@ from horovod_tpu.common.exceptions import (DuplicateNameError,
 from horovod_tpu.core import topology
 from horovod_tpu.core.process_sets import ProcessSet, global_process_set
 from horovod_tpu.observability import flight as _flight
+from horovod_tpu.profiler import perfscope as _pscope
 
 _AXIS = "hvd"
 
@@ -293,9 +294,16 @@ class _CompiledCache:
                 tl = topology.state().timeline
                 if tl is not None:
                     tl.span_begin(tag, "COMPILE")
-                    try:
-                        return fn(*args)
-                    finally:
+                t0 = time.perf_counter()
+                try:
+                    return fn(*args)
+                finally:
+                    # Step-phase attribution (profiler/perfscope.py):
+                    # the cache miss's trace+compile is `compile` time,
+                    # not whatever phase the step happened to be in.
+                    _pscope.attribute("compile",
+                                      time.perf_counter() - t0)
+                    if tl is not None:
                         tl.span_end(tag, "COMPILE")
             return fn(*args)
 
@@ -1798,11 +1806,14 @@ class _instrument:
 
     Byte counts are computed lazily — from `arrays` (already-lifted
     global payloads) or `nbytes_fn` (fast paths that never materialize a
-    global array) — only when metrics are enabled, so with
-    HOROVOD_METRICS=0 the hot path pays a single branch."""
+    global array) — only when metrics are enabled, so with both
+    HOROVOD_METRICS=0 and HOROVOD_PERFSCOPE=0 the hot path pays a couple
+    of cheap gates and no clock reads. With perfscope live the window is
+    also attributed to the step's `comms` phase (minus whatever inner
+    hooks — a compile on a cache miss — already re-attributed)."""
 
     __slots__ = ("name", "activity", "arrays", "nbytes_fn", "ntensors",
-                 "tl", "enabled", "t0")
+                 "tl", "enabled", "ps", "timed", "t0", "attr_mark")
 
     def __init__(self, name: str, activity: str, arrays: Sequence = (),
                  nbytes_fn: Optional[Callable] = None,
@@ -1816,17 +1827,28 @@ class _instrument:
     def __enter__(self) -> "_instrument":
         from horovod_tpu.observability import metrics as m
         self.enabled = m.registry().enabled
+        self.ps = _pscope.get()
         self.tl = topology.state().timeline
         if self.tl is not None:
             self.tl.span_begin(self.name, self.activity)
-        self.t0 = time.perf_counter() if self.enabled else 0.0
+        # Clock reads only when someone consumes the window (metrics
+        # or a live perfscope) — the fully-disabled path stays free.
+        self.timed = self.enabled or self.ps is not _pscope.NOOP
+        if self.timed:
+            self.attr_mark = self.ps.attributed_marker()
+            self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
-        if self.enabled:
+        if self.timed:
             dt = time.perf_counter() - self.t0
         if self.tl is not None:
             self.tl.span_end(self.name, self.activity)
+        if self.timed:
+            # Step-phase attribution (profiler/perfscope.py): this
+            # window is `comms` time, minus nested re-attributions.
+            nested = self.ps.attributed_marker() - self.attr_mark
+            self.ps.attribute("comms", dt - nested)
         if self.enabled:
             _record(self.activity, self.arrays, self.nbytes_fn,
                     self.ntensors, dt, self.tl)

@@ -42,6 +42,7 @@ from horovod_tpu.core import topology
 from horovod_tpu.core.process_sets import ProcessSet, global_process_set
 from horovod_tpu.ops import collectives, fusion
 from horovod_tpu.ops.compression import Compression
+from horovod_tpu.profiler import perfscope as _pscope
 
 _AXIS = "hvd"
 
@@ -270,7 +271,31 @@ class DistributedOptimizer:
         With backward_passes_per_step > 1, gradients accumulate locally and
         the collective fires every Nth call (reference
         LocalGradientAggregationHelper.compute_gradients).
+
+        perfscope auto-hook (profiler/perfscope.py): when the user
+        delimited no explicit step, each call to this method closes one
+        implicit training step — step N runs from the end of optimizer
+        call N-1 to the end of call N — with the gradient reduction
+        attributed to the `comms` phase and the update/apply to
+        `optimizer`; everything in between (forward/backward dispatch,
+        input) lands in the base `dispatch` phase.
         """
+        scope = _pscope.get()
+        scope.step_entry()
+        try:
+            return self._step_inner(grads, params, opt_state, scope,
+                                    **update_extra)
+        finally:
+            # Accumulation-only calls (backward_passes_per_step > 1,
+            # collective not fired: _accum_count left non-zero) are
+            # micro-batches, not training steps — the implicit step
+            # stays open so one record spans the whole accumulation
+            # cycle and its comms/optimizer phases.
+            if self._accum_count == 0:
+                scope.step_boundary()
+
+    def _step_inner(self, grads: Any, params: Any, opt_state: Any,
+                    scope, **update_extra) -> Tuple[Any, Any]:
         if self.backward_passes_per_step > 1:
             if self._accum is None:
                 self._accum = grads
@@ -285,16 +310,19 @@ class DistributedOptimizer:
             self._accum = None
             self._accum_count = 0
 
-        avg = self._allreduce_grads(grads)
+        with scope.phase("comms"):
+            avg = self._allreduce_grads(grads)
         if update_extra or getattr(self, "_apply_eager", False):
             # extra kwargs (e.g. loss for lookahead-style transforms) are
             # rare and may not be jit-stable — eager fallback; also used
             # permanently for inner transforms that cannot trace
-            updates, new_state = self.inner.update(avg, opt_state, params,
-                                                   **update_extra)
-            return optax.apply_updates(params, updates), new_state
+            with scope.phase("optimizer"):
+                updates, new_state = self.inner.update(
+                    avg, opt_state, params, **update_extra)
+                return optax.apply_updates(params, updates), new_state
         try:
-            out = self._jitted_apply()(avg, opt_state, params)
+            with scope.phase("optimizer"):
+                out = self._jitted_apply()(avg, opt_state, params)
             # success means tracing worked; later errors of the caught
             # types are runtime failures, not traceability, and re-raise
             self._apply_traced_ok = True
@@ -313,8 +341,10 @@ class DistributedOptimizer:
                 "optimizer apply not jittable (%s); running eagerly",
                 type(e).__name__)
             self._apply_eager = True
-            updates, new_state = self.inner.update(avg, opt_state, params)
-            return optax.apply_updates(params, updates), new_state
+            with scope.phase("optimizer"):
+                updates, new_state = self.inner.update(avg, opt_state,
+                                                       params)
+                return optax.apply_updates(params, updates), new_state
 
     def _jitted_apply(self):
         """The optax update + apply as ONE compiled program.
@@ -340,8 +370,11 @@ class DistributedOptimizer:
     def update(self, grads: Any, opt_state: Any, params: Any = None,
                **extra) -> Tuple[Any, Any]:
         """optax-compatible update: returns (updates, new_opt_state)."""
-        avg = self._allreduce_grads(grads)
-        return self.inner.update(avg, opt_state, params, **extra)
+        scope = _pscope.get()
+        with scope.phase("comms"):
+            avg = self._allreduce_grads(grads)
+        with scope.phase("optimizer"):
+            return self.inner.update(avg, opt_state, params, **extra)
 
 
 # TF-parity alias (reference: DistributedGradientTape, tensorflow/__init__.py

@@ -613,9 +613,13 @@ def launch_static(np: int, host_spec: str, command: List[str],
             w.terminate()
         # Persist flight-recorder tails before the KV store vanishes: a
         # SIGKILL'd worker's last pushed tail only survives in the
-        # launcher's memory (observability/flight.py).
+        # launcher's memory (observability/flight.py). The perfscope
+        # step-time summaries ride the same exit path so the doctor's
+        # perf section works offline (profiler/perfscope.py).
         from horovod_tpu.observability import flight
+        from horovod_tpu.profiler import perfscope
         flight.persist_kv_tails(rdv)
+        perfscope.persist_kv_summaries(rdv)
         rdv.stop()
         if nkv is not None:
             nkv.stop()

@@ -1,0 +1,359 @@
+"""perf_gate: the CI perf-regression sentinel (docs/perf.md).
+
+Compares perfscope ``StepProfile`` records (profiler/perfscope.py)
+against a checked-in, noise-tolerant baseline
+(``scripts/perf_baseline.json``):
+
+* **structure assertions** always run — every baseline section must be
+  present, have recorded steps, a positive mean wall time, a phase
+  breakdown whose phases cover >=90% of the wall (the perfscope
+  invariant), the phases the section is expected to exhibit, and an
+  ``mfu_source`` from the allowed set. These hold on any host, so CI's
+  CPU runners gate them on every PR.
+* **numeric assertions** (mean step time within a relative tolerance
+  band) run only when explicitly armed — ``--numeric`` or
+  ``HOROVOD_PERF_GATE_NUMERIC=1`` — because absolute step times on a
+  shared CPU runner are noise. Arm them on dedicated perf hosts.
+
+Usage::
+
+    python scripts/perf_gate.py --run --baseline scripts/perf_baseline.json
+    python scripts/perf_gate.py --emit /tmp/cur.json
+    python scripts/perf_gate.py /tmp/cur.json --baseline scripts/perf_baseline.json
+    python scripts/perf_gate.py --run --baseline scripts/perf_baseline.json --update
+    python scripts/perf_gate.py BENCH_r06.json --bench
+
+``--emit`` runs two small synthetic workloads under perfscope on the CPU
+backend (seconds of wall clock): an eager-``DistributedOptimizer`` MLP
+step (exercises the auto-hooked ``comms``/``optimizer``/``compile``
+phases plus user-marked ``input_wait``/``device_compute``) and a jitted
+matmul scan with XLA cost-analysis FLOPs (``mfu_source == "xla"``).
+``--bench`` instead treats the input as a ``bench.py`` JSON line and
+structure-checks every section that carries a ``perfscope`` stamp.
+
+Exit codes: 0 gate passed, 1 regression/structure failure, 2 usage/IO.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# Standalone invocation (CI, `make perf-gate`): the repo root is the
+# import root for horovod_tpu.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: Phase-coverage floor: the perfscope switching-timer invariant makes
+#: phases sum to wall; anything below this means attribution broke.
+MIN_COVERAGE = 0.9
+
+DEFAULT_TOLERANCE = 1.0  # +-100% band when numeric checks are armed
+
+
+# ----------------------------------------------------------------- emit
+
+def _force_cpu():
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def emit_profiles() -> dict:
+    """Run the synthetic workloads and return the current-profiles doc."""
+    jax = _force_cpu()
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.profiler import flops as F
+    from horovod_tpu.profiler import perfscope as P
+
+    hvd.init()
+    sections = {}
+
+    # --- eager MLP through DistributedOptimizer (the auto-hooked path)
+    rng = np.random.default_rng(0)
+    D, B = 64, 32
+    w = {"w1": jnp.asarray(rng.standard_normal((D, D)) * 0.1, jnp.float32),
+         "w2": jnp.asarray(rng.standard_normal((D, D)) * 0.1, jnp.float32)}
+
+    def loss(p, batch):
+        x, y = batch
+        h = jnp.tanh(x @ p["w1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss))
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+    state = opt.init(w)
+    batch0 = (jnp.asarray(rng.standard_normal((B, D)), jnp.float32),
+              jnp.asarray(rng.standard_normal((B, D)), jnp.float32))
+    ps = P.get()
+    ps.reset()
+    xla = F.jit_cost_flops(grad_fn, w, batch0)
+    # Analytic fwd+bwd fallback for the 2-matmul MLP (mul+add counted).
+    ps.set_model_flops(*F.pick_flops(xla, 6.0 * 2 * D * D * B))
+    for i in range(8):
+        with ps.step():
+            with ps.phase("input_wait"):
+                batch = batch0  # synthetic input: the marker is the point
+            l, g = grad_fn(w, batch)
+            w, state = opt.step(g, w, state)
+            with ps.phase("device_compute"):
+                jax.block_until_ready(l)
+    sections["eager_mlp"] = ps.step_profile("eager_mlp")
+
+    # --- jitted matmul scan with XLA-derived FLOPs
+    m = jnp.asarray(rng.standard_normal((128, 128)) * 0.05, jnp.float32)
+    body = jax.jit(lambda s: jnp.tanh(s @ m))
+    ps.reset()
+    xla = F.jit_cost_flops(body, m)
+    ps.set_model_flops(*F.pick_flops(xla, 2.0 * 128 ** 3))
+    s = m
+    for _ in range(8):
+        with ps.step():
+            s = body(s)
+            with ps.phase("device_compute"):
+                jax.block_until_ready(s)
+    sections["scan_matmul"] = ps.step_profile("scan_matmul")
+
+    return {"perf_gate": 1,
+            "platform": jax.devices()[0].platform,
+            "sections": sections}
+
+
+# ---------------------------------------------------------------- check
+
+def _check_profile(name: str, prof: dict, spec: dict,
+                   numeric: bool) -> list:
+    errs = []
+    if not prof:
+        return [f"{name}: missing StepProfile"]
+    if not prof.get("steps"):
+        errs.append(f"{name}: no steps recorded")
+    wall = prof.get("wall") or {}
+    mean = wall.get("mean_s")
+    if not mean or mean <= 0:
+        errs.append(f"{name}: non-positive mean step time")
+    for k in ("p50_s", "p95_s", "max_s"):
+        if wall.get(k) is None:
+            errs.append(f"{name}: wall.{k} missing")
+    phases = prof.get("phases_s") or {}
+    if not phases:
+        errs.append(f"{name}: empty phase breakdown")
+    cov = prof.get("coverage")
+    if cov is None or cov < MIN_COVERAGE:
+        errs.append(f"{name}: phase coverage {cov} < {MIN_COVERAGE} "
+                    f"(phases must sum to >=90% of wall step time)")
+    for ph in spec.get("require_phases", []):
+        if ph not in phases:
+            errs.append(f"{name}: required phase {ph!r} absent "
+                        f"(got {sorted(phases)})")
+    allowed = spec.get("mfu_source")
+    if allowed and prof.get("mfu_source") not in allowed:
+        errs.append(f"{name}: mfu_source {prof.get('mfu_source')!r} "
+                    f"not in {allowed}")
+    base_mean = spec.get("wall_mean_s")
+    if numeric and base_mean:
+        tol = float(spec.get("tolerance", DEFAULT_TOLERANCE))
+        lo, hi = base_mean / (1.0 + tol), base_mean * (1.0 + tol)
+        if not (lo <= mean <= hi):
+            errs.append(
+                f"{name}: mean step {mean * 1e3:.2f} ms outside "
+                f"[{lo * 1e3:.2f}, {hi * 1e3:.2f}] ms "
+                f"(baseline {base_mean * 1e3:.2f} ms, tol {tol})")
+    return errs
+
+
+def compare(current: dict, baseline: dict, numeric: bool) -> list:
+    errs = []
+    sections = current.get("sections") or {}
+    for name, spec in (baseline.get("sections") or {}).items():
+        errs.extend(_check_profile(name, sections.get(name) or {},
+                                   spec, numeric))
+    return errs
+
+
+def check_bench(doc: dict) -> list:
+    """Structure-check every perfscope-stamped section of a bench.py
+    JSON line (the StepProfile acceptance: phases cover >=90% of wall).
+    Self-contained — no baseline involved."""
+    extra = doc.get("extra") or {}
+    errs = []
+    found = 0
+    for sec, val in sorted(extra.items()):
+        if not isinstance(val, dict) or "perfscope" not in val:
+            continue
+        prof = val["perfscope"]
+        if not isinstance(prof, dict) or not prof.get("steps"):
+            continue  # section ran without perfscope (env-disabled)
+        found += 1
+        errs.extend(_check_profile(
+            sec, prof,
+            {"mfu_source": ["xla", "fallback", "none"]}, numeric=False))
+    if not found:
+        errs.append("bench JSON carries no perfscope StepProfile "
+                    "(HOROVOD_PERFSCOPE=0 on the bench run?)")
+    return errs
+
+
+def baseline_from(current: dict) -> dict:
+    """Derive a fresh baseline doc from a current-profiles doc
+    (numeric gating stays opt-in; reference numbers are informational
+    until a host arms --numeric)."""
+    sections = {}
+    for name, prof in (current.get("sections") or {}).items():
+        phases = sorted((prof.get("phases_s") or {}).keys())
+        sections[name] = {
+            "require_phases": phases,
+            "mfu_source": ["xla", "fallback"],
+            "wall_mean_s": (prof.get("wall") or {}).get("mean_s"),
+            "tolerance": DEFAULT_TOLERANCE,
+        }
+    return {"perf_gate": 1,
+            "platform": current.get("platform"),
+            "note": "structure assertions always run; numeric "
+                    "tolerances only under --numeric / "
+                    "HOROVOD_PERF_GATE_NUMERIC=1 (CPU CI hosts are "
+                    "noise)",
+            "sections": sections}
+
+
+# ------------------------------------------------------------------ cli
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/perf_gate.py",
+        description="perfscope StepProfile regression gate "
+                    "(docs/perf.md)")
+    p.add_argument("current", nargs="?", default="",
+                   help="current-profiles JSON (from --emit) or, with "
+                        "--bench, a bench.py JSON line file")
+    p.add_argument("--baseline", default="",
+                   help="checked-in baseline (scripts/perf_baseline.json)")
+    p.add_argument("--emit", default="", metavar="PATH",
+                   help="run the synthetic workloads and write the "
+                        "current-profiles JSON here")
+    p.add_argument("--run", action="store_true",
+                   help="emit to a temp file and compare against "
+                        "--baseline in one go (make perf-gate)")
+    p.add_argument("--bench", action="store_true",
+                   help="treat `current` as bench.py output and "
+                        "structure-check its perfscope stamps")
+    p.add_argument("--numeric", action="store_true",
+                   help="arm the numeric tolerance checks "
+                        "(HOROVOD_PERF_GATE_NUMERIC=1 equivalent)")
+    p.add_argument("--update", action="store_true",
+                   help="write --baseline from the current profiles "
+                        "instead of gating")
+    args = p.parse_args(argv)
+    from horovod_tpu.common.config import _env_bool
+    numeric = args.numeric or _env_bool("HOROVOD_PERF_GATE_NUMERIC")
+
+    temp_out = ""
+    if args.emit or args.run:
+        current = emit_profiles()
+        out = args.emit
+        if not out:
+            fd, out = tempfile.mkstemp(prefix="hvd_perf_", suffix=".json")
+            os.close(fd)
+            temp_out = out  # ours to clean up (kept only on failure)
+        with open(out, "w") as f:
+            json.dump(current, f, indent=2)
+        print(f"perf_gate: wrote current profiles to {out}",
+              file=sys.stderr)
+        if not args.run and not args.update:
+            return 0
+    elif args.current:
+        try:
+            with open(args.current) as f:
+                text = f.read()
+        except OSError as e:
+            print(f"perf_gate: cannot read {args.current}: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.bench:
+            # Accept both shapes: the pretty-printed BENCH_rXX.json
+            # artifact (one document) and raw bench stdout (log lines
+            # around one compact JSON line — take the last such line).
+            try:
+                current = json.loads(text)
+            except ValueError:
+                lines = [ln for ln in text.splitlines()
+                         if ln.strip().startswith("{")]
+                current = None
+                for ln in reversed(lines):
+                    try:
+                        current = json.loads(ln)
+                        break
+                    except ValueError:
+                        continue
+                if not isinstance(current, dict):
+                    print("perf_gate: no JSON document in bench output",
+                          file=sys.stderr)
+                    return 2
+        else:
+            current = json.loads(text)
+    else:
+        p.print_help(sys.stderr)
+        return 2
+
+    if args.bench:
+        # Bench mode is self-contained structure checking — no baseline.
+        errs = check_bench(current)
+        for e in errs:
+            print(f"perf_gate: FAIL {e}", file=sys.stderr)
+        print(f"perf_gate: {'%d failure(s)' % len(errs) if errs else 'OK'}"
+              f" (bench StepProfile structure)", file=sys.stderr)
+        return 1 if errs else 0
+
+    if not args.baseline:
+        print("perf_gate: --baseline is required to gate",
+              file=sys.stderr)
+        return 2
+
+    if args.update:
+        doc = baseline_from(current)
+        tmp = f"{args.baseline}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, args.baseline)
+        print(f"perf_gate: baseline regenerated at {args.baseline} "
+              f"(review the diff before committing)", file=sys.stderr)
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: unreadable baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+
+    errs = compare(current, baseline, numeric)
+    if errs:
+        for e in errs:
+            print(f"perf_gate: FAIL {e}", file=sys.stderr)
+        print(f"perf_gate: {len(errs)} failure(s) vs {args.baseline}",
+              file=sys.stderr)
+        return 1  # temp profile kept for postmortem (path printed above)
+    if temp_out:
+        try:
+            os.unlink(temp_out)
+        except OSError:
+            pass
+    mode = "structure+numeric" if numeric else "structure-only"
+    print(f"perf_gate: OK ({mode} vs {args.baseline})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

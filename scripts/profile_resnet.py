@@ -14,8 +14,13 @@ from jax import lax
 
 from bench import _scan_timed  # ONE copy of the slope-timing logic
 from horovod_tpu.models import resnet
+from horovod_tpu.profiler import flops as F
 
-PEAK = 197e12
+# ONE home for peak/model FLOPs constants: profiler/flops.py (the MAC
+# convention matches the historical numbers this script printed).
+PEAK = F.peak_flops_per_chip("TPU v5 lite")
+RESNET50_TRAIN_FLOPS = F.resnet_train_flops_per_image(50, "macs")
+RESNET50_FWD_FLOPS = F.RESNET_FWD_GMACS[50] * 1e9
 
 
 def slope_timed(body, state, chain=10, reps=3, warmup=2):
@@ -81,12 +86,14 @@ def main():
                 t = slope_timed(body, state)
                 ips = b / t
                 print(f"B={b} {label} full: {t*1e3:6.1f} ms, {ips:6.0f} "
-                      f"img/s, MFU {ips*12.3e9/PEAK:.1%}", flush=True)
+                      f"img/s, MFU {ips*RESNET50_TRAIN_FLOPS/PEAK:.1%}",
+                      flush=True)
                 if patch is None:
                     body, state = make_step(b, fwd_only=True)
                     t = slope_timed(body, state)
                     print(f"B={b} {label} fwd:  {t*1e3:6.1f} ms "
-                          f"(fwd MFU {b/t*4.1e9/PEAK:.1%})", flush=True)
+                          f"(fwd MFU {b/t*RESNET50_FWD_FLOPS/PEAK:.1%})",
+                          flush=True)
             finally:
                 rn._reduce_window = orig_rw
 

@@ -1,7 +1,23 @@
 #!/bin/bash
-# Poll device health; when the tunnel window is healthy (>80 TF/s on the
-# 8k matmul scan), run the ResNet A/B profile once and save it.
+# Poll device health; when the tunnel window is healthy (above the
+# HEALTHY_MATMUL_TFLOPS gate in horovod_tpu/profiler/flops.py — the ONE
+# home of the peak/threshold constants — on the 8k matmul scan), run the
+# ResNet A/B profile once and save it.
 OUT=/tmp/resnet_ab_healthy.txt
+GATE=$(python - <<'EOF' 2>>${OUT}.log
+import sys; sys.path[:0] = ["/root/repo"]
+from horovod_tpu.profiler import flops
+print(flops.HEALTHY_MATMUL_TFLOPS)
+EOF
+)
+if [ -z "$GATE" ]; then
+  # No silent re-hardcoded fallback: a probe failure here would drift
+  # from flops.HEALTHY_MATMUL_TFLOPS exactly the way this script's old
+  # inline constant did. Fail visibly instead.
+  echo "cannot read HEALTHY_MATMUL_TFLOPS from profiler/flops.py" \
+    | tee -a ${OUT}.log >&2
+  exit 1
+fi
 for i in $(seq 1 40); do
   H=$(python - <<'EOF' 2>/dev/null
 import sys; sys.path[:0] = ["/root/repo", "/root/.axon_site"]
@@ -9,8 +25,8 @@ import bench
 print(bench._device_health()['matmul_tflops'])
 EOF
 )
-  echo "$(date +%H:%M:%S) health=$H" >> ${OUT}.log
-  if python -c "import sys; sys.exit(0 if float('$H' or 0) > 80 else 1)" 2>/dev/null; then
+  echo "$(date +%H:%M:%S) health=$H gate=$GATE" >> ${OUT}.log
+  if python -c "import sys; sys.exit(0 if float('$H' or 0) >= float('$GATE') else 1)" 2>/dev/null; then
     echo "HEALTHY window at $(date)" >> $OUT
     python /root/repo/scripts/resnet_ab.py >> $OUT 2>&1
     exit 0

@@ -28,6 +28,14 @@ Modes (argv[1]):
             HorovodInternalError within HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
             — long before the staller's eventual exit — handing recovery to
             the elastic retry loop instead of an indefinite hang.
+  slow_input — every step runs under hvd.perfscope() with the batch fetch
+            marked input_wait; the worker on SLOW_INPUT_HOSTNAME sleeps
+            ELASTIC_SLOW_INPUT_SEC in that phase each step (a starved host
+            input pipeline). Nobody crashes: the point is that per-rank
+            step WALL times converge (the fast rank parks the difference
+            in the allreduce), so only the perfscope phase split — pushed
+            to the rendezvous KV and persisted at job end — lets
+            hvddoctor name the straggler and its dominant phase.
 
 Each step passes the `worker.step` fault-injection site
 (horovod_tpu/testing/faults.py), so the chaos suite can add latency or
@@ -58,6 +66,8 @@ STALL_STEP = int(os.environ.get("ELASTIC_STALL_STEP", "5"))
 # so recovery can only have been triggered by the watchdog raise — not by
 # the driver noticing a dead process.
 STALL_EXIT_AFTER = float(os.environ.get("ELASTIC_STALL_EXIT_AFTER", "8"))
+SLOW_INPUT_HOSTNAME = os.environ.get("ELASTIC_SLOW_INPUT_HOSTNAME", "")
+SLOW_INPUT_SEC = float(os.environ.get("ELASTIC_SLOW_INPUT_SEC", "0.35"))
 
 
 def main():
@@ -106,7 +116,21 @@ def main():
             # so w == step at all times if and only if state survived.
             from horovod_tpu.testing import faults
             faults.inject("worker.step")
-            g = hvd.allreduce(np.ones((4,), np.float32), op="sum")
+            if mode == "slow_input":
+                scope = hvd.perfscope()
+                with scope.step():
+                    with scope.phase("input_wait"):
+                        # The "batch fetch": starved on one host only.
+                        time.sleep(SLOW_INPUT_SEC
+                                   if my_host == SLOW_INPUT_HOSTNAME
+                                   else 0.01)
+                    # comms attribution is automatic (the collective
+                    # dispatch choke point) — the fast rank's wait for
+                    # the slow peer lands here, not in its local time.
+                    g = hvd.allreduce(np.ones((4,), np.float32),
+                                      op="sum")
+            else:
+                g = hvd.allreduce(np.ones((4,), np.float32), op="sum")
             st.params = {"w": st.params["w"] + np.asarray(g) / now}
             st.step += 1
             if (mode == "crash" and my_host == CRASH_HOSTNAME

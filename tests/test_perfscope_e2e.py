@@ -1,0 +1,81 @@
+"""perfscope end-to-end: cross-rank straggler attribution (`make
+doctor-smoke`; ISSUE 7 acceptance).
+
+A real 2-process elastic job (the test_elastic_e2e harness) where the
+worker on `127.0.0.1` — rank 0 of round 1, hosts are sorted — has an
+injected slow input pipeline (tests/elastic_worker.py `slow_input`
+mode). The defining property this test pins: per-rank step WALL times
+are indistinguishable in a synchronous job (the fast rank parks the
+difference inside the allreduce), so naming the culprit requires the
+perfscope phase split — pushed to the rendezvous KV on the exporter
+cadence, persisted by the launcher at job end, and merged by
+``hvddoctor --json`` into a perf section naming the straggler rank AND
+its dominant phase (``input_wait``).
+
+Marked `faults`: minutes of runtime, excluded from tier 1.
+"""
+
+import json
+import os
+
+import pytest
+
+from test_elastic_e2e import finish, start_job, write_hosts
+
+from horovod_tpu.observability import doctor
+
+
+@pytest.mark.faults
+def test_doctor_names_slow_input_rank_and_dominant_phase(tmp_path,
+                                                         capsys):
+    flight_dir = tmp_path / "flight"
+    env = {
+        "HOROVOD_FLIGHT_DIR": str(flight_dir),
+        # Summaries must land before the short job ends: sub-second
+        # exporter cadence instead of the 5s default.
+        "HOROVOD_METRICS_PUSH_INTERVAL": "0.2",
+        "ELASTIC_SLOW_INPUT_HOSTNAME": "127.0.0.1",
+        "ELASTIC_SLOW_INPUT_SEC": "0.35",
+        "ELASTIC_STEP_SLEEP": "0.05",
+    }
+    proc, hosts_file, progress = start_job(tmp_path, "slow_input",
+                                           extra_env=env)
+    write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    out = finish(proc)
+
+    # The launcher persisted both ranks' KV summaries at job end.
+    files = sorted(os.listdir(flight_dir))
+    perf_files = [f for f in files if f.startswith("perf-rank-")]
+    assert any(f.startswith("perf-rank-0") for f in perf_files), \
+        (files, out)
+    assert any(f.startswith("perf-rank-1") for f in perf_files), \
+        (files, out)
+
+    # Wall times alone cannot separate the ranks (synchronous job)...
+    bodies = {}
+    for f in perf_files:
+        b = json.load(open(flight_dir / f))
+        bodies[b["rank"]] = b["summary"]
+    walls = {r: s["wall"]["mean_s"] for r, s in bodies.items()}
+    assert max(walls.values()) < 2.5 * min(walls.values()), walls
+    # ...but the phase split does: rank 0 burned its step in input_wait,
+    # rank 1 parked the same time in comms.
+    assert bodies[0]["phases_s"]["input_wait"] > 0.25, bodies[0]
+    assert bodies[1]["phases_s"].get("comms", 0.0) > \
+        bodies[1]["phases_s"].get("input_wait", 0.0), bodies[1]
+
+    # Acceptance: `hvddoctor --json` names the straggler rank and
+    # `input_wait` as its dominant phase.
+    rc = doctor.main(["--dir", str(flight_dir), "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    stragglers = report["perf"]["stragglers"]
+    assert len(stragglers) == 1, report["perf"]
+    assert stragglers[0]["rank"] == 0, stragglers
+    assert stragglers[0]["dominant_phase"] == "input_wait", stragglers
+    assert stragglers[0]["slowdown_vs_median"] > 2.0, stragglers
+
+    # The text rendering names it too.
+    text = doctor.render(report)
+    assert "PERF STRAGGLER rank 0" in text, text
+    assert "input_wait" in text, text
