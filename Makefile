@@ -25,6 +25,13 @@
 #                    concurrency rules + env-knob docs drift, gating on
 #                    findings NEW relative to the checked-in baseline
 #                    (docs/static_analysis.md)
+#   make hlo-lint    hvdhlo compile-time lint (docs/static_analysis.md):
+#                    lower the canonical DP train step under the current
+#                    fusion config on the 8-rank virtual mesh and run
+#                    the HVD2xx program rules (giant-allreduce /
+#                    host-sync / donation / padding / upcast) against
+#                    scripts/hvdhlo_baseline.json — the regression guard
+#                    that keeps ops/fusion.py reverts out of the HLO
 #   make race        hvdrace: the concurrency/hammer suites (timeline,
 #                    metrics, elastic driver, rendezvous KV, verifier)
 #                    run under the runtime lockset race detector
@@ -36,9 +43,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline metrics race doctor-smoke fusion-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline metrics race doctor-smoke fusion-smoke perf-gate
 
-test: lint test-unit test-multiprocess test-e2e chaos doctor-smoke fusion-smoke perf-gate entry
+test: lint hlo-lint test-unit test-multiprocess test-e2e chaos doctor-smoke fusion-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -87,15 +94,36 @@ perf-gate:
 fusion-smoke:
 	$(PYTEST) tests/test_fusion_smoke.py --run-perf -m perf
 
+# scripts/ and the training-shaped test workers issue collectives too —
+# they carry the same stall risks the HVD0xx rules exist to catch.
+LINT_PATHS = horovod_tpu/ examples/ scripts/ \
+    tests/mp_worker.py tests/elastic_worker.py
+
 lint:
-	$(PYTHON) -m horovod_tpu.analysis horovod_tpu/ examples/ \
+	$(PYTHON) -m horovod_tpu.analysis $(LINT_PATHS) \
 	    --baseline scripts/hvdlint_baseline.json
 
 # Regenerate the accepted-findings baseline (review the diff before
 # committing: every entry is a finding future lint runs stop gating on).
 lint-baseline:
-	$(PYTHON) -m horovod_tpu.analysis horovod_tpu/ examples/ \
+	$(PYTHON) -m horovod_tpu.analysis $(LINT_PATHS) \
 	    --format json > scripts/hvdlint_baseline.json || true
+
+# hvdhlo compile-time program lint (docs/static_analysis.md,
+# docs/perf.md). The env forces the virtual CPU mesh in plain shells;
+# on images whose sitecustomize pins the platform, the analyzer forces
+# jax.config itself before touching the backend.
+hlo-lint:
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm \
+	    --baseline scripts/hvdhlo_baseline.json
+
+hlo-lint-baseline:
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm \
+	    --format json > scripts/hvdhlo_baseline.json || true
 
 # The warm-compile-cache test is a wall-clock subprocess benchmark, not
 # a concurrency test — load-sensitive, and none of its work runs through

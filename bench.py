@@ -42,8 +42,35 @@ def peak_flops_per_chip():
     return F.peak_flops_per_chip()
 
 
+def _hlo_lint_enabled():
+    """HOROVOD_HLO_LINT gate, checked BEFORE any lowering happens —
+    with the stamp disabled a section must not pay a trace+lower it
+    would otherwise skip."""
+    try:
+        from horovod_tpu.analysis import hlo
+        return hlo.lint_enabled()
+    except Exception:
+        return False
+
+
+def _hlo_lint_lowered(lowered):
+    """hvdhlo stamp for one section's already-lowered step program
+    (docs/static_analysis.md): the compile-time perf lint rides the
+    lowering the bench produces anyway. Returns {} when disabled
+    (HOROVOD_HLO_LINT=0) or on any analysis failure — the lint is a
+    diagnostic stamp here, never a bench-killer."""
+    try:
+        from horovod_tpu.analysis import hlo
+        if not hlo.lint_enabled():
+            return {}
+        return hlo.lint_summary(lowered.as_text(), path="<lowered>")
+    except Exception:
+        return {}
+
+
 def _scan_timed(local_body, state, chain, reps, warmup=2,
-                flops_out=None, profile_out=None, profile_steps=3):
+                flops_out=None, profile_out=None, profile_steps=3,
+                hlo_out=None):
     """Time `chain` training steps chained inside ONE compiled program
     (lax.scan), returning seconds per step via a latency-cancelling slope.
 
@@ -77,9 +104,19 @@ def _scan_timed(local_body, state, chain, reps, warmup=2,
         lambda c, _: (local_body(c), ()), s, None, length=chain)[0],
         donate_argnums=(0,))  # alias carry in/out: no double-buffered params
     body = jbody
-    if flops_out is not None and F.xla_flops_enabled():
+    lowered = None
+    want_hlo = hlo_out is not None and _hlo_lint_enabled()
+    if (flops_out is not None and F.xla_flops_enabled()) or want_hlo:
         try:
-            compiled = jbody.lower(state).compile()
+            lowered = jbody.lower(state)  # ONE lowering: lint + compile
+        except Exception:
+            lowered = None
+    if lowered is not None and want_hlo:
+        hlo_out.update(_hlo_lint_lowered(lowered))
+    if lowered is not None and flops_out is not None \
+            and F.xla_flops_enabled():
+        try:
+            compiled = lowered.compile()
             total = F.compiled_cost_flops(compiled)
             if total:
                 flops_out["program_flops_per_step"] = total / chain
@@ -142,12 +179,15 @@ def _scan_timed(local_body, state, chain, reps, warmup=2,
     return best if best != float("inf") else fallback
 
 
-def _perf_stamp(r, name, flops_info, prof, fallback_flops_per_step):
+def _perf_stamp(r, name, flops_info, prof, fallback_flops_per_step,
+                hlo_info=None):
     """Attach the section's StepProfile (docs/perf.md) to its result
     dict: per-step wall percentiles, the perfscope phase breakdown, and
     MFU with its source — "xla" when the FLOPs came from cost analysis
     of the program that actually ran, "fallback" when only the hand
-    constants (profiler/flops.py) were available.
+    constants (profiler/flops.py) were available. `hlo_info` (the
+    hvdhlo compile-time lint of the same lowered program,
+    docs/static_analysis.md) rides along as `hlo_lint`.
 
     Convention note: the StepProfile compares XLA FLOPs against the
     "flops" (mul+add) fallback convention; the section's legacy `mfu`
@@ -173,6 +213,8 @@ def _perf_stamp(r, name, flops_info, prof, fallback_flops_per_step):
         sp["mfu"] = round(flops_per_step / mean / peak, 4)
     r["perfscope"] = sp
     r["mfu_source"] = source
+    if hlo_info:
+        r["hlo_lint"] = hlo_info
     if wall:
         r["step_time_percentiles_ms"] = {
             k: round(wall[f"{k}_s"] * 1e3, 2)
@@ -224,10 +266,11 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
 
     state = (params, stats, opt_state, images, labels, jnp.zeros(()))
     chain = max(steps // 3, 1)
-    flops_info, prof = {}, {}
+    flops_info, prof, hlo_info = {}, {}, {}
     sec_per_step = _scan_timed(body, state, chain=chain,
                                reps=3, warmup=max(warmup // 2, 1),
-                               flops_out=flops_info, profile_out=prof)
+                               flops_out=flops_info, profile_out=prof,
+                               hlo_out=hlo_info)
 
     ips = batch / sec_per_step
     # Training FLOPs ≈ 3× forward. MAC convention (flops.py) — the
@@ -248,7 +291,8 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
     return _perf_stamp(
         r, f"resnet{depth}", flops_info, prof,
         None if on_cpu else
-        F.resnet_train_flops_per_image(depth, "flops") * per_chip_batch)
+        F.resnet_train_flops_per_image(depth, "flops") * per_chip_batch,
+        hlo_info=hlo_info)
 
 
 def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
@@ -296,10 +340,10 @@ def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
         return (p, s, o, im, lb, l)
 
     state = (params, stats, opt_state, images, labels, jnp.zeros(()))
-    flops_info, prof = {}, {}
+    flops_info, prof, hlo_info = {}, {}, {}
     sec = _scan_timed(body, state, chain=max(steps // 3, 1), reps=3,
                       warmup=warmup, flops_out=flops_info,
-                      profile_out=prof)
+                      profile_out=prof, hlo_out=hlo_info)
     # Inception V3 fwd @299 ≈ 5.73 GMAC/img (torchvision convention,
     # flops.py) → training step ≈ 3×.
     r = {"images_per_sec_per_chip": round(b / sec, 2),
@@ -312,7 +356,8 @@ def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
     return _perf_stamp(
         r, "inception_v3", flops_info, prof,
         None if on_cpu else
-        F.inception_v3_train_flops_per_image("flops") * b)
+        F.inception_v3_train_flops_per_image("flops") * b,
+        hlo_info=hlo_info)
 
 
 # --------------------------------------------------------------------------
@@ -419,17 +464,18 @@ def bench_vgg16(mesh, k, steps=12, warmup=2):
         return (p, o, im, lb, l)
 
     state = (params, opt_state, images, labels, jnp.zeros(()))
-    flops_info, prof = {}, {}
+    flops_info, prof, hlo_info = {}, {}, {}
     sec = _scan_timed(body, state, chain=max(steps // 3, 1), reps=3,
                       warmup=warmup, flops_out=flops_info,
-                      profile_out=prof)
+                      profile_out=prof, hlo_out=hlo_info)
     # VGG-16 fwd @224 ≈ 15.5 GMAC/img (flops.py) → train ≈ 3×.
     r = {"images_per_sec_per_chip": round(b / sec, 2),
          "per_chip_batch": b, "image_size": img,
          "step_ms": round(sec * 1e3, 2),
          "model_flops_per_image": F.vgg16_train_flops_per_image("macs")}
     return _perf_stamp(r, "vgg16", flops_info, prof,
-                       F.vgg16_train_flops_per_image("flops") * b)
+                       F.vgg16_train_flops_per_image("flops") * b,
+                       hlo_info=hlo_info)
 
 
 def bench_transformer(on_cpu, steps, warmup):
@@ -465,10 +511,10 @@ def bench_transformer(on_cpu, steps, warmup):
 
     state = (params, opt_state, tokens, targets, jnp.zeros(()))
     chain = max(steps // 3, 1)
-    flops_info, prof = {}, {}
+    flops_info, prof, hlo_info = {}, {}, {}
     sec = _scan_timed(body, state, chain=chain, reps=3,
                       warmup=max(warmup // 2, 1), flops_out=flops_info,
-                      profile_out=prof)
+                      profile_out=prof, hlo_out=hlo_info)
     dt, steps = sec * steps, steps  # keep downstream arithmetic unchanged
 
     # Analytical model FLOPs: the standard 6N + attention accounting
@@ -489,7 +535,7 @@ def bench_transformer(on_cpu, steps, warmup):
             cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab) / 1e6, 1),
     }
     return _perf_stamp(r, "transformer_lm", flops_info, prof,
-                       flops_tok * toks)
+                       flops_tok * toks, hlo_info=hlo_info)
 
 
 def _slope_ms(run, k, reps=2):
@@ -569,6 +615,17 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
     # else the analytic 6N fallback.
     xla_flops = F.jit_cost_flops(grad_fn, params) \
         if F.xla_flops_enabled() else None
+    # hvdhlo stamp for the eager migration path: lint the fwd+bwd
+    # program (the part that lowers here; the allreduce rides the eager
+    # collective engine, covered by the SPMD sections' stamps). The
+    # enabled check comes FIRST — lowering BERT fwd+bwd just to throw
+    # it away under HOROVOD_HLO_LINT=0 would defeat the knob.
+    hlo_info = {}
+    if _hlo_lint_enabled():
+        try:
+            hlo_info = _hlo_lint_lowered(grad_fn.lower(params))
+        except Exception:
+            pass
     fallback_flops = F.transformer_train_flops_per_token(
         cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab, seq) * batch * seq
     for name, opt in (("adasum", dist_opt), ("predivide", pre_opt)):
@@ -614,7 +671,7 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
             _perf_stamp(out, "bert_base_finetune",
                         {"program_flops_per_step": xla_flops}
                         if xla_flops else {},
-                        prof, fallback_flops)
+                        prof, fallback_flops, hlo_info=hlo_info)
     out["config"] = f"L{cfg.n_layers} D{cfg.d_model} H{cfg.n_heads} " \
                     f"S{seq} B{batch} (BERT-base shape)"
     return out

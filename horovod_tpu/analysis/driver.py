@@ -311,9 +311,21 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m horovod_tpu.analysis",
         description="hvdlint: collective-consistency and concurrency "
-                    "static analysis (docs/static_analysis.md)")
+                    "static analysis, plus hvdhlo compile-time lint of "
+                    "lowered XLA programs via --hlo "
+                    "(docs/static_analysis.md)")
     parser.add_argument("paths", nargs="*", default=[],
                         help="files or directories to lint")
+    parser.add_argument("--hlo", action="store_true",
+                        help="hvdhlo mode: treat paths as lowered "
+                             "StableHLO/HLO text dumps and run the "
+                             "HVD2xx rules over the program structure")
+    parser.add_argument("--hlo-step", default=None, metavar="PROGRAM",
+                        choices=("lm",),
+                        help="hvdhlo mode: lower the named canonical "
+                             "step program under the current fusion "
+                             "config on the virtual CPU mesh and lint "
+                             "it (the `make hlo-lint` CI gate)")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule IDs to run (default all)")
     parser.add_argument("--ignore", default="",
@@ -337,14 +349,18 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.list_rules:
         from horovod_tpu.analysis import env_rule as env_mod
+        from horovod_tpu.analysis import hlo_rules
         reg = dict(registry())
         reg[env_mod.RULE_ID] = (env_mod.DESCRIPTION, None)
         reg[HVD000] = ("suppression comment lacks a rationale", None)
+        for rule_id, (desc, _check) in hlo_rules.RULES.items():
+            reg[rule_id] = (f"[--hlo] {desc}", None)
         for rule_id in sorted(reg):
             print(f"{rule_id}  {reg[rule_id][0]}")
         return 0
 
-    if not args.paths:
+    hlo_mode = args.hlo or args.hlo_step is not None
+    if not args.paths and not args.hlo_step:
         parser.error("no paths given (try: horovod_tpu/ examples/)")
 
     root = args.root
@@ -355,19 +371,42 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     ignore = [s.strip() for s in args.ignore.split(",") if s.strip()]
-    findings = lint_paths(args.paths, select=select, ignore=ignore,
-                          root=root, env_rule=not args.no_env)
+    if hlo_mode:
+        from horovod_tpu.analysis import hlo as hlo_mod
+        findings = hlo_mod.lint_files(args.paths, select=select,
+                                      ignore=ignore)
+        if args.hlo_step is not None:
+            # Lowering failures must fail the gate loudly — a CI host
+            # that cannot build the step program is not a clean lint.
+            try:
+                text = hlo_mod.lower_step_text(args.hlo_step)
+            except Exception as e:
+                print(f"hvdhlo: cannot lower step program "
+                      f"{args.hlo_step!r}: {e}", file=sys.stderr)
+                return 2
+            findings.extend(hlo_mod.lint_text(
+                text, path=hlo_mod.step_path(args.hlo_step),
+                select=select, ignore=ignore))
+            findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    else:
+        findings = lint_paths(args.paths, select=select, ignore=ignore,
+                              root=root, env_rule=not args.no_env)
     matched = 0
+    name = "hvdhlo" if hlo_mode else "hvdlint"
     if args.baseline is not None:
         try:
             baseline = load_baseline(args.baseline)
         except (OSError, ValueError) as e:
             # A broken baseline must fail the gate, not pass everything.
-            print(f"hvdlint: unreadable baseline {args.baseline}: {e}",
+            print(f"{name}: unreadable baseline {args.baseline}: {e}",
                   file=sys.stderr)
             return 2
         findings, matched = apply_baseline(findings, baseline)
-    _record_metrics(findings)
+    if hlo_mode:
+        from horovod_tpu.analysis import hlo as hlo_mod
+        hlo_mod.record_metrics(findings)
+    else:
+        _record_metrics(findings)
     if args.fmt == "json":
         sys.stdout.write(render_json(findings))
     else:
@@ -375,12 +414,12 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
             print(f.render())
     if findings:
         tag = " new" if args.baseline is not None else ""
-        print(f"hvdlint: {len(findings)}{tag} finding(s)"
+        print(f"{name}: {len(findings)}{tag} finding(s)"
               + (f" ({matched} baselined)" if matched else ""),
               file=sys.stderr)
         return 1
     if args.fmt != "json":
-        print("hvdlint: clean"
+        print(f"{name}: clean"
               + (f" ({matched} baselined)" if matched else ""))
     return 0
 

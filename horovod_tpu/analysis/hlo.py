@@ -1,0 +1,554 @@
+"""hvdhlo: structural analysis of the lowered XLA step program.
+
+hvdlint (PR 3-4) sees Python source; the perf properties the ROADMAP
+cares about — gradient-comms overlap, buffer donation, layout padding,
+host round-trips — are properties of the *lowered program* and invisible
+to an AST linter. This module parses the two textual forms the toolchain
+already produces for free and hands a uniform op/def-use model to the
+HVD2xx rules (``analysis/hlo_rules.py``):
+
+* **StableHLO MLIR** — ``jax.jit(f).lower(*args).as_text()``, the cheap
+  pre-optimization form bench and perfscope already lower for cost
+  analysis. Donation shows up as ``jax.buffer_donor``/
+  ``tf.aliasing_output`` argument attributes.
+* **HLO text** — ``lowered.compile().as_text()`` or a dumped
+  ``*.before_optimizations.txt`` module. Donation shows up in the
+  module-level ``input_output_alias`` map.
+
+The parser is deliberately line-structural, not a grammar: it recovers
+(result, opcode, operands, operand/result tensor types, attribute text)
+per instruction plus entry parameters and their donation bits — exactly
+what the rules consume — and ignores everything else. A formatting
+drift in a field no rule reads therefore cannot break the lint.
+
+Findings ride the existing driver machinery (`driver.Finding`,
+``file:line RULE-ID msg``, ``--format json``, ``--baseline``); there are
+no source comments in lowered text, so HLO findings are silenced via the
+baseline file (``scripts/hvdhlo_baseline.json``), not inline
+suppressions. Findings feed ``hvdhlo_findings_total{rule}``
+(docs/observability.md). See docs/static_analysis.md for the rule
+catalog and docs/perf.md for the CI gate (``make hlo-lint``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from horovod_tpu.analysis.driver import Finding
+
+#: Bytes per element for the dtypes XLA prints. Unknown dtypes parse to
+#: itemsize None and size-based rules skip the value instead of guessing.
+DTYPE_BYTES = {
+    "pred": 1, "i1": 1, "s8": 1, "u8": 1, "i8": 1, "ui8": 1,
+    "s16": 2, "u16": 2, "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "i32": 4, "ui32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "i64": 8, "ui64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorType:
+    """One tensor type: dtype token + static dims (None on dynamic)."""
+
+    dtype: str
+    dims: Tuple[int, ...]
+
+    @property
+    def elems(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def itemsize(self) -> Optional[int]:
+        return DTYPE_BYTES.get(self.dtype.lower())
+
+    @property
+    def nbytes(self) -> Optional[int]:
+        i = self.itemsize
+        return None if i is None else self.elems * i
+
+    def __str__(self) -> str:
+        dims = "x".join(str(d) for d in self.dims)
+        return f"{self.dtype}[{dims}]" if self.dims else f"{self.dtype}[]"
+
+
+@dataclasses.dataclass
+class HloOp:
+    """One instruction, normalized across the two textual forms."""
+
+    line: int                     # 1-based line in the analyzed text
+    result: str                   # "%23" ("" for results-less ops)
+    opcode: str                   # canonical: all_reduce, dot_general, ...
+    operands: Tuple[str, ...]     # SSA names, '#i' projections stripped
+    operand_types: Tuple[Optional[TensorType], ...]
+    result_types: Tuple[Optional[TensorType], ...]
+    attrs: str                    # raw remainder text for attr regexes
+    scope: str                    # enclosing function / computation name
+
+
+@dataclasses.dataclass(frozen=True)
+class HloParam:
+    """One entry-computation parameter."""
+
+    index: int
+    name: str                     # "%arg0" / "%p.1"
+    type: Optional[TensorType]
+    donated: bool
+    scope: str
+    line: int
+
+
+class HloProgram:
+    """Parsed module: op list + def/use indexes the rules query."""
+
+    def __init__(self, path: str, ops: List[HloOp],
+                 params: List[HloParam], entry_scope: str,
+                 fmt: str) -> None:
+        self.path = path
+        self.ops = ops
+        self.params = params
+        self.entry_scope = entry_scope
+        self.fmt = fmt  # "stablehlo" | "hlo"
+        self._defs: Dict[Tuple[str, str], HloOp] = {}
+        self._uses: Dict[Tuple[str, str], List[HloOp]] = {}
+        for op in ops:
+            if op.result:
+                self._defs.setdefault((op.scope, op.result), op)
+            for o in op.operands:
+                self._uses.setdefault((op.scope, o), []).append(op)
+
+    @property
+    def entry_params(self) -> List[HloParam]:
+        return [p for p in self.params if p.scope == self.entry_scope]
+
+    def defining(self, scope: str, name: str) -> Optional[HloOp]:
+        return self._defs.get((scope, name))
+
+    def uses(self, scope: str, name: str) -> List[HloOp]:
+        return self._uses.get((scope, name), [])
+
+    def depends_on(self, op: HloOp, target: HloOp,
+                   max_visits: int = 4096) -> bool:
+        """True when `op` transitively consumes `target`'s result
+        (same-scope def-use reachability; the overlap-chain query)."""
+        if op.scope != target.scope or not target.result:
+            return False
+        seen: Set[str] = set()
+        frontier = list(op.operands)
+        visits = 0
+        while frontier and visits < max_visits:
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            visits += 1
+            if name == target.result:
+                return True
+            d = self.defining(op.scope, name)
+            if d is not None:
+                frontier.extend(d.operands)
+        return False
+
+
+# ------------------------------------------------------------- parsing
+
+_TENSOR_RE = re.compile(r"tensor<([^<>]*?)>")
+_HLO_SHAPE_RE = re.compile(r"\b([a-z]+[0-9]+[a-z0-9]*|pred)\[([0-9,]*)\]")
+_SSA_RE = re.compile(r"%[\w.-]+")
+
+
+def _parse_mlir_tensor(inner: str) -> Optional[TensorType]:
+    """``2x8x8x64xbf16`` / ``f32`` / ``?x128xf32`` -> TensorType|None."""
+    parts = inner.split("x")
+    dims: List[int] = []
+    for i, p in enumerate(parts):
+        p = p.strip()
+        if p.isdigit():
+            dims.append(int(p))
+            continue
+        if p == "?":
+            return None  # dynamic: size-based rules must skip
+        dtype = "x".join(parts[i:]).strip()
+        # complex<f32> etc. keep their full token; lookup just misses.
+        return TensorType(dtype, tuple(dims))
+    return None
+
+
+def _mlir_types(segment: str) -> List[Optional[TensorType]]:
+    """Every tensor<> type in `segment`, in order (non-tensor -> None
+    is NOT emitted; callers align by count only when it matches)."""
+    return [_parse_mlir_tensor(m.group(1))
+            for m in _TENSOR_RE.finditer(segment)]
+
+
+def _hlo_types(segment: str) -> List[Optional[TensorType]]:
+    return [TensorType(m.group(1),
+                       tuple(int(d) for d in m.group(2).split(",") if d))
+            for m in _HLO_SHAPE_RE.finditer(segment)]
+
+
+def _operand_names(segment: str) -> Tuple[str, ...]:
+    return tuple(m.group(0).split("#")[0]
+                 for m in _SSA_RE.finditer(segment))
+
+
+# StableHLO op header: `%23 = "stablehlo.all_reduce"(%22) <{...}> ({`
+# or `%0 = stablehlo.dot_general %arg0, %arg1, ... : (T, T) -> T`
+# or `stablehlo.return %25 : tensor<f32>` / `return %1 : tensor<...>`.
+_MLIR_OP_RE = re.compile(
+    r"^\s*(?:(%[\w]+)(?::\d+)?\s*=\s*)?"
+    r'"?([a-zA-Z_][\w$]*\.)?([a-zA-Z_][\w$-]*)"?\s*(?=[ (%<"@]|$)')
+_MLIR_FUNC_RE = re.compile(
+    r"^\s*func\.func\s+(?:(public|private)\s+)?@([\w$-]+)\s*\((.*)$")
+# The attr dict may nest braces one level (mhlo.sharding strings like
+# {jax.buffer_donor = true, mhlo.sharding = "{replicated}"}) — the
+# donation bit must survive a sharding annotation riding alongside it.
+_MLIR_ARG_RE = re.compile(
+    r"(%arg\d+):\s*([^,){]+(?:\{(?:[^{}]|\{[^{}]*\})*\})?)")
+
+#: MLIR keywords the op regex would otherwise read as opcodes.
+_MLIR_NOISE = {"module", "func", "}", "{", "^bb0", "cond", "do"}
+
+
+def _parse_stablehlo(text: str, path: str) -> HloProgram:
+    ops: List[HloOp] = []
+    params: List[HloParam] = []
+    entry_scope = ""
+    scope = ""
+    # stack of (op, brace_balance_at_open) for region ops whose result
+    # type arrives on the closing `}) : (...) -> ...` line
+    pending: List[HloOp] = []
+    lines = text.splitlines()
+    for lineno, raw in enumerate(lines, 1):
+        line = raw.strip()
+        if not line or line.startswith("//"):
+            continue
+        fm = _MLIR_FUNC_RE.match(raw)
+        if fm:
+            vis, name, argtext = fm.group(1), fm.group(2), fm.group(3)
+            scope = name
+            if vis == "public" or (not entry_scope and name == "main"):
+                entry_scope = name
+            for i, am in enumerate(_MLIR_ARG_RE.finditer(argtext)):
+                arg, typetext = am.group(1), am.group(2)
+                types = _mlir_types(typetext)
+                donated = ("jax.buffer_donor" in typetext
+                           or "tf.aliasing_output" in typetext)
+                params.append(HloParam(i, arg, types[0] if types else None,
+                                       donated, scope, lineno))
+            continue
+        if line.startswith("})"):
+            # close of a region op: its functional type rides here
+            _, _, typesig = line.partition(":")
+            if pending:
+                op = pending.pop()
+                ins, _, outs = typesig.partition("->")
+                op.operand_types = tuple(_mlir_types(ins))
+                op.result_types = tuple(_mlir_types(outs))
+            continue
+        m = _MLIR_OP_RE.match(raw)
+        if not m:
+            continue
+        result = m.group(1) or ""
+        opcode = m.group(3)
+        if opcode in _MLIR_NOISE or line.startswith("^"):
+            continue
+        opcode = opcode.replace("-", "_")
+        rest = raw[m.end():]
+        # the trailing ` : type` annotation (absent on region openers)
+        body, _, typesig = rest.rpartition(" : ")
+        if not body:
+            body, typesig = rest, ""
+        operand_types: Tuple[Optional[TensorType], ...] = ()
+        result_types: Tuple[Optional[TensorType], ...] = ()
+        if "->" in typesig:
+            ins, _, outs = typesig.partition("->")
+            operand_types = tuple(_mlir_types(ins))
+            result_types = tuple(_mlir_types(outs))
+        elif typesig:
+            result_types = tuple(_mlir_types(typesig))
+        op = HloOp(lineno, result, opcode, _operand_names(body),
+                   operand_types, result_types, rest.strip(), scope)
+        ops.append(op)
+        # `({` with no matching `})` on the same line opens a region
+        if rest.count("({") > rest.count("})"):
+            pending.append(op)
+    return HloProgram(path, ops, params, entry_scope or "main",
+                      "stablehlo")
+
+
+# HLO text: `  %all-reduce.2 = f32[256,256]{1,0} all-reduce(f32[...] %x),
+# channel_id=1, ...` inside `ENTRY %main ... {` ... `}` computations.
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%[\w.-]+)\s*=\s*(.+?)\s([a-z][a-z0-9-]*)\((.*)$")
+_HLO_COMP_RE = re.compile(
+    r"^\s*(ENTRY\s+)?(%?[\w.-]+)\s.*->\s.*\{\s*$")
+_HLO_ALIAS_RE = re.compile(
+    r"input_output_alias=\{([^{}]*(?:\{[^{}]*\}[^{}]*)*)\}")
+
+
+def _hlo_alias_params(header: str) -> Set[int]:
+    """Donated parameter numbers from the module-level alias map:
+    ``{0}: (0, {}, may-alias)`` -> param 0."""
+    m = _HLO_ALIAS_RE.search(header)
+    if not m:
+        return set()
+    return {int(g) for g in re.findall(r"\(\s*(\d+)\s*,", m.group(1))}
+
+
+def _split_args(segment: str) -> Tuple[str, str]:
+    """(arg list, attr remainder) of an instruction tail, honoring
+    nested parens: ``f32[2]{0} %a, %b), channel_id=1`` splits at the
+    close paren matching the opcode's open."""
+    depth = 0
+    for i, ch in enumerate(segment):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            if ch == ")" and depth == 0:
+                return segment[:i], segment[i + 1:]
+            depth -= 1
+    return segment, ""
+
+
+def _parse_hlo_text(text: str, path: str) -> HloProgram:
+    ops: List[HloOp] = []
+    params: List[HloParam] = []
+    entry_scope = ""
+    scope = ""
+    in_entry = False
+    donated: Set[int] = set()
+    lines = text.splitlines()
+    for lineno, raw in enumerate(lines, 1):
+        if raw.startswith("HloModule"):
+            donated = _hlo_alias_params(raw)
+            continue
+        im = _HLO_INSTR_RE.match(raw)
+        if im:
+            result, typetext, opcode, tail = im.groups()
+            args, attrs = _split_args(tail)
+            opcode = opcode.replace("-", "_")
+            op = HloOp(lineno, result, opcode, _operand_names(args),
+                       tuple(_hlo_types(args)), tuple(_hlo_types(typetext)),
+                       attrs.strip(", "), scope)
+            ops.append(op)
+            if opcode == "parameter":
+                pm = re.match(r"\s*(\d+)", args)
+                idx = int(pm.group(1)) if pm else len(params)
+                params.append(HloParam(
+                    idx, result, op.result_types[0] if op.result_types
+                    else None, in_entry and idx in donated, scope, lineno))
+            continue
+        cm = _HLO_COMP_RE.match(raw)
+        if cm and "=" not in raw.split("->")[0]:
+            in_entry = bool(cm.group(1))
+            scope = cm.group(2)
+            if in_entry:
+                entry_scope = scope
+    # parameters of non-entry computations are never donation candidates;
+    # keep only entry ones plus none else need donation bits
+    return HloProgram(path, ops, params, entry_scope, "hlo")
+
+
+def parse(text: str, path: str = "<hlo>") -> HloProgram:
+    """Parse either textual form; dispatch by content."""
+    head = text[:4096]
+    if "HloModule" in head:
+        return _parse_hlo_text(text, path)
+    return _parse_stablehlo(text, path)
+
+
+# ------------------------------------------------------------- linting
+
+def registry() -> Dict[str, Tuple[str, object]]:
+    """rule_id -> (description, check(program) -> iterable[Finding])."""
+    from horovod_tpu.analysis import hlo_rules
+    return dict(hlo_rules.RULES)
+
+
+def lint_text(text: str, path: str = "<hlo>",
+              select: Optional[Sequence[str]] = None,
+              ignore: Sequence[str] = ()) -> List[Finding]:
+    """Run the HVD2xx rules over one lowered module's text."""
+    prog = parse(text, path)
+    wanted = {r.upper() for r in select} if select is not None else None
+    ignored = {r.upper() for r in ignore}
+    out: List[Finding] = []
+    for rule_id, (_desc, check) in sorted(registry().items()):
+        if wanted is not None and rule_id not in wanted:
+            continue
+        if rule_id in ignored:
+            continue
+        out.extend(check(prog))
+    out.sort(key=lambda f: (f.line, f.rule_id))
+    return out
+
+
+def lint_files(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               ignore: Sequence[str] = ()) -> List[Finding]:
+    """Lint dumped modules; unreadable paths fail the gate (HVD999),
+    mirroring the AST driver's contract."""
+    findings: List[Finding] = []
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as e:
+            findings.append(Finding(str(p), 1, "HVD999",
+                                    f"unreadable: {e}"))
+            continue
+        findings.extend(lint_text(text, path=str(p), select=select,
+                                  ignore=ignore))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings
+
+
+def lint_enabled() -> bool:
+    """HOROVOD_HLO_LINT gate (default on) for the bench-side stamping;
+    the CLI/CI path runs unconditionally."""
+    from horovod_tpu.common.config import _env_on
+    return _env_on("HOROVOD_HLO_LINT", True)
+
+
+#: Bench stamps at most this many findings per section (full details
+#: always come from re-running the CLI on the dumped module).
+_SUMMARY_MAX_FINDINGS = 20
+
+
+def lint_summary(text: str, path: str = "<lowered>") -> Dict[str, object]:
+    """The compact per-section stamp bench embeds in its JSON line."""
+    findings = lint_text(text, path=path)
+    record_metrics(findings)
+    rules: Dict[str, int] = {}
+    for f in findings:
+        rules[f.rule_id] = rules.get(f.rule_id, 0) + 1
+    out: Dict[str, object] = {"count": len(findings),
+                              "clean": not findings}
+    if findings:
+        out["rules"] = rules
+        out["findings"] = [f.render()
+                           for f in findings[:_SUMMARY_MAX_FINDINGS]]
+        if len(findings) > _SUMMARY_MAX_FINDINGS:
+            out["truncated"] = len(findings) - _SUMMARY_MAX_FINDINGS
+    return out
+
+
+def record_metrics(findings: Sequence[Finding]) -> None:
+    """hvdhlo_findings_total{rule} (PR 2 registry); lint must work in
+    environments without the runtime deps, so failures are swallowed."""
+    try:
+        from horovod_tpu.observability import metrics as m
+        counter = m.registry().counter(
+            "hvdhlo_findings_total", "hvdhlo findings by rule",
+            labelnames=("rule",))
+        for f in findings:
+            counter.labels(rule=f.rule_id).inc()
+    except Exception:
+        pass
+
+
+# ------------------------------------------------- canonical step lower
+
+def _force_cpu_mesh(min_devices: int = 2):
+    """CPU backend with a multi-device virtual mesh (the perf_gate
+    recipe: env alone doesn't switch platforms on images whose
+    sitecustomize pins jax.config)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < min_devices:
+        raise RuntimeError(
+            f"hlo-lint needs >= {min_devices} CPU devices; the backend "
+            "initialized before the device-count flag could apply "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "before starting python)")
+    return jax
+
+
+def lower_step_text(kind: str = "lm") -> str:
+    """StableHLO text of the canonical DP train step under the CURRENT
+    fusion config — the program `make hlo-lint` gates.
+
+    `lm`: the tied-embedding transformer-LM shape from bench's
+    lm_overlap section (an 8 MB embedding + 6 residual FFN blocks,
+    ~25 MB of f32 gradients) through the framework's own in-jit
+    bucketed reduction on the virtual CPU mesh. The 8 MB embedding
+    gradient is the canary: with chunking + the bucket cap intact every
+    all-reduce payload stays <= the cap; reverting ops/fusion.py to the
+    pre-PR-6 single-giant-allreduce plan (or lifting the cap while
+    raising the threshold) resurfaces a >cap payload and trips HVD201.
+    """
+    if kind != "lm":
+        raise ValueError(f"unknown --hlo-step program {kind!r}")
+    jax = _force_cpu_mesh()
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.common import config as C
+    from horovod_tpu.common.compat import ensure_jax_api
+    from horovod_tpu.ops import fusion
+    from horovod_tpu.optim.optimizer import reduce_gradients_in_jit
+
+    # The env-derived effective threshold, computed here rather than
+    # through topology state so the gate needs no hvd.init(): both an
+    # env simulation of the old plan (HOROVOD_FUSION_THRESHOLD=64MB +
+    # HOROVOD_BUCKET_CAP=0) and a code revert of the chunking land in
+    # the lowered program.
+    thresh = fusion.effective_threshold(
+        C._env_int(C.HOROVOD_FUSION_THRESHOLD,
+                   C.DEFAULT_FUSION_THRESHOLD_BYTES),
+        C._env_int(C.HOROVOD_BUCKET_CAP, C.DEFAULT_BUCKET_CAP_BYTES))
+
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("hvd",))
+    rng = np.random.default_rng(0)
+    D, F, V, NL = 256, 1024, 8192, 6
+    params = {"emb": jnp.asarray(
+        rng.standard_normal((V, D)) * 0.02, jnp.float32)}
+    for i in range(NL):
+        params[f"wi{i}"] = jnp.asarray(
+            rng.standard_normal((D, F)) * 0.02, jnp.float32)
+        params[f"wo{i}"] = jnp.asarray(
+            rng.standard_normal((F, D)) * 0.02, jnp.float32)
+
+    def local_step(p, tok, tgt):
+        def loss(p):
+            h = p["emb"][tok]
+            for i in range(NL):
+                h = h + jnp.tanh(h @ p[f"wi{i}"]) @ p[f"wo{i}"]
+            logits = h @ p["emb"].T
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], -1))
+
+        g = jax.grad(loss)(p)
+        g = reduce_gradients_in_jit(g, num_ranks=ndev,
+                                    fusion_threshold_bytes=thresh)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.01 * b, p, g)
+
+    B, S = 16, 64
+    tok = jnp.asarray(rng.integers(0, V, (B * ndev, S)))
+    tgt = jnp.roll(tok, -1, axis=1)
+    ensure_jax_api()
+    step = jax.shard_map(local_step, mesh=mesh,
+                         in_specs=(P(), P("hvd"), P("hvd")), out_specs=P(),
+                         check_vma=False)
+    return jax.jit(step, donate_argnums=0).lower(params, tok, tgt).as_text()
+
+
+#: Stable pseudo-path for --hlo-step findings, so baseline entries
+#: survive across hosts and invocations.
+def step_path(kind: str) -> str:
+    return f"<hlo-step:{kind}>"

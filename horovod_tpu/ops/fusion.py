@@ -31,6 +31,13 @@ Two properties the original greedy packer lacked, both measured to matter
   is still running (the role of the reference's background RunLoopOnce
   cycle); with forward-order packing the first bucket depends on the very
   last gradient produced and nothing can overlap.
+
+Both properties are CI-gated at the HLO level, not just unit-tested:
+``make hlo-lint`` (hvdhlo rule HVD201, analysis/hlo_rules.py) lowers the
+canonical DP step through this planner and fails on any fused all-reduce
+payload above the bucket cap surviving to the program — a refactor here
+that silently resurrects the pre-bucketing single-giant-allreduce plan
+is caught at lower time on CPU-only CI (docs/static_analysis.md).
 """
 
 from __future__ import annotations

@@ -158,7 +158,19 @@ def compiled_cost_flops(compiled) -> Optional[float]:
     except Exception:
         return None
     if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else {}
+        # Per-device list (older JAX): under SPMD every device runs the
+        # same module, so take the first entry with a POSITIVE NUMERIC
+        # flops count — device 0's dict can be empty, and some builds
+        # report -1 or a non-numeric placeholder for "unknown", which
+        # must not shadow a populated later entry.
+        def _usable(d):
+            try:
+                return float(d.get("flops")) > 0.0
+            except (TypeError, ValueError):
+                return False
+        dicts = [d for d in ca if isinstance(d, dict)]
+        ca = next((d for d in dicts if _usable(d)),
+                  dicts[0] if dicts else {})
     if not isinstance(ca, dict):
         return None
     f = ca.get("flops")

@@ -1,0 +1,211 @@
+"""Regenerate the golden StableHLO fixtures for the hvdhlo rule suite.
+
+Each fixture is a tiny jitted program lowered on the CPU backend and
+checked in under ``tests/fixtures/hlo/`` so ``tests/test_hvdhlo.py``
+stays hermetic on CPU CI (no lowering at test time; the rules run over
+the committed text). One positive and, where the negative is not
+covered by every other fixture, one negative twin per HVD2xx rule —
+including the ResNet-block HVD204 pair (channels 64 vs lane-padded
+128).
+
+Run from the repo root after changing a fixture program::
+
+    python scripts/gen_hlo_fixtures.py
+
+and review the diff: fixture churn is rule-input churn.
+"""
+
+import os
+import sys
+
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "")
+     + " --xla_force_host_platform_device_count=8").strip())
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu  # noqa: E402, F401  (ensure_jax_api: jax.shard_map)
+from horovod_tpu.optim.optimizer import (  # noqa: E402
+    reduce_gradients_in_jit)
+
+OUT = os.path.join(_REPO, "tests", "fixtures", "hlo")
+
+_MB = 1024 * 1024
+
+
+def _mesh():
+    n = len(jax.devices())
+    return Mesh(np.array(jax.devices()).reshape(n), ("hvd",)), n
+
+
+def _dp_step_text(threshold_bytes):
+    """Two ~8 MB weights through the framework's in-jit bucketed
+    reduction: the 64 MB threshold resurrects the giant fused psum
+    (HVD201 positive), the 4 MB default chunks it (negative)."""
+    mesh, n = _mesh()
+
+    def local_step(p, x):
+        def loss(p):
+            h = jnp.tanh(x @ p["w0"])
+            h = jnp.tanh(h @ p["w1"])
+            return jnp.sum(h ** 2)
+
+        g = jax.grad(loss)(p)
+        g = reduce_gradients_in_jit(g, num_ranks=n,
+                                    fusion_threshold_bytes=threshold_bytes)
+        # x rides back out (the caller reuses the batch buffer), so the
+        # fixture isolates HVD201 — no incidental HVD203 on the input.
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g), x
+
+    params = {"w0": jnp.ones((1448, 1448), jnp.float32),
+              "w1": jnp.ones((1448, 1448), jnp.float32)}
+    step = jax.shard_map(local_step, mesh=mesh,
+                         in_specs=(P(), P("hvd")),
+                         out_specs=(P(), P("hvd")), check_vma=False)
+    # 128 rows per shard: the backward dL/dW contracts over the local
+    # batch, and 128 keeps that extent lane-aligned so this fixture
+    # isolates HVD201 (no incidental HVD204).
+    x = jnp.ones((128 * n, 1448), jnp.float32)
+    return jax.jit(step, donate_argnums=0).lower(params, x).as_text()
+
+
+def hvd201_giant_allreduce():
+    return _dp_step_text(64 * _MB)
+
+
+def hvd201_bucketed():
+    return _dp_step_text(4 * _MB)
+
+
+def hvd201_chained():
+    """Global-norm clip done naively: the 8 MB gradient psum depends on
+    the norm psum — a gradient-scale serialized dependency chain (small
+    inherently-serial pairs like softmax's max->sum stay exempt via the
+    bucket-cap floor on the chain's total payload)."""
+    mesh, n = _mesh()
+
+    def local(g, x):
+        norm = lax.psum(jnp.sum(g * g), "hvd")
+        return lax.psum(g / jnp.sqrt(norm), "hvd")
+
+    step = jax.shard_map(local, mesh=mesh, in_specs=(P(), P("hvd")),
+                         out_specs=P(), check_vma=False)
+    return jax.jit(step).lower(jnp.ones((1448, 1448), jnp.float32),
+                               jnp.ones((8 * n,), jnp.float32)).as_text()
+
+
+def hvd202_host_callback():
+    """A debug print left inside the step: lowers to a host callback
+    custom-call — one device->host->device round-trip per step."""
+
+    def step(x):
+        s = jnp.sum(x)
+        jax.debug.print("loss={s}", s=s)
+        return x * 2.0
+
+    return jax.jit(step).lower(jnp.ones((128,), jnp.float32)).as_text()
+
+
+def _donation_step(donate):
+    # x is 4 MB, shape-matches the output (so the donation is usable),
+    # and is dead after its single use; w is referenced twice, so only
+    # x is a donation candidate and the fixture isolates one finding.
+    f = jax.jit(lambda x, w: jnp.tanh(x @ w) * jnp.sum(w),
+                donate_argnums=(0,) if donate else ())
+    x = jnp.ones((1024, 1024), jnp.float32)
+    w = jnp.ones((1024, 1024), jnp.float32)
+    return f.lower(x, w).as_text()
+
+
+def hvd203_undonated():
+    return _donation_step(donate=False)
+
+
+def hvd203_donated():
+    return _donation_step(donate=True)
+
+
+def _resnet_block_text(channels):
+    """A ResNet basic block (conv3x3-relu-conv3x3 + residual), NHWC
+    bf16: channels=64 is the real ResNet-50 stage-1 width — every conv
+    operand pads 64 -> 128 lanes, 50% of the block's FLOPs are padding
+    (the static face of the 0.17-MFU conv gap). The lane-padded twin
+    (channels=128) is clean."""
+
+    def conv(x, k):
+        return lax.conv_general_dilated(
+            x, k, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def block(x, k1, k2):
+        h = jax.nn.relu(conv(x, k1))
+        return jax.nn.relu(conv(h, k2) + x)
+
+    c = channels
+    x = jnp.ones((8, 16, 16, c), jnp.bfloat16)
+    k = jnp.ones((3, 3, c, c), jnp.bfloat16)
+    return jax.jit(block).lower(x, k, k).as_text()
+
+
+def hvd204_resnet_block():
+    return _resnet_block_text(64)
+
+
+def hvd204_resnet_block_padded():
+    return _resnet_block_text(128)
+
+
+def hvd205_upcast_matmul():
+    """bf16 activations upcast to f32 BEFORE the matmul: the MXU runs
+    the dot at the f32 rate for no precision benefit."""
+    f = jax.jit(lambda x, w: jnp.tanh(x.astype(jnp.float32)) @ w)
+    return f.lower(jnp.ones((128, 256), jnp.bfloat16),
+                   jnp.ones((256, 128), jnp.float32)).as_text()
+
+
+def hvd205_upcast_accum():
+    """The legitimate upcast: bf16 -> f32 feeding a reduction
+    (accumulate in f32) — must stay clean."""
+    f = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+    return f.lower(jnp.ones((128, 256), jnp.bfloat16)).as_text()
+
+
+FIXTURES = {
+    "hvd201_giant_allreduce": hvd201_giant_allreduce,
+    "hvd201_bucketed": hvd201_bucketed,
+    "hvd201_chained": hvd201_chained,
+    "hvd202_host_callback": hvd202_host_callback,
+    "hvd203_undonated": hvd203_undonated,
+    "hvd203_donated": hvd203_donated,
+    "hvd204_resnet_block": hvd204_resnet_block,
+    "hvd204_resnet_block_padded": hvd204_resnet_block_padded,
+    "hvd205_upcast_matmul": hvd205_upcast_matmul,
+    "hvd205_upcast_accum": hvd205_upcast_accum,
+}
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    for name, fn in sorted(FIXTURES.items()):
+        path = os.path.join(OUT, f"{name}.mlir")
+        text = fn()
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {os.path.relpath(path, _REPO)} "
+              f"({len(text.splitlines())} lines)")
+
+
+if __name__ == "__main__":
+    main()

@@ -97,7 +97,8 @@ def emit_profiles() -> dict:
               jnp.asarray(rng.standard_normal((B, D)), jnp.float32))
     ps = P.get()
     ps.reset()
-    xla = F.jit_cost_flops(grad_fn, w, batch0)
+    xla = F.jit_cost_flops(grad_fn, w, batch0) \
+        if F.xla_flops_enabled() else None
     # Analytic fwd+bwd fallback for the 2-matmul MLP (mul+add counted).
     ps.set_model_flops(*F.pick_flops(xla, 6.0 * 2 * D * D * B))
     for i in range(8):
@@ -114,7 +115,7 @@ def emit_profiles() -> dict:
     m = jnp.asarray(rng.standard_normal((128, 128)) * 0.05, jnp.float32)
     body = jax.jit(lambda s: jnp.tanh(s @ m))
     ps.reset()
-    xla = F.jit_cost_flops(body, m)
+    xla = F.jit_cost_flops(body, m) if F.xla_flops_enabled() else None
     ps.set_model_flops(*F.pick_flops(xla, 2.0 * 128 ** 3))
     s = m
     for _ in range(8):
@@ -201,6 +202,31 @@ def check_bench(doc: dict) -> list:
     if not found:
         errs.append("bench JSON carries no perfscope StepProfile "
                     "(HOROVOD_PERFSCOPE=0 on the bench run?)")
+    return errs
+
+
+def update_errors(current: dict) -> list:
+    """Why `--update` must refuse to turn `current` into the baseline.
+
+    A broken run must not silently become the new reference: a section
+    whose phase coverage is below MIN_COVERAGE recorded broken
+    attribution, and one whose ``mfu_source`` is a fallback recorded a
+    run where the XLA cost analysis never fired — baselining either
+    would teach the gate to accept exactly the failure it exists to
+    catch."""
+    sections = current.get("sections") or {}
+    errs = []
+    if not sections:
+        errs.append("no sections in the current profiles")
+    for name, prof in sorted(sections.items()):
+        cov = (prof or {}).get("coverage")
+        if cov is None or cov < MIN_COVERAGE:
+            errs.append(f"{name}: coverage {cov} < {MIN_COVERAGE} — "
+                        "phase attribution is broken in this run")
+        src = (prof or {}).get("mfu_source")
+        if src != "xla":
+            errs.append(f"{name}: mfu_source {src!r} is a fallback — "
+                        "the XLA cost analysis did not run")
     return errs
 
 
@@ -320,6 +346,14 @@ def main(argv=None) -> int:
         return 2
 
     if args.update:
+        errs = update_errors(current)
+        if errs:
+            for e in errs:
+                print(f"perf_gate: FAIL {e}", file=sys.stderr)
+            print(f"perf_gate: refusing to regenerate {args.baseline} "
+                  f"from a broken run ({len(errs)} failure(s)); fix the "
+                  "run, don't lower the bar", file=sys.stderr)
+            return 1
         doc = baseline_from(current)
         tmp = f"{args.baseline}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:

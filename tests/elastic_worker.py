@@ -128,9 +128,10 @@ def main():
                     # dispatch choke point) — the fast rank's wait for
                     # the slow peer lands here, not in its local time.
                     g = hvd.allreduce(np.ones((4,), np.float32),
-                                      op="sum")
+                                      op="sum", name="elastic_step_grad")
             else:
-                g = hvd.allreduce(np.ones((4,), np.float32), op="sum")
+                g = hvd.allreduce(np.ones((4,), np.float32), op="sum",
+                                  name="elastic_step_grad")
             st.params = {"w": st.params["w"] + np.asarray(g) / now}
             st.step += 1
             if (mode == "crash" and my_host == CRASH_HOSTNAME

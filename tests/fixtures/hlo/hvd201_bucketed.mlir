@@ -1,0 +1,98 @@
+module @jit_local_step attributes {mhlo.num_partitions = 8 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<1448x1448xf32> {jax.buffer_donor = true}, %arg1: tensor<1448x1448xf32> {jax.buffer_donor = true}, %arg2: tensor<1024x1448xf32>) -> (tensor<1448x1448xf32> {jax.result_info = "[0]['w0']"}, tensor<1448x1448xf32> {jax.result_info = "[0]['w1']"}, tensor<1024x1448xf32> {jax.result_info = "[1]"}) {
+    %0 = stablehlo.custom_call @Sharding(%arg0) {backend_config = "", mhlo.sharding = "{replicated}"} : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %1 = stablehlo.custom_call @SPMDFullToShardShape(%0) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %2 = stablehlo.custom_call @Sharding(%arg1) {backend_config = "", mhlo.sharding = "{replicated}"} : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %3 = stablehlo.custom_call @SPMDFullToShardShape(%2) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %4 = stablehlo.custom_call @Sharding(%arg2) {backend_config = "", mhlo.sharding = "{devices=[8,1]<=[8]}"} : (tensor<1024x1448xf32>) -> tensor<1024x1448xf32>
+    %5 = stablehlo.custom_call @SPMDFullToShardShape(%4) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<1024x1448xf32>) -> tensor<128x1448xf32>
+    %6:3 = call @shmap_body(%1, %3, %5) : (tensor<1448x1448xf32>, tensor<1448x1448xf32>, tensor<128x1448xf32>) -> (tensor<1448x1448xf32>, tensor<1448x1448xf32>, tensor<128x1448xf32>)
+    %7 = stablehlo.custom_call @Sharding(%6#0) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %8 = stablehlo.custom_call @SPMDShardToFullShape(%7) {backend_config = "", mhlo.sharding = "{replicated}"} : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %9 = stablehlo.custom_call @Sharding(%6#1) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %10 = stablehlo.custom_call @SPMDShardToFullShape(%9) {backend_config = "", mhlo.sharding = "{replicated}"} : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %11 = stablehlo.custom_call @Sharding(%6#2) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<128x1448xf32>) -> tensor<128x1448xf32>
+    %12 = stablehlo.custom_call @SPMDShardToFullShape(%11) {backend_config = "", mhlo.sharding = "{devices=[8,1]<=[8]}"} : (tensor<128x1448xf32>) -> tensor<1024x1448xf32>
+    return %8, %10, %12 : tensor<1448x1448xf32>, tensor<1448x1448xf32>, tensor<1024x1448xf32>
+  }
+  func.func private @shmap_body(%arg0: tensor<1448x1448xf32>, %arg1: tensor<1448x1448xf32>, %arg2: tensor<128x1448xf32>) -> (tensor<1448x1448xf32> {jax.result_info = "[None, None]"}, tensor<1448x1448xf32> {jax.result_info = "[None, None]"}, tensor<128x1448xf32> {jax.result_info = "[('hvd',), None]"}) {
+    %0 = stablehlo.dot_general %arg2, %arg0, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<128x1448xf32>, tensor<1448x1448xf32>) -> tensor<128x1448xf32>
+    %1 = stablehlo.tanh %0 : tensor<128x1448xf32>
+    %cst = stablehlo.constant dense<1.000000e+00> : tensor<f32>
+    %2 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<f32>) -> tensor<128x1448xf32>
+    %3 = stablehlo.subtract %2, %1 : tensor<128x1448xf32>
+    %4 = stablehlo.dot_general %1, %arg1, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<128x1448xf32>, tensor<1448x1448xf32>) -> tensor<128x1448xf32>
+    %5 = stablehlo.tanh %4 : tensor<128x1448xf32>
+    %6 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<f32>) -> tensor<128x1448xf32>
+    %7 = stablehlo.subtract %6, %5 : tensor<128x1448xf32>
+    %cst_0 = stablehlo.constant dense<2.000000e+00> : tensor<f32>
+    %8 = stablehlo.broadcast_in_dim %cst_0, dims = [] : (tensor<f32>) -> tensor<128x1448xf32>
+    %9 = stablehlo.multiply %8, %5 : tensor<128x1448xf32>
+    %10 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<f32>) -> tensor<128x1448xf32>
+    %11 = stablehlo.multiply %10, %9 : tensor<128x1448xf32>
+    %12 = stablehlo.multiply %11, %7 : tensor<128x1448xf32>
+    %13 = stablehlo.multiply %12, %5 : tensor<128x1448xf32>
+    %14 = stablehlo.add %12, %13 : tensor<128x1448xf32>
+    %15 = stablehlo.dot_general %14, %1, contracting_dims = [0] x [0], precision = [DEFAULT, DEFAULT] : (tensor<128x1448xf32>, tensor<128x1448xf32>) -> tensor<1448x1448xf32>
+    %16 = stablehlo.transpose %15, dims = [1, 0] : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %17 = stablehlo.dot_general %14, %arg1, contracting_dims = [1] x [1], precision = [DEFAULT, DEFAULT] : (tensor<128x1448xf32>, tensor<1448x1448xf32>) -> tensor<128x1448xf32>
+    %18 = stablehlo.multiply %17, %3 : tensor<128x1448xf32>
+    %19 = stablehlo.multiply %18, %1 : tensor<128x1448xf32>
+    %20 = stablehlo.add %18, %19 : tensor<128x1448xf32>
+    %21 = stablehlo.dot_general %20, %arg2, contracting_dims = [0] x [0], precision = [DEFAULT, DEFAULT] : (tensor<128x1448xf32>, tensor<128x1448xf32>) -> tensor<1448x1448xf32>
+    %22 = stablehlo.transpose %21, dims = [1, 0] : (tensor<1448x1448xf32>) -> tensor<1448x1448xf32>
+    %23 = stablehlo.broadcast_in_dim %22, dims = [1, 2] : (tensor<1448x1448xf32>) -> tensor<1x1448x1448xf32>
+    %24 = stablehlo.broadcast_in_dim %16, dims = [1, 2] : (tensor<1448x1448xf32>) -> tensor<1x1448x1448xf32>
+    %25 = stablehlo.reshape %23 : (tensor<1x1448x1448xf32>) -> tensor<1x2096704xf32>
+    %26 = stablehlo.reshape %24 : (tensor<1x1448x1448xf32>) -> tensor<1x2096704xf32>
+    %27 = stablehlo.slice %26 [0:1, 0:1048352] : (tensor<1x2096704xf32>) -> tensor<1x1048352xf32>
+    %28 = "stablehlo.all_reduce"(%27) <{channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>, replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>, use_global_device_ids}> ({
+    ^bb0(%arg3: tensor<f32>, %arg4: tensor<f32>):
+      %57 = stablehlo.add %arg3, %arg4 : tensor<f32>
+      stablehlo.return %57 : tensor<f32>
+    }) : (tensor<1x1048352xf32>) -> tensor<1x1048352xf32>
+    %cst_1 = stablehlo.constant dense<8.000000e+00> : tensor<f32>
+    %29 = stablehlo.broadcast_in_dim %cst_1, dims = [] : (tensor<f32>) -> tensor<1x1048352xf32>
+    %30 = stablehlo.divide %28, %29 : tensor<1x1048352xf32>
+    %31 = stablehlo.slice %26 [0:1, 1048352:2096704] : (tensor<1x2096704xf32>) -> tensor<1x1048352xf32>
+    %32 = "stablehlo.all_reduce"(%31) <{channel_handle = #stablehlo.channel_handle<handle = 2, type = 1>, replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>, use_global_device_ids}> ({
+    ^bb0(%arg3: tensor<f32>, %arg4: tensor<f32>):
+      %57 = stablehlo.add %arg3, %arg4 : tensor<f32>
+      stablehlo.return %57 : tensor<f32>
+    }) : (tensor<1x1048352xf32>) -> tensor<1x1048352xf32>
+    %33 = stablehlo.broadcast_in_dim %cst_1, dims = [] : (tensor<f32>) -> tensor<1x1048352xf32>
+    %34 = stablehlo.divide %32, %33 : tensor<1x1048352xf32>
+    %35 = stablehlo.slice %25 [0:1, 0:1048352] : (tensor<1x2096704xf32>) -> tensor<1x1048352xf32>
+    %36 = "stablehlo.all_reduce"(%35) <{channel_handle = #stablehlo.channel_handle<handle = 3, type = 1>, replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>, use_global_device_ids}> ({
+    ^bb0(%arg3: tensor<f32>, %arg4: tensor<f32>):
+      %57 = stablehlo.add %arg3, %arg4 : tensor<f32>
+      stablehlo.return %57 : tensor<f32>
+    }) : (tensor<1x1048352xf32>) -> tensor<1x1048352xf32>
+    %37 = stablehlo.broadcast_in_dim %cst_1, dims = [] : (tensor<f32>) -> tensor<1x1048352xf32>
+    %38 = stablehlo.divide %36, %37 : tensor<1x1048352xf32>
+    %39 = stablehlo.slice %25 [0:1, 1048352:2096704] : (tensor<1x2096704xf32>) -> tensor<1x1048352xf32>
+    %40 = "stablehlo.all_reduce"(%39) <{channel_handle = #stablehlo.channel_handle<handle = 4, type = 1>, replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>, use_global_device_ids}> ({
+    ^bb0(%arg3: tensor<f32>, %arg4: tensor<f32>):
+      %57 = stablehlo.add %arg3, %arg4 : tensor<f32>
+      stablehlo.return %57 : tensor<f32>
+    }) : (tensor<1x1048352xf32>) -> tensor<1x1048352xf32>
+    %41 = stablehlo.broadcast_in_dim %cst_1, dims = [] : (tensor<f32>) -> tensor<1x1048352xf32>
+    %42 = stablehlo.divide %40, %41 : tensor<1x1048352xf32>
+    %43 = stablehlo.concatenate %38, %42, dim = 1 : (tensor<1x1048352xf32>, tensor<1x1048352xf32>) -> tensor<1x2096704xf32>
+    %44 = stablehlo.reshape %43 : (tensor<1x2096704xf32>) -> tensor<1x1448x1448xf32>
+    %45 = stablehlo.concatenate %30, %34, dim = 1 : (tensor<1x1048352xf32>, tensor<1x1048352xf32>) -> tensor<1x2096704xf32>
+    %46 = stablehlo.reshape %45 : (tensor<1x2096704xf32>) -> tensor<1x1448x1448xf32>
+    %47 = stablehlo.slice %44 [0:1, 0:1448, 0:1448] : (tensor<1x1448x1448xf32>) -> tensor<1x1448x1448xf32>
+    %48 = stablehlo.reshape %47 : (tensor<1x1448x1448xf32>) -> tensor<1448x1448xf32>
+    %49 = stablehlo.slice %46 [0:1, 0:1448, 0:1448] : (tensor<1x1448x1448xf32>) -> tensor<1x1448x1448xf32>
+    %50 = stablehlo.reshape %49 : (tensor<1x1448x1448xf32>) -> tensor<1448x1448xf32>
+    %cst_2 = stablehlo.constant dense<1.000000e-01> : tensor<f32>
+    %51 = stablehlo.broadcast_in_dim %cst_2, dims = [] : (tensor<f32>) -> tensor<1448x1448xf32>
+    %52 = stablehlo.multiply %51, %48 : tensor<1448x1448xf32>
+    %53 = stablehlo.subtract %arg0, %52 : tensor<1448x1448xf32>
+    %54 = stablehlo.broadcast_in_dim %cst_2, dims = [] : (tensor<f32>) -> tensor<1448x1448xf32>
+    %55 = stablehlo.multiply %54, %50 : tensor<1448x1448xf32>
+    %56 = stablehlo.subtract %arg1, %55 : tensor<1448x1448xf32>
+    return %53, %56, %arg2 : tensor<1448x1448xf32>, tensor<1448x1448xf32>, tensor<128x1448xf32>
+  }
+}

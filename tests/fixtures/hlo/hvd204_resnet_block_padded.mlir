@@ -1,0 +1,16 @@
+module @jit_block attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<8x16x16x128xbf16>, %arg1: tensor<3x3x128x128xbf16>, %arg2: tensor<3x3x128x128xbf16>) -> (tensor<8x16x16x128xbf16> {jax.result_info = ""}) {
+    %0 = stablehlo.convolution(%arg0, %arg1) dim_numbers = [b, 0, 1, f]x[0, 1, i, o]->[b, 0, 1, f], window = {stride = [1, 1], pad = [[1, 1], [1, 1]], lhs_dilate = [1, 1], rhs_dilate = [1, 1], reverse = [false, false]} {batch_group_count = 1 : i64, feature_group_count = 1 : i64, precision_config = [#stablehlo<precision DEFAULT>, #stablehlo<precision DEFAULT>]} : (tensor<8x16x16x128xbf16>, tensor<3x3x128x128xbf16>) -> tensor<8x16x16x128xbf16>
+    %1 = call @relu(%0) : (tensor<8x16x16x128xbf16>) -> tensor<8x16x16x128xbf16>
+    %2 = stablehlo.convolution(%1, %arg2) dim_numbers = [b, 0, 1, f]x[0, 1, i, o]->[b, 0, 1, f], window = {stride = [1, 1], pad = [[1, 1], [1, 1]], lhs_dilate = [1, 1], rhs_dilate = [1, 1], reverse = [false, false]} {batch_group_count = 1 : i64, feature_group_count = 1 : i64, precision_config = [#stablehlo<precision DEFAULT>, #stablehlo<precision DEFAULT>]} : (tensor<8x16x16x128xbf16>, tensor<3x3x128x128xbf16>) -> tensor<8x16x16x128xbf16>
+    %3 = stablehlo.add %2, %arg0 : tensor<8x16x16x128xbf16>
+    %4 = call @relu(%3) : (tensor<8x16x16x128xbf16>) -> tensor<8x16x16x128xbf16>
+    return %4 : tensor<8x16x16x128xbf16>
+  }
+  func.func private @relu(%arg0: tensor<8x16x16x128xbf16>) -> tensor<8x16x16x128xbf16> {
+    %cst = stablehlo.constant dense<0.000000e+00> : tensor<bf16>
+    %0 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<bf16>) -> tensor<8x16x16x128xbf16>
+    %1 = stablehlo.maximum %arg0, %0 : tensor<8x16x16x128xbf16>
+    return %1 : tensor<8x16x16x128xbf16>
+  }
+}

@@ -1,0 +1,8 @@
+module @jit__lambda_ attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<128x256xbf16>, %arg1: tensor<256x128xf32>) -> (tensor<128x128xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.convert %arg0 : (tensor<128x256xbf16>) -> tensor<128x256xf32>
+    %1 = stablehlo.tanh %0 : tensor<128x256xf32>
+    %2 = stablehlo.dot_general %1, %arg1, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<128x256xf32>, tensor<256x128xf32>) -> tensor<128x128xf32>
+    return %2 : tensor<128x128xf32>
+  }
+}

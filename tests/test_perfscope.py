@@ -667,3 +667,92 @@ def test_accumulation_microbatches_not_counted_as_steps(fresh, hvd):
     assert s["steps"] == 2, s
     # every recorded step contains the fired collective + apply
     assert "comms" in s["phases_s"] and "optimizer" in s["phases_s"]
+
+
+# ------------------------- flops cost_analysis() shape handling
+# (ISSUE 8 satellite: both shapes jax has shipped, pinned by fixture)
+
+class _FakeCompiled:
+    """Stands in for jit(f).lower(...).compile(): only cost_analysis()
+    is consulted by compiled_cost_flops."""
+
+    def __init__(self, ca):
+        self._ca = ca
+
+    def cost_analysis(self):
+        if isinstance(self._ca, Exception):
+            raise self._ca
+        return self._ca
+
+
+def test_flops_cost_analysis_dict_form(fresh):
+    """Newer JAX: cost_analysis() returns ONE dict."""
+    assert F.compiled_cost_flops(_FakeCompiled({"flops": 123.0})) == 123.0
+    # missing / zero / garbage flops entries all mean "no cost model"
+    assert F.compiled_cost_flops(_FakeCompiled({})) is None
+    assert F.compiled_cost_flops(_FakeCompiled({"flops": 0.0})) is None
+    assert F.compiled_cost_flops(_FakeCompiled({"flops": "n/a"})) is None
+
+
+def test_flops_cost_analysis_per_device_list_form(fresh):
+    """Older JAX: cost_analysis() returns a per-device list of dicts;
+    under SPMD the module is per-device code, so any populated entry
+    describes the program."""
+    assert F.compiled_cost_flops(
+        _FakeCompiled([{"flops": 7.0}, {"flops": 7.0}])) == 7.0
+    # device 0's dict can be empty on some backends: later entries count
+    assert F.compiled_cost_flops(
+        _FakeCompiled([{}, {"flops": 9.0}])) == 9.0
+    # -1 / non-numeric placeholders must not shadow a populated entry
+    assert F.compiled_cost_flops(
+        _FakeCompiled([{"flops": -1}, {"flops": 9.0}])) == 9.0
+    assert F.compiled_cost_flops(
+        _FakeCompiled([{"flops": "n/a"}, {"flops": 9.0}])) == 9.0
+    assert F.compiled_cost_flops(_FakeCompiled([])) is None
+    assert F.compiled_cost_flops(_FakeCompiled(["bogus"])) is None
+    assert F.compiled_cost_flops(_FakeCompiled((({"flops": 5.0},)))) == 5.0
+
+
+def test_flops_cost_analysis_failure_paths(fresh):
+    assert F.compiled_cost_flops(
+        _FakeCompiled(RuntimeError("no cost model"))) is None
+    assert F.compiled_cost_flops(_FakeCompiled("not a dict")) is None
+
+
+# ------------------------- perf_gate --update refusal (ISSUE 8
+# satellite: a broken run must not silently become the new baseline)
+
+def test_perf_gate_update_errors_refuse_broken_runs(fresh):
+    good = {"sections": {"sec": _gate_profile()}}
+    assert perf_gate.update_errors(good) == []
+    low_cov = {"sections": {"sec": _gate_profile(coverage=0.5)}}
+    assert any("coverage" in e
+               for e in perf_gate.update_errors(low_cov))
+    fb = {"sections": {"sec": _gate_profile(mfu_source="fallback")}}
+    assert any("fallback" in e for e in perf_gate.update_errors(fb))
+    assert perf_gate.update_errors({"sections": {}})  # nothing profiled
+
+
+def test_perf_gate_update_cli_refuses_and_preserves_baseline(
+        fresh, tmp_path):
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(
+        {"platform": "cpu",
+         "sections": {"sec": _gate_profile(mfu_source="fallback")}}))
+    base = tmp_path / "base.json"
+    base.write_text("{\"sentinel\": true}")
+    rc = perf_gate.main([str(cur), "--baseline", str(base), "--update"])
+    assert rc == 1
+    # the refusal must not have touched the existing baseline
+    assert json.loads(base.read_text()) == {"sentinel": True}
+
+
+def test_perf_gate_update_cli_accepts_healthy_run(fresh, tmp_path):
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(
+        {"platform": "cpu", "sections": {"sec": _gate_profile()}}))
+    base = tmp_path / "base.json"
+    rc = perf_gate.main([str(cur), "--baseline", str(base), "--update"])
+    assert rc == 0
+    doc = json.loads(base.read_text())
+    assert "sec" in doc["sections"]
