@@ -16,6 +16,11 @@
 #                    SIGKILL) asserting the doctor names the stalled
 #                    rank and the last-agreed collective
 #                    (docs/observability.md, docs/troubleshooting.md)
+#   make serve-smoke serving tier (docs/serving.md): the deterministic
+#                    unit suite plus the 2-process elastic serving e2e
+#                    — SIGKILL one replica under continuous load; zero
+#                    accepted requests dropped, p99 bounded through the
+#                    failover, hvddoctor names the dead replica
 #   make perf-gate   perfscope CI sentinel: emit StepProfiles from the
 #                    synthetic workloads and gate them against the
 #                    checked-in scripts/perf_baseline.json (structure
@@ -43,9 +48,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline metrics race doctor-smoke fusion-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline metrics race doctor-smoke serve-smoke fusion-smoke perf-gate
 
-test: lint hlo-lint test-unit test-multiprocess test-e2e chaos doctor-smoke fusion-smoke perf-gate entry
+test: lint hlo-lint test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke fusion-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -78,6 +83,13 @@ doctor-smoke:
 	$(PYTEST) tests/test_flight.py tests/test_perfscope.py
 	$(PYTEST) tests/test_flight_e2e.py tests/test_perfscope_e2e.py \
 	    --run-faults -m faults
+
+# Serving tier (docs/serving.md): the fake-clock batcher/engine/pool
+# unit suite runs in tier 1 too; the 2-process elastic serving e2e
+# (faults marker — SIGKILL a replica mid-flight under load) only here.
+serve-smoke:
+	$(PYTEST) tests/test_serve.py
+	$(PYTEST) tests/test_serve_e2e.py --run-faults -m faults
 
 # perfscope CI sentinel (docs/perf.md): emit StepProfiles from the
 # synthetic CPU workloads and compare against the checked-in baseline.
@@ -133,7 +145,7 @@ race:
 	    tests/test_timeline.py tests/test_metrics.py \
 	    tests/test_flight.py tests/test_perfscope.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
-	    tests/test_hvdlint.py \
+	    tests/test_hvdlint.py tests/test_serve.py \
 	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache
 
 entry:

@@ -677,6 +677,144 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
     return out
 
 
+def bench_serving(on_cpu, duration=None, threads=8):
+    """Serving tier under load (docs/serving.md): an in-process
+    loopback replica pool — frontend → continuous batcher → per-bucket
+    AOT engine — driven by paced client threads approximating open-loop
+    arrivals. Reports requests/sec/chip and p50/p99 end-to-end request
+    latency (the serving acceptance numbers), mean formed batch size,
+    and the engine's hvdhlo stamp of the lowered inference program.
+
+    Loopback on one host: the numbers measure the service's control
+    plane + batching + a real AOT device step, not multi-host fanout —
+    both replicas share device 0, so chips=1 in the per-chip rate."""
+    import threading as th
+
+    from horovod_tpu.runner import secret as secret_mod
+    from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+    from horovod_tpu.serve.batching import ContinuousBatcher
+    from horovod_tpu.serve.engine import InferenceEngine
+    from horovod_tpu.serve.frontend import Frontend, ServeClient
+    from horovod_tpu.serve.pool import ReplicaPool
+    from horovod_tpu.serve.replica import ReplicaServer
+
+    duration = duration or (2.0 if on_cpu else 6.0)
+    # lane-aligned dims: the engine's own hvdhlo stamp (HVD204) holds
+    # this model to the padding guidance it reports on
+    features, hidden = 128, 256
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    params = {
+        "w1": jax.random.normal(k1, (features, hidden), jnp.float32) / 8,
+        "w2": jax.random.normal(k2, (hidden, 1), jnp.float32) / 16,
+    }
+
+    def infer_fn(p, x):
+        return jnp.maximum(x @ p["w1"], 0.0) @ p["w2"]
+
+    secret = secret_mod.make_secret_key().encode()
+    rdv = RendezvousServer(secret=secret)
+    rdv_port = rdv.start()
+    batcher = ContinuousBatcher(max_batch=16, max_wait_s=0.002,
+                                depth=4096)
+    replicas = []
+    stops = []
+    lock = th.Lock()
+    lat = []      # guarded-by: lock
+    fails = []    # guarded-by: lock
+    stop_load = th.Event()
+    load_threads = []
+    try:
+        for r in range(2):
+            rep = ReplicaServer(
+                InferenceEngine(infer_fn, params, name=f"bench{r}"),
+                kv=KVClient("127.0.0.1", rdv_port, secret=secret),
+                secret=secret)
+            rep.ident.update({"rank": r, "local_rank": r})
+            rep.engine.warmup((features,), np.float32, batcher.buckets)
+            rep.start()
+            replicas.append(rep)
+        pool = ReplicaPool(rdv, batcher, secret=secret,
+                           discovery_interval=0.05)
+        pool.start()
+        stops.append(pool.stop)
+        pool.wait_for_replicas(2, timeout=60)
+        frontend = Frontend(batcher, secret=secret, port=0)
+        front_port = frontend.start()
+        stops.append(frontend.stop)
+        addr = ("127.0.0.1", front_port)
+
+        probe = ServeClient(addr, secret=secret)
+        probe.infer(np.ones((features,), np.float32))  # prime the path
+        probe.close()
+
+        def load_worker():
+            c = ServeClient(addr, secret=secret)
+            x = np.ones((features,), np.float32)
+            try:
+                while not stop_load.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        c.infer(x)
+                    except Exception as e:
+                        with lock:
+                            fails.append(_err_str(e))
+                        return
+                    with lock:
+                        lat.append(time.perf_counter() - t0)
+                    time.sleep(0.002)
+            finally:
+                c.close()
+
+        t_start = time.perf_counter()
+        load_threads = [th.Thread(target=load_worker, daemon=True)
+                        for _ in range(threads)]
+        for t in load_threads:
+            t.start()
+        time.sleep(duration)
+        stop_load.set()
+        for t in load_threads:
+            t.join(timeout=30)
+        wall = time.perf_counter() - t_start
+
+        with lock:
+            samples = sorted(lat)
+            errors = list(fails)
+        if not samples:
+            raise RuntimeError(
+                "serving bench completed zero requests: "
+                + "; ".join(errors[:3]))
+        n = len(samples)
+        p50 = samples[n // 2]
+        p99 = samples[min(n - 1, int(n * 0.99))]
+        batches = pool.batches_done
+        return {
+            "requests": n,
+            "wall_seconds": round(wall, 3),
+            "requests_per_sec": round(n / wall, 1),
+            "requests_per_sec_per_chip": round(n / wall, 1),
+            "chips": 1,
+            "replicas": len(replicas),
+            "p50_ms": round(p50 * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "batches": batches,
+            "mean_batch_size": round(n / max(batches, 1), 2),
+            "max_batch": batcher.max_batch,
+            "buckets": list(batcher.buckets),
+            "load_threads": threads,
+            "hlo_lint": replicas[0].engine.hlo_lint() or None,
+            "client_errors": errors[:5] or None,
+        }
+    finally:
+        stop_load.set()
+        for t in load_threads:
+            t.join(timeout=10)
+        for s in stops:
+            s()
+        for rep in replicas:
+            rep.stop()
+        rdv.stop()
+
+
 # --------------------------------------------------------------------------
 # Fusion sweep + autotune on an 8-device virtual CPU mesh (subprocess).
 #
@@ -1179,6 +1317,11 @@ def main():
     flash = None if on_cpu else stamp(
         _section("flash_attention", bench_flash_attention),
         "flash_attention")
+    # Serving tier (docs/serving.md): loopback replica pool under paced
+    # load. Control-plane + batching + one AOT device step per batch —
+    # no window stamp; the number is dominated by the service, not the
+    # device/tunnel window.
+    serving = _section("serving", bench_serving, on_cpu)
 
     per_chip_ips = best["images_per_sec_per_chip"] if best else None
     print(json.dumps({
@@ -1205,6 +1348,7 @@ def main():
             "lm_overlap_train_step": lm_overlap,
             "autotune": autotune,
             "flash_attention_s8192": flash,
+            "serving": serving,
             "section_errors": _SECTION_ERRORS or None,
         },
     }), flush=True)

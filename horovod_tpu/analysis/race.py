@@ -70,6 +70,8 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.analysis.verifier",
     "horovod_tpu.core.topology",
     "horovod_tpu.core.process_sets",
+    "horovod_tpu.serve.batching",
+    "horovod_tpu.serve.pool",
 )
 
 _LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))

@@ -45,15 +45,51 @@ def _to_saveable(tree: Any) -> Any:
         lambda x: np.asarray(x) if isinstance(x, np.generic) else x, tree)
 
 
+def _peer_env() -> bool:
+    """True when the launcher env says this process has PEERS
+    (HOROVOD_SIZE > 1, or a nonzero HOROVOD_RANK): the uninitialized
+    `save` leniency must not extend to a multi-process job, where N
+    uninitialized workers would race the same path barrier-free."""
+    from horovod_tpu.common import config as _config
+    try:
+        if int(os.environ.get(_config.HOROVOD_SIZE, "1") or "1") > 1:
+            return True
+        if int(os.environ.get(_config.HOROVOD_RANK, "0") or "0") > 0:
+            return True
+    except ValueError:
+        return True  # unparseable peer env: refuse rather than race
+    return False
+
+
 def save(path: str, tree: Any, *, all_ranks_barrier: bool = True) -> None:
     """Write a pytree checkpoint from rank 0 (reference convention:
     rank-0-only saves); other ranks wait at a barrier so the checkpoint
-    is durable before anyone races ahead."""
-    if topology.rank() == 0:
+    is durable before anyone races ahead.
+
+    Works without an initialized topology too (single-process tools,
+    serving-side scripts): an uninitialized process acts as rank 0 and
+    skips the barrier — there are no peers to synchronize with. That
+    leniency is fenced to genuinely solo processes: a worker spawned by
+    a multi-process launcher (HOROVOD_RANK/HOROVOD_SIZE in the env)
+    that saves before `hvd.init()` still fails fast — N uninitialized
+    peers would otherwise all write `path` concurrently with no
+    barrier and corrupt the checkpoint."""
+    rank = topology.rank_or_none()
+    if rank is None and _peer_env():
+        from horovod_tpu.common import config as _config
+        raise RuntimeError(
+            "checkpoint.save() called before hvd.init() in a "
+            f"multi-process job ({_config.HOROVOD_RANK}="
+            f"{os.environ.get(_config.HOROVOD_RANK)!r}, "
+            f"{_config.HOROVOD_SIZE}="
+            f"{os.environ.get(_config.HOROVOD_SIZE)!r}): every peer "
+            "would race the same checkpoint path with no barrier. "
+            "Call hvd.init() first.")
+    if rank is None or rank == 0:
         cp = _checkpointer()
         cp.save(os.path.abspath(path), _to_saveable(tree), force=True)
         cp.wait_until_finished()
-    if all_ranks_barrier and topology.size() > 1:
+    if all_ranks_barrier and rank is not None and topology.size() > 1:
         from horovod_tpu.ops import collectives
         collectives.barrier()
 
@@ -77,6 +113,38 @@ def restore(path: str, like: Optional[Any] = None) -> Any:
             lambda l, r: type(l)(np.asarray(r)[()])
             if isinstance(l, np.generic) else r, like, out)
     return out
+
+
+def restore_params(path: str, like: Optional[Any] = None,
+                   key: str = "params") -> Any:
+    """Load ONLY the `key` subtree (default ``"params"``) of a training
+    checkpoint: the rest of the tree (optimizer state) is read as raw
+    arrays and discarded, never materialized into optimizer types — so
+    a serving replica can restore weights without constructing (or even
+    being able to import) the optimizer that trained them.
+
+    The checkpoint is read structure-free (orbax target=None), so the
+    optimizer subtree's types never need to be constructible here; when
+    `like` is given its structure is validated against the params
+    subtree and numpy-scalar leaves are coerced back (same contract as
+    `restore`)."""
+    import jax
+
+    tree = restore(path)
+    if not isinstance(tree, dict) or key not in tree:
+        have = sorted(tree) if isinstance(tree, dict) else type(tree)
+        raise KeyError(
+            f"checkpoint {path} has no {key!r} subtree (top-level keys: "
+            f"{have}); pass key=... for checkpoints saved under a "
+            f"different name")
+    params = tree[key]
+    if like is not None:
+        # tree_map validates the structures match; the map coerces
+        # numpy scalar leaves like restore(like=...) does.
+        params = jax.tree_util.tree_map(
+            lambda l, r: type(l)(np.asarray(r)[()])
+            if isinstance(l, np.generic) else r, like, params)
+    return params
 
 
 def latest_step(root: str) -> Optional[int]:

@@ -327,6 +327,26 @@ class _Stream:
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
+    def _put(self, item) -> bool:
+        """Bounded-queue put that stays responsive to stop().
+
+        A plain q.put() blocks FOREVER once the prefetch queue is full
+        and the consumer is gone — the exact shape of an abrupt client
+        disconnect: the handler thread dies with the connection, nobody
+        drains the queue, and the producer thread leaks blocked in put()
+        past worker.stop(). Poll with a short timeout instead, so the
+        producer notices the stop flag and exits promptly.
+        """
+        import queue
+
+        while not self._stop.is_set():
+            try:
+                self.q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _produce(self):
         import cloudpickle
 
@@ -344,9 +364,9 @@ class _Stream:
                 if deadline is None:
                     deadline = time.monotonic() + w.dispatcher_timeout
                 if time.monotonic() > deadline:
-                    self.q.put(("error",
-                                f"dispatcher unreachable: {e}"))
-                    self.q.put(("end", None))
+                    self._put(("error",
+                               f"dispatcher unreachable: {e}"))
+                    self._put(("end", None))
                     return
                 time.sleep(w.poll_interval)
                 continue
@@ -355,7 +375,7 @@ class _Stream:
                 break
             if st[0] == "exhausted":
                 # late/restarted worker: no shard left — empty stream
-                self.q.put(("end", None))
+                self._put(("end", None))
                 return
             time.sleep(w.poll_interval)
         else:
@@ -365,10 +385,11 @@ class _Stream:
             for batch in fn(shard, num_shards):
                 if self._stop.is_set():
                     return
-                self.q.put(("batch", batch))
+                if not self._put(("batch", batch)):
+                    return  # stopped while the queue was full
         except Exception as e:  # surface preprocessing errors to clients
-            self.q.put(("error", f"{type(e).__name__}: {e}"))
-        self.q.put(("end", None))
+            self._put(("error", f"{type(e).__name__}: {e}"))
+        self._put(("end", None))
 
     def next_response(self):
         item = self.q.get()

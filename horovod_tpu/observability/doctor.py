@@ -376,6 +376,105 @@ def analyze_perf(summaries: List[Dict[str, Any]]
     return {"rounds": out_rounds, "stragglers": stragglers}
 
 
+#: serve-event identity: "replica rank=<r> host=<h> pid=<p> ..." (both
+#: the replica's own events and the pool's use this shape —
+#: serve/replica.py, serve/pool.py).
+_SERVE_RE = None
+
+
+def _serve_fields(desc: str) -> Optional[Dict[str, Any]]:
+    global _SERVE_RE
+    import re
+    if _SERVE_RE is None:
+        _SERVE_RE = re.compile(
+            r"replica rank=(\d+) host=(\S+) pid=(\d+)")
+    m = _SERVE_RE.search(desc)
+    if not m:
+        return None
+    out: Dict[str, Any] = {"rank": int(m.group(1)), "host": m.group(2),
+                           "pid": int(m.group(3))}
+    for k in ("batches", "requeued", "port", "round"):
+        km = re.search(rf"\b{k}=(\d+)", desc)
+        if km:
+            out[k] = int(km.group(1))
+    return out
+
+
+def analyze_serve(dumps: List[RankDump]) -> Optional[Dict[str, Any]]:
+    """Serving-tier analysis from flight `serve` events: replica
+    lifecycle (UP/ADOPTED → DRAINED or DEAD) and, headline, every
+    replica DEATH with how many in-flight requests were requeued — the
+    'which replica died under load' question a serving postmortem
+    starts with (docs/serving.md, docs/troubleshooting.md)."""
+    replicas: Dict[Tuple, Dict[str, Any]] = {}
+    deaths: List[Dict[str, Any]] = []
+    other: List[str] = []
+    # Supplemental requeue trail: when a stale-heartbeat eviction races
+    # a failed submit, the DEAD event carries requeued=0 and the pool
+    # records a separate "late requeue after eviction ... requeued=N"
+    # event — folded into the death's total below so the headline never
+    # under-reports. Deduped by (timestamp, desc): the same launcher
+    # event can appear in both a full dump and a KV tail.
+    late: Dict[Tuple, int] = {}
+    late_seen: set = set()
+    seen = False
+    for d in dumps:
+        for ev in d.events:
+            if len(ev) < 4 or ev[2] != "serve":
+                continue
+            seen = True
+            desc = str(ev[3])
+            fields = _serve_fields(desc)
+            if fields is None:
+                if not any(desc == o for o in other):
+                    other.append(desc)
+                continue
+            key = (fields["rank"], fields["host"], fields["pid"])
+            info = replicas.setdefault(
+                key, {"rank": fields["rank"], "host": fields["host"],
+                      "pid": fields["pid"], "state": "up",
+                      "batches": 0, "requeued": 0})
+            if "batches" in fields:
+                info["batches"] = max(info["batches"], fields["batches"])
+            if "late requeue" in desc:
+                evkey = (float(ev[1]), desc)
+                if evkey not in late_seen:
+                    late_seen.add(evkey)
+                    late[key] = late.get(key, 0) \
+                        + fields.get("requeued", 0)
+                continue
+            if " DEAD " in desc or desc.rstrip().endswith("DEAD"):
+                info["state"] = "dead"
+                info["requeued"] = fields.get("requeued", 0)
+                death = {**info, "time": float(ev[1])}
+                if not any(dd["pid"] == info["pid"]
+                           and dd["rank"] == info["rank"]
+                           for dd in deaths):
+                    deaths.append(death)
+            elif "DRAINED" in desc and info["state"] != "dead":
+                info["state"] = "drained"
+            elif "EVICTED" in desc and info["state"] != "dead":
+                # The replica's own terminal event when it exits rc 1
+                # on a pid-pinned die order (troubleshooting.md) — in a
+                # tail-only merge this is the only record of the exit,
+                # and rendering it as UP would misread a terminal exit
+                # as a live replica.
+                info["state"] = "evicted"
+    if not seen:
+        return None
+    for key, n in late.items():
+        if key in replicas:
+            replicas[key]["requeued"] += n
+        for dd in deaths:
+            if (dd["rank"], dd["host"], dd["pid"]) == key:
+                dd["requeued"] += n
+    return {
+        "replicas": [replicas[k] for k in sorted(replicas)],
+        "deaths": sorted(deaths, key=lambda x: x["time"]),
+        "other_events": other[:10],
+    }
+
+
 def dedupe(dumps: List[RankDump]) -> List[RankDump]:
     """Collapse redundant dumps, keeping non-overlapping evidence.
 
@@ -509,6 +608,7 @@ def merge(dumps: List[RankDump], tail: int = 8,
         "triggers": {f"{d.rank}@r{d.round}": d.trigger for d in dumps},
         "groups": groups,
         "perf": analyze_perf(dedupe_perf(perf)) if perf else None,
+        "serve": analyze_serve(dumps),
         "per_rank": {},
     }
     for d in dumps:
@@ -603,6 +703,25 @@ def render(report: Dict[str, Any], tail: int = 8) -> str:
         if g["divergence"] is None and not g["stragglers"] \
                 and not g["missing"]:
             add("  all ranks in step at the end of the recorded window")
+        add("")
+    serve = report.get("serve")
+    if serve:
+        add("[serve] replica pool (flight `serve` events; "
+            "docs/serving.md)")
+        for info in serve["replicas"]:
+            state = info["state"].upper()
+            line = (f"  replica rank {info['rank']} "
+                    f"(host {info['host']}, pid {info['pid']}): {state}")
+            if info["batches"]:
+                line += f", {info['batches']} batch(es) served"
+            add(line)
+        for dd in serve["deaths"]:
+            add(f"  SERVE REPLICA DEATH: rank {dd['rank']} "
+                f"(host {dd['host']}, pid {dd['pid']}) — "
+                f"{dd['requeued']} in-flight request(s) requeued onto "
+                f"survivors")
+        if not serve["deaths"]:
+            add("  no replica deaths recorded")
         add("")
     perf = report.get("perf")
     if perf:

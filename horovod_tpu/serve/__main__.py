@@ -1,0 +1,5 @@
+import sys
+
+from horovod_tpu.serve.launcher import main
+
+sys.exit(main())

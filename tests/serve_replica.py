@@ -1,0 +1,65 @@
+"""Serving replica worker (driven by tests/test_serve_e2e.py).
+
+The serving analog of tests/elastic_worker.py: a real replica process
+spawned by `python -m horovod_tpu.serve`. It
+
+* restores its weights PARAMS-ONLY from the training checkpoint the
+  test saved (checkpoint.restore_params — no optimizer is constructed,
+  exercising the serving restore path end-to-end),
+* AOT-warms every batch bucket so serving never compiles in-band,
+* serves until the launcher drains it (exit 0), and
+* writes its pid to SERVE_TEST_PID_DIR/<hostname> so the test can
+  SIGKILL a specific replica mid-load.
+
+Model: y = x @ w + b on a (FEATURES,) input — small enough to serve at
+unit-test speed, real enough that every response value proves the
+checkpoint weights (not zeros) produced it.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+FEATURES = int(os.environ.get("SERVE_TEST_FEATURES", "4"))
+
+
+def infer_fn(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def main() -> int:
+    from horovod_tpu.serve.batching import ContinuousBatcher
+    from horovod_tpu.serve.engine import InferenceEngine
+    from horovod_tpu.serve.replica import serve_replica
+
+    pid_dir = os.environ.get("SERVE_TEST_PID_DIR", "")
+    if pid_dir:
+        host = os.environ.get("HOROVOD_HOSTNAME", "localhost")
+        os.makedirs(pid_dir, exist_ok=True)
+        with open(os.path.join(pid_dir, host), "w") as f:
+            f.write(str(os.getpid()))
+
+    like = {"w": np.zeros((FEATURES,), np.float32),
+            "b": np.zeros((), np.float32)}
+    engine = InferenceEngine.from_checkpoint(
+        os.environ["SERVE_TEST_CHECKPOINT"], infer_fn, like_params=like,
+        name="e2e")
+    assert float(jnp.sum(engine.params["w"])) != 0.0, \
+        "checkpoint params came back as zeros"
+
+    batcher = ContinuousBatcher()  # env-derived knobs = the job's knobs
+    engine.warmup((FEATURES,), np.float32, batcher.buckets)
+    lint = engine.hlo_lint()
+    print(f"SERVE_REPLICA_LINT programs={lint['programs']} "
+          f"count={lint['count']}", flush=True)
+    return serve_replica(engine)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

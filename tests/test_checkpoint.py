@@ -19,6 +19,72 @@ def test_save_restore_roundtrip(hvd, tmp_path):
     assert int(got["step"]) == 7
 
 
+def test_restore_params_only_no_optimizer(tmp_path):
+    """ISSUE 9 satellite: a serving replica loads a TRAINING checkpoint
+    (params + optimizer state) weights-only — no optimizer object is
+    constructed, and save/restore work without an initialized topology
+    (the single-process serving-tooling path: rank_or_none() is None)."""
+    from horovod_tpu import checkpoint as ckpt
+    params = {"w": jnp.arange(4, dtype=jnp.float32),
+              "b": jnp.float32(0.5)}
+    opt = {"mu": {"w": jnp.ones((4,), jnp.float32)},
+           "count": np.int64(7)}
+    path = str(tmp_path / "train_ck")
+    ckpt.save(path, {"params": params, "opt": opt})
+    like = {"w": np.zeros((4,), np.float32), "b": np.float32(0)}
+    got = ckpt.restore_params(path, like=like)
+    assert set(got) == {"w", "b"}
+    np.testing.assert_allclose(np.asarray(got["w"]),
+                               np.arange(4, dtype=np.float32))
+    assert float(got["b"]) == 0.5
+    # the numpy-scalar leaf came back as a scalar (same contract as
+    # restore(like=...)), not a 0-d array
+    assert isinstance(got["b"], np.generic)
+
+
+def test_save_fails_fast_uninit_with_peer_env(tmp_path, monkeypatch):
+    """The uninitialized-save leniency is fenced to genuinely solo
+    processes: a worker spawned by a multi-process launcher
+    (HOROVOD_SIZE>1 / nonzero HOROVOD_RANK) that saves before
+    hvd.init() fails fast instead of N peers racing the same path with
+    no barrier."""
+    from horovod_tpu import checkpoint as ckpt
+    monkeypatch.setattr(ckpt.topology, "rank_or_none", lambda: None)
+    path = str(tmp_path / "ck")
+    tree = {"x": np.zeros((2,), np.float32)}
+
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    with pytest.raises(RuntimeError, match="before hvd.init"):
+        ckpt.save(path, tree)
+    monkeypatch.setenv("HOROVOD_SIZE", "1")
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    with pytest.raises(RuntimeError, match="multi-process"):
+        ckpt.save(path, tree)
+    monkeypatch.setenv("HOROVOD_SIZE", "nonsense")
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    with pytest.raises(RuntimeError):  # unparseable: refuse, not race
+        ckpt.save(path, tree)
+
+    # Solo process (rank 0 of size 1, or no launcher env): still works.
+    monkeypatch.setenv("HOROVOD_SIZE", "1")
+    ckpt.save(path, tree)
+    monkeypatch.delenv("HOROVOD_SIZE")
+    monkeypatch.delenv("HOROVOD_RANK")
+    ckpt.save(path, tree)
+    np.testing.assert_allclose(
+        np.asarray(ckpt.restore(path)["x"]), 0.0)
+
+
+def test_restore_params_missing_and_custom_key(tmp_path):
+    from horovod_tpu import checkpoint as ckpt
+    path = str(tmp_path / "ck_weights")
+    ckpt.save(path, {"weights": {"w": jnp.ones((2,), jnp.float32)}})
+    with pytest.raises(KeyError, match="has no 'params' subtree"):
+        ckpt.restore_params(path)
+    got = ckpt.restore_params(path, key="weights")
+    np.testing.assert_allclose(np.asarray(got["w"]), 1.0)
+
+
 def test_elastic_state_disk_anchor(hvd, tmp_path):
     from horovod_tpu import checkpoint as ckpt
     root = str(tmp_path / "run")

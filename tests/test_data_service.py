@@ -136,6 +136,42 @@ def test_run_worker_entry(tmp_path):
         disp.stop()
 
 
+def test_producer_exits_promptly_on_abrupt_disconnect(service):
+    """Regression (ISSUE 9 satellite): a full prefetch queue with no
+    consumer — the abrupt-client-disconnect shape: the handler thread
+    dies with the connection and nobody drains the queue — must not
+    leak the producer thread past worker.stop(). The bounded put polls
+    the stop flag instead of blocking forever."""
+    disp, workers, client, _ = service
+
+    def big(shard, num_shards):
+        for i in range(shard, 1000, num_shards):
+            yield {"x": np.full((256,), i, np.int32)}
+
+    client.register_dataset("leak", big)
+    # Wait until both workers' producers are wedged on a full queue
+    # (prefetch=4 batches buffered, nobody consuming).
+    deadline = time.monotonic() + 10.0
+    streams = []
+    while time.monotonic() < deadline:
+        streams = [w._streams.get("leak") for w in workers]
+        if all(s is not None and s.q.full() for s in streams):
+            break
+        time.sleep(0.02)
+    assert all(s is not None and s.q.full() for s in streams), \
+        "producers never filled their prefetch queues"
+    threads = [s._thread for s in streams]
+    assert all(t.is_alive() for t in threads)  # blocked mid-production
+    t0 = time.monotonic()
+    for w in workers:
+        w.stop()
+    for t in threads:
+        t.join(timeout=3.0)
+    assert not any(t.is_alive() for t in threads), \
+        "producer thread leaked past stop() (blocked on a full queue)"
+    assert time.monotonic() - t0 < 5.0
+
+
 def test_secret_is_required(monkeypatch):
     """ADVICE r2: pickle over the wire must never be unauthenticated."""
     monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)

@@ -1,0 +1,802 @@
+"""Serving tier unit suite (horovod_tpu/serve/, docs/serving.md).
+
+Deterministic coverage of the pieces the 2-process e2e
+(test_serve_e2e.py, `make serve-smoke`) exercises under real faults:
+
+* the continuous batcher under a FAKE CLOCK — deadline flush, max-batch
+  flush, shape-bucket padding, requeue-on-replica-death ordering;
+* the AOT engine — one compile per bucket, padding-correct results,
+  hvdhlo lint stamp;
+* pre-registered horovod_serve_* metric series (idle service scrapes
+  zeros, not absent series);
+* the frontend/pool/replica stack over loopback, including a replica
+  death mid-stream with zero accepted requests dropped;
+* the doctor's serve section naming a dead replica from flight events.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serve.batching import ContinuousBatcher, parse_buckets
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    from horovod_tpu.observability import metrics
+    from horovod_tpu.serve import telemetry
+    metrics.reset_for_tests()
+    telemetry._mx_cache = None
+    yield
+    metrics.reset_for_tests()
+    telemetry._mx_cache = None
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _batcher(clock, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_s", 0.010)
+    kw.setdefault("depth", 64)
+    return ContinuousBatcher(clock=clock, **kw)
+
+
+def _item(v, shape=(3,), dtype=np.float32):
+    return np.full(shape, v, dtype)
+
+
+# ------------------------------------------------------------- buckets
+
+def test_parse_buckets_default_pow2():
+    assert parse_buckets(None, 8) == (1, 2, 4, 8)
+    assert parse_buckets("", 6) == (1, 2, 4, 6)
+    assert parse_buckets(None, 1) == (1,)
+
+
+def test_parse_buckets_explicit_and_validation():
+    # max_batch is ALWAYS in the set: a full batch must land on an
+    # exact bucket. "4,64" without the 8 would pad every full batch of
+    # 5-8 up to 64 mostly-zero rows.
+    assert parse_buckets("2,16", 8) == (2, 8, 16)
+    assert parse_buckets("4,64", 8) == (4, 8, 64)
+    assert parse_buckets("1,2", 8) == (1, 2, 8)
+    assert parse_buckets("8", 8) == (8,)
+    with pytest.raises(ValueError):
+        parse_buckets("0,4", 8)
+    with pytest.raises(ValueError):
+        parse_buckets("a,b", 8)
+
+
+def test_constructor_buckets_normalized_like_env_path():
+    """Explicit `buckets` get the same invariants as the env path:
+    positive, deduped, max_batch always present — programmatic callers
+    must not get the 4,64 padding pathology the env parse guards."""
+    b = ContinuousBatcher(max_batch=8, max_wait_s=0.01, depth=8,
+                          buckets=[4, 64])
+    assert b.buckets == (4, 8, 64)
+    assert b.max_batch == 8
+    with pytest.raises(ValueError):
+        ContinuousBatcher(max_batch=8, buckets=[0, 4])
+
+
+# ------------------------------------------------- batch formation
+
+def test_no_flush_before_deadline_or_full():
+    clock = FakeClock()
+    b = _batcher(clock)
+    b.offer(_item(1))
+    b.offer(_item(2))
+    assert b.poll() is None  # neither full nor due: continuous batching
+    clock.advance(0.005)
+    assert b.poll() is None
+
+
+def test_deadline_flush_partial_batch():
+    clock = FakeClock()
+    b = _batcher(clock)
+    b.offer(_item(1))
+    clock.advance(0.004)
+    b.offer(_item(2))
+    clock.advance(0.0061)  # oldest is now past max_wait; newest is not
+    batch = b.poll()
+    assert batch is not None
+    assert [float(r.payload[0]) for r in batch.requests] == [1.0, 2.0]
+    assert batch.bucket == 2  # padded to the 2-bucket, not max_batch
+    assert b.depth_now() == 0
+
+
+def test_max_batch_flush_immediate():
+    clock = FakeClock()
+    b = _batcher(clock)
+    for i in range(5):
+        b.offer(_item(i))
+    batch = b.poll()  # no time passed: flushed because it is FULL
+    assert batch is not None and len(batch.requests) == 4
+    assert [float(r.payload[0]) for r in batch.requests] == [0, 1, 2, 3]
+    assert b.depth_now() == 1  # the 5th joins the NEXT batch
+    clock.advance(0.011)
+    nxt = b.poll()
+    assert nxt is not None and len(nxt.requests) == 1
+    assert nxt.bucket == 1
+
+
+def test_bucket_padding_correctness():
+    clock = FakeClock()
+    b = _batcher(clock, max_batch=8)
+    for i in range(3):
+        b.offer(_item(i + 1))
+    clock.advance(0.011)
+    batch = b.poll()
+    assert batch.bucket == 4  # smallest bucket >= 3
+    arr = batch.stacked()
+    assert arr.shape == (4, 3) and arr.dtype == np.float32
+    np.testing.assert_array_equal(arr[0], np.full((3,), 1.0))
+    np.testing.assert_array_equal(arr[2], np.full((3,), 3.0))
+    np.testing.assert_array_equal(arr[3], np.zeros((3,)))  # padding rows
+
+
+def test_shape_groups_never_mix():
+    clock = FakeClock()
+    b = _batcher(clock)
+    b.offer(_item(1, shape=(3,)))
+    b.offer(_item(2, shape=(5,)))
+    b.offer(_item(3, shape=(3,)))
+    clock.advance(0.011)
+    first = b.poll()
+    # the OLDEST request picks the group; same-shape peers join it
+    assert [tuple(r.payload.shape) for r in first.requests] \
+        == [(3,), (3,)]
+    second = b.poll()  # the (5,) request, also past its deadline
+    assert [tuple(r.payload.shape) for r in second.requests] == [(5,)]
+
+
+def test_requeue_preserves_order_ahead_of_new_arrivals():
+    """The replica-death contract: in-flight requests go back at the
+    HEAD in arrival order, ahead of requests accepted later."""
+    clock = FakeClock()
+    b = _batcher(clock)
+    for i in range(4):
+        b.offer(_item(i))
+    batch = b.poll()
+    assert len(batch.requests) == 4
+    b.offer(_item(7))  # arrives while the batch is in flight
+    b.requeue(batch.requests)  # replica died
+    clock.advance(0.011)
+    redo = b.poll()
+    assert [float(r.payload[0]) for r in redo.requests] == [0, 1, 2, 3]
+    assert all(r.requeues == 1 for r in redo.requests)
+    clock.advance(0.011)
+    later = b.poll()
+    assert [float(r.payload[0]) for r in later.requests] == [7]
+
+
+def test_requeue_limit_fails_request_instead_of_cycling():
+    clock = FakeClock()
+    b = _batcher(clock, requeue_limit=2)
+    r = b.offer(_item(1))
+    b.poll(clock.t + 1)  # form + discard the batch (simulated dispatch)
+    b.requeue([r])
+    b.poll(clock.t + 2)
+    b.requeue([r])
+    b.poll(clock.t + 3)
+    b.requeue([r])  # third requeue: over the cap
+    assert r.event.is_set() and r.error is not None
+    assert b.depth_now() == 0
+
+
+def test_bounded_queue_rejects_when_full_but_requeue_is_exempt():
+    clock = FakeClock()
+    b = _batcher(clock, depth=2)
+    r1 = b.offer(_item(1))
+    r2 = b.offer(_item(2))
+    assert r1 is not None and r2 is not None
+    assert b.offer(_item(3)) is None  # bounded: reject, don't buffer
+    batch = b.poll(clock.t + 1)
+    b.requeue(batch.requests)  # accepted requests NEVER bounce
+    assert b.depth_now() == 2
+
+
+def test_requeue_returns_actual_count_not_batch_size():
+    """The death postmortem reports how many requests actually went
+    back in the queue: requests already decided (frontend timeout) are
+    dropped from the requeue, not double-dispatched."""
+    clock = FakeClock()
+    b = _batcher(clock)
+    rs = [b.offer(_item(i)) for i in range(3)]
+    batch = b.poll(clock.t + 1)
+    assert len(batch.requests) == 3
+    rs[0].fail("timed out in the frontend")  # decided while in flight
+    assert b.requeue(batch.requests) == 2
+    assert b.depth_now() == 2
+
+
+def test_purge_of_decided_requests_updates_depth_gauge():
+    """A poll() purge that empties the queue without forming a batch
+    must move the depth gauge too — mass frontend timeouts are exactly
+    when operators read it."""
+    from horovod_tpu.serve import telemetry
+    clock = FakeClock()
+    b = _batcher(clock)
+    rs = [b.offer(_item(i)) for i in range(3)]
+    assert telemetry.handles()["queue_depth"].value == 3
+    for r in rs:
+        r.fail("timed out in the frontend")
+    assert b.poll() is None          # everything purged, no batch
+    assert b.depth_now() == 0
+    assert telemetry.handles()["queue_depth"].value == 0
+
+
+def test_multi_group_flush_not_head_of_line_blocked():
+    """A full batch of one shape must flush even when the OLDEST
+    pending request is a not-yet-due request of another shape — every
+    shape group is evaluated per poll, not just the head's."""
+    clock = FakeClock()
+    b = _batcher(clock)
+    b.offer(_item(1, shape=(5,)))      # oldest: partial, not yet due
+    for i in range(4):
+        b.offer(_item(i, shape=(3,)))  # a FULL batch of another shape
+    batch = b.poll()                   # no time has passed
+    assert batch is not None
+    assert [tuple(r.payload.shape) for r in batch.requests] == [(3,)] * 4
+    assert b.depth_now() == 1          # the (5,) request still waits
+    assert b.poll() is None            # ... for its own deadline
+    clock.advance(0.011)
+    nxt = b.poll()
+    assert [tuple(r.payload.shape) for r in nxt.requests] == [(5,)]
+
+
+def test_quiesced_tracks_handed_out_batches():
+    """The drain-idle TOCTOU guard: a batch poll() handed out keeps the
+    batcher non-quiesced until task_done() acknowledges it — there is
+    no window where a batch is in a dispatch thread's hands but
+    invisible to the drain watcher."""
+    clock = FakeClock()
+    b = _batcher(clock)
+    assert b.quiesced()
+    b.offer(_item(1))
+    assert not b.quiesced()            # queued
+    batch = b.poll(clock.t + 1)
+    assert batch is not None and b.depth_now() == 0
+    assert not b.quiesced()            # handed out, unacknowledged
+    b.task_done()
+    assert b.quiesced()
+
+
+def test_request_outcome_decided_exactly_once_under_race():
+    """complete()/fail() are an atomic test-and-set: racing deciders
+    (frontend timeout vs dispatch delivery) produce exactly ONE winner,
+    so status counters can never double-book a request."""
+    clock = FakeClock()
+    b = _batcher(clock)
+    r = b.offer(_item(1))
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def decider(i):
+        barrier.wait()
+        if i % 2:
+            if r.complete(i):
+                wins.append(("ok", i))
+        else:
+            if r.fail(f"e{i}"):
+                wins.append(("err", i))
+
+    threads = [threading.Thread(target=decider, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(wins) == 1, wins
+    # outcomes exclusive; deciders joined, so the reads are quiescent
+    assert (r.result is None) != (r.error is None)  # hvdlint: disable=HVD101 -- all decider threads joined above
+
+
+def test_drain_flushes_immediately_and_closes_admission():
+    clock = FakeClock()
+    b = _batcher(clock)
+    b.offer(_item(1))
+    assert b.poll() is None
+    b.set_drain(True)
+    # admission closes atomically with the drain flag: a request that
+    # raced past the frontend's unlocked drain check still bounces
+    # here, so it can never be accepted after the replicas are released
+    assert b.offer(_item(2)) is None
+    batch = b.poll()
+    assert batch is not None and len(batch.requests) == 1
+
+
+def test_next_batch_blocking_wakes_on_offer():
+    b = ContinuousBatcher(max_batch=2, max_wait_s=5.0, depth=8)
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(b.next_batch(timeout=5.0)), daemon=True)
+    t.start()
+    time.sleep(0.05)
+    b.offer(_item(1))
+    b.offer(_item(2))  # full batch: must flush without the 5s deadline
+    t.join(timeout=3.0)
+    assert not t.is_alive()
+    assert out and out[0] is not None and len(out[0].requests) == 2
+
+
+# ------------------------------------------------------------ telemetry
+
+def test_serve_metrics_preregistered_scrape_zeros():
+    """ISSUE 9 satellite: an idle service must scrape ZEROS for every
+    horovod_serve_* series, not missing series."""
+    from horovod_tpu.observability import metrics as m
+    from horovod_tpu.serve.telemetry import preregister_metrics
+    preregister_metrics()
+    text = m.registry().render()
+    for name in ("horovod_serve_requests_total",
+                 "horovod_serve_request_seconds",
+                 "horovod_serve_queue_depth",
+                 "horovod_serve_batches_total",
+                 "horovod_serve_batch_seconds",
+                 "horovod_serve_batch_size",
+                 "horovod_serve_padded_items_total",
+                 "horovod_serve_inflight_batches",
+                 "horovod_serve_replicas",
+                 "horovod_serve_replica_deaths_total",
+                 "horovod_serve_requeued_requests_total",
+                 "horovod_serve_no_replica_total",
+                 "horovod_serve_replica_batches_total",
+                 "horovod_serve_replica_batch_seconds",
+                 "horovod_serve_compiles_total"):
+        assert name in text, f"{name} missing from idle scrape"
+    # every status label series exists up front
+    for status in ("accepted", "rejected", "completed", "failed"):
+        assert f'status="{status}"' in text, text
+
+
+# --------------------------------------------------------------- engine
+
+def _mlp_engine(features=3):
+    import jax.numpy as jnp
+
+    from horovod_tpu.serve.engine import InferenceEngine
+    params = {"w": jnp.arange(features, dtype=jnp.float32)}
+
+    def infer_fn(p, x):
+        return x @ p["w"]
+
+    return InferenceEngine(infer_fn, params)
+
+
+def test_engine_one_compile_per_bucket_and_padding_safe():
+    eng = _mlp_engine()
+    eng.warmup((3,), np.float32, (1, 2, 4))
+    assert eng.compiles == 3
+    batch = np.stack([np.full((3,), 2.0, np.float32),
+                      np.zeros((3,), np.float32)])  # 1 real + 1 pad row
+    out = eng.infer(batch)
+    assert eng.compiles == 3  # bucket shape (2,3) was pre-compiled
+    np.testing.assert_allclose(out[0], 2.0 * (0 + 1 + 2))
+    out4 = eng.infer(np.zeros((4, 3), np.float32))
+    assert out4.shape[0] == 4 and eng.compiles == 3
+
+
+def test_engine_hlo_lint_stamp():
+    eng = _mlp_engine()
+    eng.warmup((3,), np.float32, (1,))
+    stamp = eng.hlo_lint()
+    assert stamp["programs"] == 1
+    assert "count" in stamp and "clean" in stamp
+
+
+def test_engine_from_checkpoint_params_only(tmp_path, hvd):
+    """Serving restore: a TRAINING checkpoint (params + optimizer
+    state) loads weights-only; no optimizer object is built."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import checkpoint as ckpt
+    from horovod_tpu.serve.engine import InferenceEngine
+    params = {"w": jnp.full((3,), 2.0, jnp.float32)}
+    opt_state = {"momentum": {"w": jnp.ones((3,), jnp.float32)},
+                 "step": np.int64(123)}
+    path = str(tmp_path / "train_ck")
+    ckpt.save(path, {"params": params, "opt": opt_state})
+
+    eng = InferenceEngine.from_checkpoint(
+        path, lambda p, x: x @ p["w"],
+        like_params={"w": np.zeros((3,), np.float32)})
+    out = eng.infer(np.ones((1, 3), np.float32))
+    np.testing.assert_allclose(out[0], 6.0)
+
+
+# ------------------------------------------------ loopback stack + pool
+
+@pytest.fixture()
+def serving_stack(monkeypatch):
+    """RendezvousServer + N loopback replicas + pool + frontend."""
+    from horovod_tpu.runner import secret as secret_mod
+    from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+    from horovod_tpu.serve.frontend import Frontend, ServeClient
+    from horovod_tpu.serve.pool import ReplicaPool
+    from horovod_tpu.serve.replica import ReplicaServer
+
+    secret_hex = secret_mod.make_secret_key()
+    monkeypatch.setenv(secret_mod.SECRET_ENV, secret_hex)
+    secret = secret_hex.encode()
+    rdv = RendezvousServer(secret=secret)
+    port = rdv.start()
+    made = {"replicas": [], "clients": [], "stops": []}
+
+    def add_replica(rank=0):
+        monkeypatch.setenv("HOROVOD_RANK", str(rank))
+        monkeypatch.setenv("HOROVOD_LOCAL_RANK", str(rank))
+        monkeypatch.setenv("HOROVOD_HOSTNAME", f"host{rank}")
+        rep = ReplicaServer(_mlp_engine(),
+                            kv=KVClient("127.0.0.1", port, secret=secret))
+        rep.start()
+        made["replicas"].append(rep)
+        return rep
+
+    def build(batcher, n_replicas=1, replica_timeout=5.0):
+        for r in range(n_replicas):
+            add_replica(r)
+        pool = ReplicaPool(rdv, batcher, secret=secret,
+                           replica_timeout=replica_timeout,
+                           discovery_interval=0.05)
+        pool.start()
+        pool.wait_for_replicas(n_replicas, timeout=15)
+        fe = Frontend(batcher, secret=secret, port=0)
+        fp = fe.start()
+        made["stops"] += [fe.stop, pool.stop]
+        client = ServeClient(("127.0.0.1", fp), secret=secret)
+        made["clients"].append(client)
+        return pool, fe, client
+
+    yield build, add_replica, made
+    for c in made["clients"]:
+        c.close()
+    for s in made["stops"]:
+        s()
+    for rep in made["replicas"]:
+        rep.stop()
+    rdv.stop()
+
+
+def test_loopback_roundtrip_and_stats(serving_stack):
+    build, _, _ = serving_stack
+    b = ContinuousBatcher(max_batch=4, max_wait_s=0.005, depth=64)
+    pool, fe, client = build(b, n_replicas=1)
+    for i in range(6):
+        out = client.infer(np.full((3,), float(i), np.float32))
+        assert abs(float(out) - i * 3.0) < 1e-5
+    st = client.stats()
+    assert st["accepted"] == st["completed"] == 6
+    assert st["failed"] == st["rejected"] == 0
+
+
+def test_replica_death_requeues_onto_survivor(serving_stack):
+    """Kill one of two replicas mid-stream: every accepted request
+    still completes (zero dropped), the pool records the death, and the
+    doctor can name the dead replica from the flight events."""
+    from horovod_tpu.observability import doctor, flight
+    flight.reset_for_tests()
+    build, _, made = serving_stack
+    b = ContinuousBatcher(max_batch=2, max_wait_s=0.002, depth=256)
+    pool, fe, client = build(b, n_replicas=2, replica_timeout=3.0)
+
+    results = []
+    errors = []
+
+    def worker(tid):
+        from horovod_tpu.serve.frontend import ServeClient, \
+            ServeRequestError
+        c = ServeClient(client.addr)
+        try:
+            for i in range(20):
+                v = tid * 100 + i
+                try:
+                    out = c.infer(np.full((3,), float(v), np.float32))
+                    results.append((v, float(out)))
+                except ServeRequestError as e:
+                    errors.append((v, str(e)))
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    # Hard-kill one replica mid-load (server vanishes, conns reset).
+    time.sleep(0.1)
+    victim = made["replicas"][0]
+    victim._srv.shutdown()
+    victim._srv.server_close()
+    victim._srv = None
+    victim._stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads)
+    assert not errors, errors
+    assert len(results) == 80
+    for v, out in results:
+        assert abs(out - v * 3.0) < 1e-4, (v, out)
+
+    # The doctor names the dead replica from the launcher-side events.
+    dump = flight.get().payload("test")
+    rd = doctor.RankDump(dump, "<mem>", tail_only=False)
+    serve = doctor.analyze_serve([rd])
+    if pool.deaths:  # the killed replica had a batch in flight  # hvdlint: disable=HVD101 -- load stopped; int read is atomic under the GIL
+        assert serve is not None and serve["deaths"], dump["events"]
+        dead = serve["deaths"][0]
+        assert dead["pid"] == victim.ident["pid"]
+        text = doctor.render(doctor.merge([rd]))
+        assert "SERVE REPLICA DEATH" in text, text
+
+
+def test_frontend_rejects_new_requests_once_drain_requested(
+        serving_stack):
+    """Admission closes the moment a shutdown/drain is requested: a
+    request arriving after that is REJECTED (never accepted), so it
+    cannot become an accepted-but-starved request once the replicas
+    are released."""
+    build, _, _ = serving_stack
+    b = ContinuousBatcher(max_batch=4, max_wait_s=0.005, depth=64)
+    pool, fe, client = build(b, n_replicas=1)
+    out = client.infer(_item(2))
+    assert abs(float(out) - 6.0) < 1e-5
+    client.shutdown()
+    st = client.infer_raw(_item(3))
+    assert st == ("rejected", "service draining"), st
+    stats = client.stats()
+    assert stats["accepted"] == 1 and stats["rejected"] == 1
+
+
+def test_frontend_rejects_on_full_queue(serving_stack):
+    build, _, _ = serving_stack
+    # No replica ever dispatches (n_replicas=0): the queue fills up.
+    from horovod_tpu.serve.frontend import ServeRequestError
+    b = ContinuousBatcher(max_batch=4, max_wait_s=30.0, depth=2)
+    pool, fe, client = build(b, n_replicas=0)
+    fe.request_timeout = 0.5
+
+    def fire_and_forget():
+        from horovod_tpu.serve.frontend import ServeClient
+        c = ServeClient(client.addr)
+        try:
+            c.infer_raw(_item(1))
+        except Exception:
+            pass
+        finally:
+            c.close()
+
+    t1 = threading.Thread(target=fire_and_forget, daemon=True)
+    t2 = threading.Thread(target=fire_and_forget, daemon=True)
+    t1.start(); t2.start()
+    deadline = time.monotonic() + 5
+    while b.depth_now() < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert b.depth_now() == 2
+    st = client.infer_raw(_item(2))
+    assert st[0] == "rejected", st
+    with pytest.raises(ServeRequestError):
+        client.infer(_item(3))
+    t1.join(timeout=5); t2.join(timeout=5)
+    # the two timed-out requests must land in the latency histogram —
+    # the worst-tail samples are the ones a failover p99 is read for
+    from horovod_tpu.serve import telemetry
+    hist = telemetry.handles()["request_seconds"].labels()
+    assert hist.count >= 2
+
+
+# ------------------------------- pool liveness + die orders (fake KV)
+
+class FakeStore:
+    """scope_items/put subset of RendezvousServer the pool uses."""
+
+    def __init__(self):
+        self.data = {}
+
+    def scope_items(self, scope):
+        pfx = scope + "/"
+        return {k[len(pfx):]: v for k, v in self.data.items()
+                if k.startswith(pfx)}
+
+    def put(self, scope, key, val):
+        self.data[f"{scope}/{key}"] = val
+
+
+def _registration(hb, pid=4321):
+    return json.dumps({
+        "hostname": "hostX", "local_rank": 0, "rank": 0, "round": 0,
+        "pid": pid, "addr": "127.0.0.1", "port": 1, "hb": hb}).encode()
+
+
+def test_pool_skew_immune_freshness_stale_eviction_and_die_order(
+        monkeypatch):
+    """Heartbeat freshness never compares cross-host wall clocks: a
+    registration with an arbitrarily skewed `hb` stamp is adopted, stays
+    adopted while the value ADVANCES, and is evicted — with a pid-pinned
+    die order published — once it freezes for STALE_HEARTBEAT_S of
+    launcher-monotonic time."""
+    from horovod_tpu.serve import pool as pool_mod
+
+    monkeypatch.setattr(pool_mod, "STALE_HEARTBEAT_S", 0.3)
+    store = FakeStore()
+    # hb "hours in the past" of this host's clock: the old wall-clock
+    # cutoff would have skipped this live replica forever.
+    store.put("serve", "replica/hostX/0", _registration(hb=5.0))
+    p = pool_mod.ReplicaPool(store, ContinuousBatcher(max_batch=2),
+                             secret=b"s" * 32, discovery_interval=0.02)
+    p.start()
+    try:
+        p.wait_for_replicas(1, timeout=5)  # adopted despite the skew
+        # an advancing value stays fresh well past STALE_HEARTBEAT_S
+        deadline = time.monotonic() + 0.6
+        hb = 5.0
+        while time.monotonic() < deadline:
+            hb += 1.0
+            store.put("serve", "replica/hostX/0", _registration(hb=hb))
+            assert p.replica_count() == 1
+            time.sleep(0.02)
+        # frozen value: evicted after STALE_HEARTBEAT_S launcher-time
+        deadline = time.monotonic() + 5
+        while p.replica_count() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert p.replica_count() == 0 and p.deaths == 1  # hvdlint: disable=HVD101 -- eviction observed via replica_count; int read is atomic under the GIL
+        assert store.data.get("serve/die/hostX/0") == b"4321"
+        time.sleep(0.1)  # dead identity is never re-adopted
+        assert p.replica_count() == 0
+    finally:
+        p.stop()
+
+
+def test_pool_retires_replica_whose_registration_vanished(monkeypatch):
+    """A fast respawn inside the stale-heartbeat window overwrites the
+    slot's single KV key, so the corpse never shows up as stale — the
+    pool must retire an adopted replica whose registration vanished
+    from the scan instead of routing a batch onto it later."""
+    from horovod_tpu.serve import pool as pool_mod
+
+    store = FakeStore()
+    store.put("serve", "replica/hostX/0", _registration(hb=1.0,
+                                                        pid=111))
+    p = pool_mod.ReplicaPool(store, ContinuousBatcher(max_batch=2),
+                             secret=b"s" * 32, discovery_interval=0.02)
+    p.start()
+    try:
+        p.wait_for_replicas(1, timeout=5)
+        # the slot re-registers with a NEW pid (fast respawn): the old
+        # identity is gone from the scan and must be retired — and the
+        # new one adopted — well before STALE_HEARTBEAT_S could fire
+        store.put("serve", "replica/hostX/0", _registration(hb=2.0,
+                                                            pid=222))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with p._lock:
+                pids = sorted(r.pid for r in p._replicas.values())
+            if pids == [222]:
+                break
+            time.sleep(0.02)
+        assert pids == [222], pids
+        assert p.deaths == 1  # hvdlint: disable=HVD101 -- eviction observed via the locked scan above; int read is atomic under the GIL
+        assert store.data.get("serve/die/hostX/0") == b"111"
+    finally:
+        p.stop()
+
+
+def test_replica_wait_for_shutdown_honors_pid_pinned_die_order(
+        monkeypatch):
+    class FakeKV:
+        def __init__(self):
+            self.data = {}
+
+        def get(self, scope, key, timeout=0.0):
+            return self.data.get(f"{scope}/{key}")
+
+    monkeypatch.setenv("HOROVOD_HOSTNAME", "hostY")
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", "0")
+    from horovod_tpu.serve.replica import ReplicaServer
+    kv = FakeKV()
+    rep = ReplicaServer(_mlp_engine(), kv=kv, secret=b"s" * 32)
+    # someone else's die order (a previous pid on the slot): ignored
+    kv.data["serve/die/hostY/0"] = b"999999999"
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(rep.wait_for_shutdown(poll=0.01)),
+        daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive(), "stale (other-pid) die order killed the replica"
+    kv.data["serve/die/hostY/0"] = str(rep.ident["pid"]).encode()
+    t.join(timeout=5)
+    assert not t.is_alive() and out == [1]  # nonzero exit → respawn
+    # drain beats a die order: shutdown is checked first, returns 0
+    kv.data["serve/shutdown"] = b"1"
+    rep2 = ReplicaServer(_mlp_engine(), kv=kv, secret=b"s" * 32)
+    assert rep2.wait_for_shutdown(poll=0.01) == 0
+
+
+# ------------------------------------------------------- doctor (serve)
+
+def _serve_dump(events):
+    return {"version": 1, "rank": None, "size": None, "trigger": "test",
+            "hostname": "launcher", "pid": 1, "round": 0, "rounds": {},
+            "recorded": len(events), "dropped": 0, "collective_calls": 0,
+            "wall_time": 0.0,
+            "events": [[i, float(i), "serve", desc]
+                       for i, desc in enumerate(events)]}
+
+
+def test_doctor_serve_section_names_dead_replica():
+    from horovod_tpu.observability import doctor
+    body = _serve_dump([
+        "launcher: frontend UP port=1234",
+        "pool: replica rank=0 host=a pid=11 addr=1.2.3.4:5 ADOPTED round=1",
+        "pool: replica rank=1 host=b pid=22 addr=1.2.3.5:5 ADOPTED round=1",
+        "replica rank=0 host=a pid=11 addr=1.2.3.4:5 DEAD batches=7 "
+        "requeued=3 error=ConnectionResetError: peer reset",
+        # a replica's own terminal event when it exits rc 1 on a
+        # pid-pinned die order — must not render as UP
+        "replica rank=2 host=c pid=33 EVICTED (exiting for respawn) "
+        "batches=4",
+    ])
+    rd = doctor.RankDump(body, "<mem>", tail_only=False)
+    serve = doctor.analyze_serve([rd])
+    assert serve is not None
+    assert len(serve["replicas"]) == 3
+    assert len(serve["deaths"]) == 1
+    evicted = [r for r in serve["replicas"] if r["rank"] == 2]
+    assert evicted and evicted[0]["state"] == "evicted"
+    dead = serve["deaths"][0]
+    assert (dead["rank"], dead["host"], dead["pid"]) == (0, "a", 11)
+    assert dead["requeued"] == 3 and dead["batches"] == 7
+    report = doctor.merge([rd])
+    text = doctor.render(report)
+    assert "SERVE REPLICA DEATH: rank 0 (host a, pid 11)" in text, text
+    assert "3 in-flight request(s) requeued" in text, text
+    # machine-readable too (--json path)
+    assert json.loads(json.dumps(report))["serve"]["deaths"]
+
+
+def test_doctor_folds_late_requeue_into_death_total():
+    """A stale-heartbeat eviction racing a failed submit emits DEAD
+    with requeued=0 plus a supplemental 'late requeue' event — the
+    doctor folds the late count into the death headline, deduping the
+    same launcher event appearing in both a full dump and a KV tail."""
+    from horovod_tpu.observability import doctor
+    events = [
+        "pool: replica rank=0 host=a pid=11 addr=1.2.3.4:5 ADOPTED "
+        "round=0",
+        "replica rank=0 host=a pid=11 addr=1.2.3.4:5 DEAD batches=2 "
+        "requeued=0 error=StaleHeartbeat: no advance in 5s",
+        "pool: late requeue after eviction of replica rank=0 host=a "
+        "pid=11 addr=1.2.3.4:5 requeued=4",
+    ]
+    rd = doctor.RankDump(_serve_dump(events), "<mem>", tail_only=False)
+    serve = doctor.analyze_serve([rd])
+    assert serve["deaths"][0]["requeued"] == 4
+    assert serve["replicas"][0]["requeued"] == 4
+    # the identical event in a second dump is NOT double-counted
+    rd2 = doctor.RankDump(_serve_dump(events), "<mem2>",
+                          tail_only=False)
+    serve2 = doctor.analyze_serve([rd, rd2])
+    assert serve2["deaths"][0]["requeued"] == 4
+    text = doctor.render(doctor.merge([rd]))
+    assert "4 in-flight request(s) requeued" in text, text
+
+
+def test_doctor_serve_section_absent_without_serve_events():
+    from horovod_tpu.observability import doctor
+    body = _serve_dump([])
+    body["events"] = [[0, 0.0, "kv", "PUT /x/y (3B)"]]
+    rd = doctor.RankDump(body, "<mem>", tail_only=False)
+    assert doctor.analyze_serve([rd]) is None
+    assert "[serve]" not in doctor.render(doctor.merge([rd]))

@@ -1,0 +1,240 @@
+"""Serving-tier end-to-end chaos test (`make serve-smoke`; ISSUE 9
+acceptance).
+
+A REAL elastic serving job: `python -m horovod_tpu.serve` spawns two
+replica processes (tests/serve_replica.py) that restore params-only
+from a training checkpoint; the test drives open-loop load through the
+authenticated frontend while SIGKILLing one replica mid-flight, and
+asserts the acceptance bar:
+
+* ZERO dropped accepted requests — every accepted request completes
+  with the right answer;
+* bounded tail latency through the failover: p99 over the whole run
+  (kill included) stays under 10x the steady-state p50 measured before
+  the kill;
+* `hvddoctor` names the killed replica (serve section, from the flight
+  events + persisted KV tails);
+* the job drains cleanly and exits 0 after the client's shutdown.
+
+Marked `faults`: minutes of runtime, excluded from tier 1.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(__file__)
+REPLICA = os.path.join(HERE, "serve_replica.py")
+
+FEATURES = 4
+SECRET = "ab" * 32  # fixed job secret so the test client can sign
+
+
+def _write_hosts(path, spec: str) -> None:
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(spec.split(",")) + "\n")
+    os.replace(tmp, path)
+
+
+def _save_checkpoint(tmp_path) -> str:
+    """A training-shaped checkpoint (params + optimizer state) written
+    WITHOUT an initialized topology — the tooling path serving uses."""
+    from horovod_tpu import checkpoint as ckpt
+    import jax.numpy as jnp
+    path = str(tmp_path / "train_ck")
+    params = {"w": jnp.arange(1, FEATURES + 1, dtype=jnp.float32),
+              "b": jnp.float32(0.5)}
+    opt = {"mu": {"w": jnp.ones((FEATURES,), jnp.float32)},
+           "count": np.int64(77)}
+    ckpt.save(path, {"params": params, "opt": opt})
+    return path
+
+
+def _expected(v: float) -> float:
+    # x = full(v); w = 1..F; b = 0.5
+    return v * sum(range(1, FEATURES + 1)) + 0.5
+
+
+def _start_service(tmp_path, ckpt_path):
+    hosts_file = tmp_path / "hosts.txt"
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(0o755)
+    port_file = tmp_path / "serve.port"
+    flight_dir = tmp_path / "flight"
+    pid_dir = tmp_path / "pids"
+    env = dict(os.environ)
+    env.update({
+        "XLA_FLAGS": "",
+        "HOROVOD_TPU_EMULATE_RANKS": "",
+        "HOROVOD_SECRET_KEY": SECRET,
+        "HOROVOD_SERVE_PORT_FILE": str(port_file),
+        "HOROVOD_FLIGHT_DIR": str(flight_dir),
+        "SERVE_TEST_CHECKPOINT": ckpt_path,
+        "SERVE_TEST_PID_DIR": str(pid_dir),
+        "SERVE_TEST_FEATURES": str(FEATURES),
+        # fast failover detection + short batch deadlines: the p99
+        # bound is measured against these, not against defaults
+        "HOROVOD_SERVE_MAX_BATCH": "4",
+        "HOROVOD_SERVE_MAX_WAIT_MS": "20",
+        "HOROVOD_SERVE_REPLICA_TIMEOUT": "5",
+        "HOROVOD_METRICS_PUSH_INTERVAL": "0.2",
+    })
+    cmd = [sys.executable, "-m", "horovod_tpu.serve",
+           "--host-discovery-script", str(script),
+           "--slots-per-host", "1",
+           "--min-np", "1",
+           "--elastic-timeout", "120",
+           "--blacklist-cooldown-range", "300", "600",
+           "--", sys.executable, REPLICA]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    return proc, hosts_file, port_file, flight_dir, pid_dir
+
+
+def _finish(proc, timeout=180.0) -> str:
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        pytest.fail(f"serving job hung; output:\n{out}")
+    assert proc.returncode == 0, \
+        f"job failed rc={proc.returncode}:\n{out}"
+    return out
+
+
+@pytest.mark.faults
+def test_serving_survives_replica_sigkill_under_load(tmp_path):
+    from horovod_tpu.observability import doctor
+    from horovod_tpu.serve.frontend import (ServeClient,
+                                            wait_for_port_file)
+
+    ckpt_path = _save_checkpoint(tmp_path)
+    proc, hosts_file, port_file, flight_dir, pid_dir = \
+        _start_service(tmp_path, ckpt_path)
+    _write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    try:
+        port = wait_for_port_file(str(port_file), timeout=90)
+        addr = ("127.0.0.1", port)
+        probe = ServeClient(addr, secret=SECRET.encode())
+        # Wait until both replicas serve (pid files + a live answer).
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                if len(os.listdir(pid_dir)) >= 2:
+                    out = probe.infer(
+                        np.full((FEATURES,), 1.0, np.float32))
+                    assert abs(float(out) - _expected(1.0)) < 1e-4
+                    break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            pytest.fail("replicas never came up; output:\n"
+                        + (proc.stdout.read() if proc.stdout else ""))
+
+        lock = threading.Lock()
+        latencies = []   # (t_done, seconds)  guarded-by: lock
+        results = []     # (value, answer)    guarded-by: lock
+        failures = []    # guarded-by: lock
+        stop_load = threading.Event()
+
+        def load_worker(tid):
+            c = ServeClient(addr, secret=SECRET.encode())
+            i = 0
+            try:
+                while not stop_load.is_set():
+                    v = float(tid * 10000 + i)
+                    t0 = time.perf_counter()
+                    try:
+                        out = c.infer(
+                            np.full((FEATURES,), v, np.float32))
+                    except Exception as e:
+                        with lock:
+                            failures.append((v, repr(e)))
+                        return
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        latencies.append((time.monotonic(), dt))
+                        results.append((v, float(np.ravel(out)[0])))
+                    i += 1
+                    time.sleep(0.01)  # open-loop-ish per-thread pacing
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=load_worker, args=(t,),
+                                    daemon=True) for t in range(4)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+
+        # Steady state first, then SIGKILL the 127.0.0.1 replica.
+        time.sleep(2.0)
+        t_kill = time.monotonic()
+        with open(os.path.join(pid_dir, "127.0.0.1")) as f:
+            victim_pid = int(f.read().strip())
+        os.kill(victim_pid, signal.SIGKILL)
+        # Pin the host set to the survivor so cooldown re-admission
+        # noise can't interfere (same shape as the elastic e2e).
+        _write_hosts(hosts_file, "localhost:1")
+        time.sleep(3.0)  # keep the load on through the failover
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+
+        with lock:
+            lat = list(latencies)
+            res = list(results)
+            fails = list(failures)
+
+        # --- acceptance: zero dropped accepted requests, right answers
+        assert not fails, fails
+        assert len(res) > 100, f"too little load ran: {len(res)}"
+        for v, out in res:
+            assert abs(out - _expected(v)) < max(1e-3, 1e-6 * abs(out)), \
+                (v, out)
+
+        # --- acceptance: bounded p99 through the failover
+        steady = sorted(dt for ts, dt in lat if ts < t_kill)
+        assert steady, "no steady-state samples before the kill"
+        p50_steady = steady[len(steady) // 2]
+        all_lat = sorted(dt for _, dt in lat)
+        p99 = all_lat[min(len(all_lat) - 1, int(len(all_lat) * 0.99))]
+        assert p99 < 10 * max(p50_steady, 0.05), \
+            (f"p99 {p99 * 1e3:.1f}ms vs steady p50 "
+             f"{p50_steady * 1e3:.1f}ms")
+
+        # --- drain and exit 0
+        probe.shutdown()
+        probe.close()
+        out = _finish(proc)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+    assert "SERVE_REPLICA_UP" in out
+    assert "died" in out and "requeued" in out, out
+
+    # --- acceptance: the doctor names the killed replica
+    dumps = doctor.dedupe(doctor.load_dir(str(flight_dir)))
+    assert dumps, sorted(os.listdir(flight_dir))
+    report = doctor.merge(dumps)
+    serve = report["serve"]
+    assert serve is not None, report
+    assert serve["deaths"], serve
+    dead = serve["deaths"][0]
+    assert dead["pid"] == victim_pid
+    assert dead["host"] == "127.0.0.1"
+    text = doctor.render(report)
+    assert "SERVE REPLICA DEATH" in text, text
+    assert "127.0.0.1" in text and str(victim_pid) in text, text
