@@ -16,6 +16,15 @@
 #                    SIGKILL) asserting the doctor names the stalled
 #                    rank and the last-agreed collective
 #                    (docs/observability.md, docs/troubleshooting.md)
+#   make watch-smoke hvdwatch online anomaly detection + hvdtop
+#                    (docs/observability.md): the fake-clock detector
+#                    unit suite plus the 2-process elastic e2e — a
+#                    mid-run one-rank slowdown injected via
+#                    testing/faults.py must be detected within the
+#                    step budget, with a flight dump, an on-demand
+#                    device trace and a `watch` KV record left behind,
+#                    hvddoctor naming the rank+detector, hvdtop showing
+#                    the live anomaly, and a clean run reporting zero
 #   make serve-smoke serving tier (docs/serving.md): the deterministic
 #                    unit suite plus the 2-process elastic serving e2e
 #                    — SIGKILL one replica under continuous load; zero
@@ -48,9 +57,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline metrics race doctor-smoke serve-smoke fusion-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline metrics race doctor-smoke serve-smoke watch-smoke fusion-smoke perf-gate
 
-test: lint hlo-lint test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke fusion-smoke perf-gate entry
+test: lint hlo-lint test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke fusion-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -83,6 +92,13 @@ doctor-smoke:
 	$(PYTEST) tests/test_flight.py tests/test_perfscope.py
 	$(PYTEST) tests/test_flight_e2e.py tests/test_perfscope_e2e.py \
 	    --run-faults -m faults
+
+# hvdwatch + hvdtop (docs/observability.md): the fake-clock detector
+# unit suite runs in tier 1 too; the 2-process slowdown-injection e2e
+# (faults marker) only here.
+watch-smoke:
+	$(PYTEST) tests/test_watch.py
+	$(PYTEST) tests/test_watch_e2e.py --run-faults -m faults
 
 # Serving tier (docs/serving.md): the fake-clock batcher/engine/pool
 # unit suite runs in tier 1 too; the 2-process elastic serving e2e
@@ -144,6 +160,7 @@ race:
 	env HOROVOD_RACE_CHECK=1 $(PYTEST) tests/test_race.py \
 	    tests/test_timeline.py tests/test_metrics.py \
 	    tests/test_flight.py tests/test_perfscope.py \
+	    tests/test_watch.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
 	    tests/test_hvdlint.py tests/test_serve.py \
 	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache

@@ -219,7 +219,34 @@ def _perf_stamp(r, name, flops_info, prof, fallback_flops_per_step,
         r["step_time_percentiles_ms"] = {
             k: round(wall[f"{k}_s"] * 1e3, 2)
             for k in ("mean", "p50", "p95", "max")}
+    r["hvdwatch"] = _watch_stamp()
     return r
+
+
+_watch_last_counts = {}
+
+
+def _watch_stamp():
+    """Per-section hvdwatch block (observability/watch.py): run one
+    detection pass over the samples the section just produced, then
+    stamp how many anomalies this section added. Clean runs stamp zero
+    everywhere — scripts/perf_gate.py asserts exactly that, so a bench
+    whose own workloads trip a detector fails CI instead of silently
+    publishing a number measured during an anomaly."""
+    global _watch_last_counts
+    counts = {}
+    try:
+        from horovod_tpu.observability import watch
+        watch.get().tick()
+        counts = watch.get().counts()
+    except Exception:
+        pass
+    prev, _watch_last_counts = _watch_last_counts, dict(counts)
+    new = {k: v - prev.get(k, 0) for k, v in counts.items()
+           if v - prev.get(k, 0) > 0}
+    return {"anomalies_total": sum(new.values()),
+            "by_detector": new,
+            "cumulative_total": sum(counts.values())}
 
 
 # --------------------------------------------------------------------------

@@ -544,7 +544,9 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
                             default_slots=args.slots_per_host or 1),
         cooldown_range=tuple(cooldown) if cooldown else None)
     from horovod_tpu.runner import secret as secret_mod
-    job_secret = secret_mod.make_secret_key()
+    # A pre-set HOROVOD_SECRET_KEY is honored (job_secret_key) so
+    # `hvdtop` / `hvddoctor --kv` can sign reads against the live job.
+    job_secret = secret_mod.job_secret_key()
     rdv = RendezvousServer(secret=job_secret.encode())
     rdv_port = rdv.start()
     ip = _local_ip()
@@ -595,10 +597,11 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
         # operator at the doctor when the job failed. The perfscope
         # step-time summaries ride the same exit path (doctor's perf
         # section, profiler/perfscope.py).
-        from horovod_tpu.observability import flight
+        from horovod_tpu.observability import flight, watch
         from horovod_tpu.profiler import perfscope
         tails = flight.persist_kv_tails(rdv)
         perfscope.persist_kv_summaries(rdv)
+        watch.persist_kv_records(rdv)
         flight_dir = os.environ.get(flight.FLIGHT_DIR_ENV, "")
         if rc != 0 and flight_dir and (
                 tails or os.path.isdir(flight_dir)):

@@ -10,6 +10,12 @@ runtime events per rank, dumped on stall/divergence/fatal-error/
 SIGUSR1/exit. `doctor` — `python -m horovod_tpu.observability.doctor`
 merges the per-rank dumps into one cross-rank postmortem
 (docs/observability.md, docs/troubleshooting.md).
+`watch` — hvdwatch, the always-on online anomaly detector riding the
+exporter cadence: rolling median+MAD detectors over step time, MFU,
+overlap, input wait, elastic churn, and serve SLO burn rate, escalating
+to flight dumps + on-demand device traces on trigger. `top` — hvdtop,
+`python -m horovod_tpu.observability.top`, the live per-rank fleet
+view over the `/metrics` route and the perf/flight/watch KV scopes.
 """
 
 from horovod_tpu.observability.metrics import (  # noqa: F401

@@ -41,6 +41,14 @@ every rank's wall time matches; only the split names the culprit), each
 straggler tagged with its dominant phase (`input_wait`, `dispatch`,
 `optimizer`, ...).
 
+When hvdwatch anomaly records are present
+(`watch-rank-<r>.r<round>.json` files, or the live `watch/` KV scope —
+observability/watch.py), the report gains an **[anomalies] section**:
+every online detection with its detector, z-score and trigger step,
+correlated against the report's own straggler/divergence evidence — an
+anomalous rank that is also a perf or collective straggler in the same
+round is marked *corroborated*.
+
 See docs/troubleshooting.md for a worked read-through of a report.
 """
 
@@ -285,6 +293,106 @@ def load_perf_kv(addr: str, port: int, max_ranks: int = 256,
                     max_ranks=max_ranks, max_rounds=max_rounds)
 
 
+def _parse_watch(raw: bytes, source: str) -> Optional[Dict[str, Any]]:
+    try:
+        body = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not (isinstance(body, dict) and body.get("watch")
+            and isinstance(body.get("anomalies"), list)):
+        return None
+    from horovod_tpu.observability.watch import WATCH_VERSION
+    try:
+        version = int(body["watch"])
+    except (TypeError, ValueError):
+        version = WATCH_VERSION + 1
+    if version > WATCH_VERSION:
+        print(f"doctor: {source}: watch record version "
+              f"{body.get('watch')} is newer than this tool "
+              f"understands; skipping", file=sys.stderr)
+        return None
+    # Sanitize at the boundary (the parse_snapshot contract: one
+    # truncated or hand-edited record must never cost the whole
+    # report): ranks must be integers, anomaly entries must be dicts
+    # with the numeric fields render() formats.
+    try:
+        body["rank"] = int(body["rank"])
+    except (KeyError, TypeError, ValueError):
+        body["rank"] = None
+    try:
+        body["round"] = int(body.get("round", 0) or 0)
+    except (TypeError, ValueError):
+        body["round"] = 0
+    clean = []
+    for a in body["anomalies"]:
+        if not isinstance(a, dict):
+            continue
+        try:
+            a["value"] = float(a.get("value", 0.0))
+            a["median"] = float(a.get("median", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if a.get("z") is not None:
+            try:
+                a["z"] = float(a["z"])
+            except (TypeError, ValueError):
+                a["z"] = None
+        clean.append(a)
+    body["anomalies"] = clean
+    if not isinstance(body.get("counts"), dict):
+        body["counts"] = {}
+    body["counts"] = {str(k): v for k, v in body["counts"].items()
+                      if isinstance(v, (int, float))}
+    return body
+
+
+def load_watch_dir(d: str) -> List[Dict[str, Any]]:
+    """Parse the hvdwatch anomaly records the launcher persisted
+    (`watch-rank-<r>.r<round>.json`, observability/watch.py)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("watch-") or not name.endswith(".json") \
+                or ".tmp" in name:
+            continue
+        try:
+            with open(os.path.join(d, name), "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        rec = _parse_watch(raw, name)
+        if rec is not None:
+            out.append(rec)
+    return out
+
+
+def load_watch_kv(addr: str, port: int, max_ranks: int = 256,
+                  max_rounds: int = 64) -> List[Dict[str, Any]]:
+    """Scrape `watch/rank-<r>.r<round>` anomaly records from a live
+    rendezvous server."""
+    from horovod_tpu.observability.watch import SCOPE as WATCH_SCOPE
+    return _scan_kv(addr, port, WATCH_SCOPE, _parse_watch,
+                    max_ranks=max_ranks, max_rounds=max_rounds)
+
+
+def dedupe_watch(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One record per (rank, round) — keep the one carrying the most
+    anomalies (records are cumulative, so more = later)."""
+    best: Dict[Tuple, Dict[str, Any]] = {}
+    for r in records:
+        if r.get("rank") is None:
+            continue
+        key = (int(r["rank"]), int(r.get("round", 0) or 0))
+        cur = best.get(key)
+        if cur is None or (sum((r.get("counts") or {}).values())
+                           > sum((cur.get("counts") or {}).values())):
+            best[key] = r
+    return [best[k] for k in sorted(best)]
+
+
 def dedupe_perf(summaries: List[Dict[str, Any]]
                 ) -> List[Dict[str, Any]]:
     """One summary per (rank, round) — keep the one covering the most
@@ -374,6 +482,64 @@ def analyze_perf(summaries: List[Dict[str, Any]]
             "stragglers": rnd_stragglers,
         }
     return {"rounds": out_rounds, "stragglers": stragglers}
+
+
+def analyze_anomalies(records: List[Dict[str, Any]],
+                      perf: Optional[Dict[str, Any]] = None,
+                      groups: Optional[Dict[str, Dict[str, Any]]] = None
+                      ) -> Optional[Dict[str, Any]]:
+    """The hvdwatch [anomalies] section: every anomaly record the
+    watchers pushed, correlated with the doctor's own straggler and
+    divergence evidence — an anomalous rank that is ALSO a perf or
+    collective straggler in the same round is corroborated, which is
+    what separates "the detector fired" from "the detector fired on
+    the rank the rest of the report blames"."""
+    records = dedupe_watch(records)
+    if not records:
+        return None
+    perf_stragglers: Dict[Tuple[int, int], str] = {}
+    for s in (perf or {}).get("stragglers", []):
+        perf_stragglers[(int(s["rank"]), int(s.get("round", 0)))] = \
+            str(s.get("dominant_phase"))
+    coll_stragglers: set = set()
+    for g in (groups or {}).values():
+        for r in g.get("stragglers", []):
+            coll_stragglers.add((int(r), int(g.get("round", 0))))
+    anomalies: List[Dict[str, Any]] = []
+    per_rank: Dict[str, Any] = {}
+    detectors: Dict[str, int] = {}
+    for rec in records:
+        rank = int(rec["rank"])
+        rnd = int(rec.get("round", 0) or 0)
+        key = f"{rank}@r{rnd}"
+        per_rank[key] = {
+            "rank": rank, "round": rnd,
+            "counts": rec.get("counts") or {},
+            "active": rec.get("active") or [],
+        }
+        for name, n in (rec.get("counts") or {}).items():
+            detectors[name] = detectors.get(name, 0) + int(n)
+        for a in rec.get("anomalies") or []:
+            entry = dict(a)
+            entry.setdefault("rank", rank)
+            entry.setdefault("round", rnd)
+            corroboration = []
+            if (rank, rnd) in perf_stragglers:
+                corroboration.append(
+                    "perf straggler "
+                    f"({perf_stragglers[(rank, rnd)]})")
+            if (rank, rnd) in coll_stragglers:
+                corroboration.append("collective straggler")
+            entry["corroborated_by"] = corroboration
+            anomalies.append(entry)
+    anomalies.sort(key=lambda a: (a.get("wall_time") or 0,
+                                  a.get("rank") or 0))
+    return {
+        "total": sum(detectors.values()),
+        "detectors": detectors,
+        "ranks": per_rank,
+        "anomalies": anomalies,
+    }
 
 
 #: serve-event identity: "replica rank=<r> host=<h> pid=<p> ..." (both
@@ -580,7 +746,8 @@ def analyze_group(round_id: int, gid: int, dumps: List[RankDump]
 
 
 def merge(dumps: List[RankDump], tail: int = 8,
-          perf: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+          perf: Optional[List[Dict[str, Any]]] = None,
+          watch: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
     size = max((d.size for d in dumps if d.size), default=None)
     seen_ranks: set = set()
     for d in dumps:
@@ -611,6 +778,8 @@ def merge(dumps: List[RankDump], tail: int = 8,
         "serve": analyze_serve(dumps),
         "per_rank": {},
     }
+    report["anomalies"] = analyze_anomalies(
+        watch or [], perf=report["perf"], groups=groups)
     for d in dumps:
         info: Dict[str, Any] = {
             "rank": d.rank,
@@ -703,6 +872,30 @@ def render(report: Dict[str, Any], tail: int = 8) -> str:
         if g["divergence"] is None and not g["stragglers"] \
                 and not g["missing"]:
             add("  all ranks in step at the end of the recorded window")
+        add("")
+    anomalies = report.get("anomalies")
+    if anomalies:
+        add("[anomalies] hvdwatch online detections "
+            "(observability/watch.py; docs/observability.md)")
+        det = ", ".join(f"{k}: {v}" for k, v in
+                        sorted(anomalies["detectors"].items()))
+        add(f"  {anomalies['total']} anomaly(ies) total ({det})")
+        for a in anomalies["anomalies"]:
+            rnd = "" if not a.get("round") else f" round {a['round']}"
+            z = f" z={a['z']:.1f}" if a.get("z") is not None else ""
+            line = (f"  ANOMALY rank {a.get('rank')}{rnd}: "
+                    f"detector {a.get('detector')} value "
+                    f"{a.get('value'):.6g} (baseline "
+                    f"{a.get('median'):.6g}){z} at step {a.get('step')}")
+            if a.get("corroborated_by"):
+                line += " — corroborated by " \
+                    + " + ".join(a["corroborated_by"])
+            add(line)
+        for key, info in sorted(anomalies["ranks"].items()):
+            if info["active"]:
+                add(f"  rank {info['rank']} round {info['round']}: "
+                    f"still ACTIVE at last push: "
+                    f"{', '.join(info['active'])}")
         add("")
     serve = report.get("serve")
     if serve:
@@ -844,9 +1037,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     loaded: List[RankDump] = []
     perf: List[Dict[str, Any]] = []
+    watch: List[Dict[str, Any]] = []
     if args.dir:
         loaded.extend(load_dir(args.dir))
         perf.extend(load_perf_dir(args.dir))
+        watch.extend(load_watch_dir(args.dir))
     if args.kv:
         addr, _, port = args.kv.rpartition(":")
         if not addr or not port.isdigit():
@@ -856,16 +1051,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         loaded.extend(load_kv(addr, int(port), max_ranks=args.max_ranks))
         perf.extend(load_perf_kv(addr, int(port),
                                  max_ranks=args.max_ranks))
+        watch.extend(load_watch_kv(addr, int(port),
+                                   max_ranks=args.max_ranks))
     if not args.dir and not args.kv:
         build_parser().print_help(sys.stderr)
         return 2
     dumps = dedupe(loaded)
-    if not dumps and not perf:
+    if not dumps and not perf and not watch:
         print("doctor: no flight dumps found (is HOROVOD_FLIGHT_DIR set "
               "on the job, or the rendezvous server still up?)",
               file=sys.stderr)
         return 2
-    report = merge(dumps, tail=args.tail, perf=perf)
+    report = merge(dumps, tail=args.tail, perf=perf, watch=watch)
     if args.trace:
         export_trace(dumps, args.trace)
         print(f"doctor: wrote merged trace to {args.trace}",

@@ -169,6 +169,16 @@ class MetricsExporter:
                 perfscope.push_summary()
             except Exception:
                 pass
+            # hvdwatch detection pass (observability/watch.py): the
+            # anomaly detectors consume the perfscope samples and
+            # registry series accumulated since the last tick, escalate
+            # capture on trigger, and refresh this rank's `watch/` KV
+            # record. Best-effort like every other sink.
+            try:
+                from horovod_tpu.observability import watch
+                watch.on_export_tick()
+            except Exception:
+                pass
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> None:

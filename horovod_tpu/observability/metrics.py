@@ -43,6 +43,13 @@ from horovod_tpu.common.config import _env_bool, _env_int
 
 HOROVOD_METRICS = "HOROVOD_METRICS"
 HOROVOD_METRICS_LABEL_MAX = "HOROVOD_METRICS_LABEL_MAX"
+HOROVOD_METRICS_STALE_SECONDS = "HOROVOD_METRICS_STALE_SECONDS"
+
+#: Default staleness cutoff for pushed rank snapshots in the job-wide
+#: `/metrics` merge, as a multiple of the exporter push interval: a rank
+#: that missed ~3 pushes is gone (evicted, crashed, SIGKILL'd), and its
+#: frozen series must age out of the scrape rather than render forever.
+STALE_PUSH_INTERVALS = 3.0
 
 # Fixed log-scale bucket ladders (powers of two). Fixed — not
 # configurable per call site — so per-rank histograms merge bucket-by-
@@ -267,6 +274,15 @@ class MetricsRegistry:
                   buckets: Sequence[float] = TIME_BUCKETS):
         return self._family(name, "histogram", help_, labelnames, buckets)
 
+    def peek(self, name: str) -> Optional[_Family]:
+        """An existing family, or None — WITHOUT creating it. Readers
+        that merely observe (the hvdwatch detectors sampling serve
+        series) must not materialize families a process never emits."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            return self._families.get(name)
+
     # ------------------------------------------------------------- export
     def snapshot(self, rank: Optional[int] = None) -> dict:
         """Plain-JSON state of every family — the KV-push / dump payload."""
@@ -345,6 +361,41 @@ def render_snapshots(snapshots: Sequence[dict]) -> str:
         out.append(f"# TYPE {name} {fam['kind']}")
         out.extend(rows[name])
     return "\n".join(out) + ("\n" if out else "")
+
+
+def stale_cutoff_seconds() -> float:
+    """Age (seconds) beyond which a pushed rank snapshot is dropped from
+    the `/metrics` merge. `HOROVOD_METRICS_STALE_SECONDS` overrides; 0
+    disables aging. Default: 3 exporter push intervals — dead/evicted
+    ranks otherwise persist in the job-wide scrape forever."""
+    from horovod_tpu.common.config import (
+        HOROVOD_METRICS_PUSH_INTERVAL, _env_float)
+    explicit = _env_float(HOROVOD_METRICS_STALE_SECONDS, -1.0)
+    if explicit >= 0.0:
+        return explicit
+    return STALE_PUSH_INTERVALS * max(
+        _env_float(HOROVOD_METRICS_PUSH_INTERVAL, 5.0), 0.1)
+
+
+def fresh_snapshots(snapshots: Sequence[dict],
+                    stale_seconds: Optional[float] = None,
+                    now: Optional[float] = None) -> List[dict]:
+    """Drop pushed snapshots whose `time` stamp is older than
+    `stale_seconds` (wall clock; `now` injectable for tests). Snapshots
+    without a stamp are kept — aging must fail open, never hide live
+    data. `stale_seconds <= 0` disables aging."""
+    if stale_seconds is None:
+        stale_seconds = stale_cutoff_seconds()
+    if stale_seconds <= 0.0:
+        return list(snapshots)
+    now = time.time() if now is None else now
+    out: List[dict] = []
+    for snap in snapshots:
+        t = snap.get("time")
+        if isinstance(t, (int, float)) and now - t > stale_seconds:
+            continue
+        out.append(snap)
+    return out
 
 
 def parse_snapshot(data: bytes) -> Optional[dict]:

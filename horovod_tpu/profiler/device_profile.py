@@ -22,8 +22,11 @@ from __future__ import annotations
 
 import dataclasses
 import glob
+import os
 import re
 import tempfile
+import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 _DEFAULT_BUCKETS: List[Tuple[str, str]] = [
@@ -147,6 +150,106 @@ def load_xspace(trace_dir: str):
     with open(paths[-1], "rb") as fh:
         xs.ParseFromString(fh.read())
     return xs
+
+
+# ---------------------------------------------------------------- capture
+#
+# On-demand capture hook (hvdwatch escalation, observability/watch.py):
+# one process-wide lock serializes every jax.profiler trace started
+# through here — jax raises on a second start_trace while one is live,
+# and an anomaly-triggered capture must not collide with an operator's
+# SIGUSR1-era poke or a second detector firing in the same window.
+# Try-acquire semantics: a trigger that loses the race is SKIPPED (and
+# reported False), never queued — a queued capture would record the
+# post-anomaly steady state, which is not the evidence anyone wanted.
+
+_capture_lock = threading.Lock()
+_capture_skipped = 0  # diagnostics only; races are benign
+# Interpreter-exit drain: a capture still running when the job finishes
+# would be killed with its daemon thread BEFORE stop_trace flushes the
+# artifact — losing exactly the evidence the escalation asked for. The
+# exit hook tells the runner to cut its window short and waits (bounded)
+# for the stop/flush to complete.
+_exit_drain = threading.Event()
+_active_runner: Optional[threading.Thread] = None
+_drain_installed = False
+
+
+def capture_active() -> bool:
+    """True while an on-demand device trace is running."""
+    return _capture_lock.locked()
+
+
+def _drain_capture_at_exit() -> None:
+    t = _active_runner
+    if t is not None and t.is_alive():
+        _exit_drain.set()
+        # Bounded: profiler start/stop can take tens of seconds on slow
+        # hosts; an unflushable trace must still not hang the exit.
+        t.join(timeout=60.0)
+
+
+def start_on_demand_capture(out_dir: str,
+                            steps: int = 8,
+                            step_count_fn: Optional[Callable[[], int]] = None,
+                            timeout_s: float = 30.0,
+                            poll_s: float = 0.05) -> bool:
+    """Start a `jax.profiler` device trace that stops itself after
+    `step_count_fn` advances by `steps` (or after `timeout_s`, whichever
+    first — a stalled job must not trace forever). Returns True when the
+    capture was scheduled; False when another capture holds the lock.
+
+    The ENTIRE capture — including `start_trace`, whose first call can
+    block for many seconds while the platform profiler initializes —
+    runs on a daemon thread: the caller (the hvdwatch escalation on the
+    metrics-exporter thread) must never stall on it, or the telemetry
+    plane freezes for exactly the window it is trying to record.
+    """
+    global _capture_skipped
+    if not _capture_lock.acquire(blocking=False):
+        _capture_skipped += 1
+        return False
+
+    def _runner() -> None:
+        try:
+            try:
+                import jax
+                os.makedirs(out_dir, exist_ok=True)
+                jax.profiler.start_trace(out_dir)
+            except Exception:
+                return  # no jax / trace already active out-of-band
+            # Once the trace is live it MUST be stopped no matter what
+            # the (caller-supplied) step counter does — a leaked trace
+            # buffers for the job's lifetime and makes every later
+            # start_trace fail, silently killing all future captures.
+            try:
+                start = step_count_fn() if step_count_fn is not None \
+                    else 0
+                deadline = time.monotonic() + max(timeout_s, poll_s)
+                while time.monotonic() < deadline \
+                        and not _exit_drain.is_set():
+                    if step_count_fn is not None \
+                            and step_count_fn() - start >= steps:
+                        break
+                    time.sleep(poll_s)
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+        finally:
+            _capture_lock.release()
+
+    global _active_runner, _drain_installed
+    if not _drain_installed:
+        _drain_installed = True
+        import atexit
+        atexit.register(_drain_capture_at_exit)
+    t = threading.Thread(target=_runner, name="hvd-devprof-capture",
+                         daemon=True)
+    _active_runner = t  # single writer: the capture lock is held
+    t.start()
+    return True
 
 
 def profile_step(run_once: Callable[[], object], reps: int = 3,

@@ -77,7 +77,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from horovod_tpu.common.config import _env_on
 
@@ -376,6 +376,26 @@ class PerfScope:
             self._model_flops = None
             self._flops_source = "none"
 
+    def step_count(self) -> int:
+        """Total steps recorded (cheap — one locked int read)."""
+        with self._lock:
+            return self._steps
+
+    def recent_samples(self, since_step: int = 0
+                       ) -> "Tuple[int, List[Tuple[float, Dict[str, float]]]]":
+        """Per-step samples recorded after step count `since_step`
+        (bounded by the rolling window), plus the current total step
+        count. The hvdwatch detectors (observability/watch.py) feed on
+        this each exporter tick: callers track the returned total and
+        pass it back so every step is consumed exactly once."""
+        with self._lock:
+            total = self._steps
+            n = min(max(total - since_step, 0), len(self._recent))
+            samples = [
+                (w, dict(p)) for w, p in
+                list(self._recent)[len(self._recent) - n:]] if n else []
+        return total, samples
+
     def summary(self) -> Dict[str, Any]:
         """Rolling summary over the recent window: wall percentiles,
         mean per-phase seconds/fractions, coverage, dominant phases,
@@ -547,6 +567,12 @@ class _NoopScope:
 
     def summary(self) -> Dict[str, Any]:
         return {}
+
+    def step_count(self) -> int:
+        return 0
+
+    def recent_samples(self, since_step: int = 0):
+        return 0, []
 
     def step_profile(self, name: str, **extra: Any) -> Dict[str, Any]:
         return {"name": name, "perfscope": SUMMARY_VERSION, **extra}

@@ -233,7 +233,16 @@ def recover_trace(path: str) -> list:
                         text[:cut + 1].rstrip().rstrip(",") + _FOOTER)
                 except ValueError:
                     end = cut
-    return data["traceEvents"] if isinstance(data, dict) else data
+    events = data.get("traceEvents") if isinstance(data, dict) else data
+    # A file that merely *parses* is not a trace: `null`, a number, or a
+    # dict without traceEvents used to sail through here (and out of the
+    # `recover` CLI with exit 0), silently producing a non-trace. An
+    # unrecoverable input must raise so callers can fail loudly.
+    if not isinstance(events, list):
+        raise ValueError(
+            f"not a Chrome trace: parsed to {type(events).__name__}, "
+            f"expected a traceEvents list")
+    return events
 
 
 def _main(argv=None) -> int:

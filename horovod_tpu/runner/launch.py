@@ -538,9 +538,11 @@ def launch_static(np: int, host_spec: str, command: List[str],
 
     # Per-job HMAC secret: control-plane writes are authenticated
     # (reference: runner/common/util/secret.py; previously the KV accepted
-    # writes from anyone on the network).
+    # writes from anyone on the network). A pre-set HOROVOD_SECRET_KEY is
+    # honored (job_secret_key) so out-of-band tooling — `hvdtop`,
+    # `hvddoctor --kv` — can sign its reads against a live job.
     from horovod_tpu.runner import secret as secret_mod
-    job_secret = secret_mod.make_secret_key()
+    job_secret = secret_mod.job_secret_key()
     rdv = RendezvousServer(secret=job_secret.encode())
     rdv_port = rdv.start()
     ip = coordinator_ip or _local_ip()
@@ -616,10 +618,11 @@ def launch_static(np: int, host_spec: str, command: List[str],
         # launcher's memory (observability/flight.py). The perfscope
         # step-time summaries ride the same exit path so the doctor's
         # perf section works offline (profiler/perfscope.py).
-        from horovod_tpu.observability import flight
+        from horovod_tpu.observability import flight, watch
         from horovod_tpu.profiler import perfscope
         flight.persist_kv_tails(rdv)
         perfscope.persist_kv_summaries(rdv)
+        watch.persist_kv_records(rdv)
         rdv.stop()
         if nkv is not None:
             nkv.stop()

@@ -81,6 +81,11 @@ def announce_port(port: int) -> None:
 
 class _KVHandler(BaseHTTPRequestHandler):
     store: Dict[str, bytes] = {}  # guarded-by: lock
+    # Server-clock arrival time per metrics/ key: staleness aging in
+    # /metrics compares against THIS stamp, not the snapshot's own
+    # worker-clock `time`, so cross-host clock skew cannot silently
+    # drop a live rank from the scrape.
+    put_times: Dict[str, float] = {}  # guarded-by: lock
     lock = threading.Lock()
     secret: Optional[bytes] = None
 
@@ -107,8 +112,11 @@ class _KVHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(n)
         if not self._authorized(body):
             return self._reject()
+        key = self._key()
         with self.lock:
-            self.store[self._key()] = body
+            self.store[key] = body
+            if key.startswith(METRICS_SCOPE + "/"):
+                self.put_times[key] = time.time()
         self.send_response(200)
         self.end_headers()
         self._observe("PUT", t0)
@@ -138,6 +146,7 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._reject()
         with self.lock:
             self.store.pop(self._key(), None)
+            self.put_times.pop(self._key(), None)
         self.send_response(200)
         self.end_headers()
         self._observe("DELETE", t0)
@@ -160,12 +169,24 @@ class _KVHandler(BaseHTTPRequestHandler):
         reg = m.registry()
         snaps = [reg.snapshot()] if reg.enabled else []
         with self.lock:
-            pushed = [v for k, v in sorted(self.store.items())
+            pushed = [(v, self.put_times.get(k))
+                      for k, v in sorted(self.store.items())
                       if k.startswith(METRICS_SCOPE + "/")]
-        for raw in pushed:
+        worker_snaps = []
+        for raw, arrived in pushed:
             snap = m.parse_snapshot(raw)
             if snap is not None:
-                snaps.append(snap)
+                # Age against the SERVER-clock arrival stamp when one
+                # exists (HTTP pushes): worker clock skew must not hide
+                # a live rank. Server-side put() (no stamp) keeps the
+                # snapshot's own time.
+                if arrived is not None:
+                    snap["time"] = arrived
+                worker_snaps.append(snap)
+        # Age out ranks that stopped refreshing their snapshot (evicted
+        # or SIGKILL'd workers otherwise render frozen series forever):
+        # keep only snapshots pushed within HOROVOD_METRICS_STALE_SECONDS.
+        snaps.extend(m.fresh_snapshots(worker_snaps))
         body = m.render_snapshots(snaps).encode("utf-8")
         self.send_response(200)
         self.send_header("Content-Type",
@@ -180,8 +201,8 @@ class RendezvousServer:
 
     def __init__(self, port: int = 0, secret: Optional[bytes] = None):
         handler = type("Handler", (_KVHandler,),
-                       {"store": {}, "lock": threading.Lock(),
-                        "secret": secret})
+                       {"store": {}, "put_times": {},
+                        "lock": threading.Lock(), "secret": secret})
         self._handler = handler
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
         self.port = self._httpd.server_address[1]

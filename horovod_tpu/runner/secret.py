@@ -26,6 +26,15 @@ def make_secret_key() -> str:
     return _secrets.token_hex(32)
 
 
+def job_secret_key() -> str:
+    """The job secret a launcher should use: a pre-set
+    HOROVOD_SECRET_KEY is honored — so out-of-band tooling (`hvdtop`,
+    `hvddoctor --kv`, external ServeClients) can sign reads against the
+    live job — else a fresh per-job key. One helper so the convention
+    lives in one place across every launcher."""
+    return os.environ.get(SECRET_ENV, "") or make_secret_key()
+
+
 def secret_from_env() -> Optional[bytes]:
     val = os.environ.get(SECRET_ENV, "")
     return val.encode() if val else None

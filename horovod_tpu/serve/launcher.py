@@ -86,12 +86,9 @@ def run_serve(args, command: List[str],
         HostDiscoveryScript(args.host_discovery_script,
                             default_slots=args.slots_per_host or 1),
         cooldown_range=tuple(cooldown) if cooldown else None)
-    # Honor a pre-set job secret so external clients can authenticate
-    # against the frontend (the training launcher always generates one —
-    # nothing outside the job needs to talk to it; the serving frontend
-    # is FOR things outside the job).
-    job_secret = os.environ.get(secret_mod.SECRET_ENV) \
-        or secret_mod.make_secret_key()
+    # Honor a pre-set job secret (job_secret_key) so external clients
+    # can authenticate against the frontend.
+    job_secret = secret_mod.job_secret_key()
     rdv = RendezvousServer(secret=job_secret.encode())
     rdv_port = rdv.start()
     ip = _local_ip()

@@ -79,6 +79,11 @@ def handles():
             "compiles": reg.counter(
                 "horovod_serve_compiles_total",
                 "AOT bucket-shape compilations (warmup + on-demand)"),
+            "slo_burn": reg.gauge(
+                "horovod_serve_slo_burn_rate",
+                "SLO error-budget burn rate over the last watch tick "
+                "(1.0 = exactly on budget; hvdwatch alerts at "
+                "HOROVOD_WATCH_BURN_RATE — observability/watch.py)"),
         }
         _mx_cache = (reg, mx)
     return _mx_cache[1]

@@ -79,6 +79,17 @@ def emit_profiles() -> dict:
     hvd.init()
     sections = {}
 
+    def watch_stamp():
+        """Run one hvdwatch detection pass over the section's samples
+        and stamp its cumulative anomaly counts — the gate's zero-
+        anomalies-on-clean-runs assertion needs the detectors to have
+        actually LOOKED at this run."""
+        from horovod_tpu.observability import watch
+        watch.get().tick()
+        counts = watch.get().counts()
+        return {"anomalies_total": sum(counts.values()),
+                "by_detector": dict(counts)}
+
     # --- eager MLP through DistributedOptimizer (the auto-hooked path)
     rng = np.random.default_rng(0)
     D, B = 64, 32
@@ -109,7 +120,8 @@ def emit_profiles() -> dict:
             w, state = opt.step(g, w, state)
             with ps.phase("device_compute"):
                 jax.block_until_ready(l)
-    sections["eager_mlp"] = ps.step_profile("eager_mlp")
+    sections["eager_mlp"] = ps.step_profile("eager_mlp",
+                                            hvdwatch=watch_stamp())
 
     # --- jitted matmul scan with XLA-derived FLOPs
     m = jnp.asarray(rng.standard_normal((128, 128)) * 0.05, jnp.float32)
@@ -123,7 +135,8 @@ def emit_profiles() -> dict:
             s = body(s)
             with ps.phase("device_compute"):
                 jax.block_until_ready(s)
-    sections["scan_matmul"] = ps.step_profile("scan_matmul")
+    sections["scan_matmul"] = ps.step_profile("scan_matmul",
+                                              hvdwatch=watch_stamp())
 
     return {"perf_gate": 1,
             "platform": jax.devices()[0].platform,
@@ -161,6 +174,7 @@ def _check_profile(name: str, prof: dict, spec: dict,
     if allowed and prof.get("mfu_source") not in allowed:
         errs.append(f"{name}: mfu_source {prof.get('mfu_source')!r} "
                     f"not in {allowed}")
+    errs.extend(_check_watch(name, prof.get("hvdwatch")))
     base_mean = spec.get("wall_mean_s")
     if numeric and base_mean:
         tol = float(spec.get("tolerance", DEFAULT_TOLERANCE))
@@ -171,6 +185,25 @@ def _check_profile(name: str, prof: dict, spec: dict,
                 f"[{lo * 1e3:.2f}, {hi * 1e3:.2f}] ms "
                 f"(baseline {base_mean * 1e3:.2f} ms, tol {tol})")
     return errs
+
+
+def _check_watch(name: str, block) -> list:
+    """A clean run must record ZERO hvdwatch anomalies: a bench number
+    measured while a detector was firing (input starvation, overlap
+    collapse, a step-time shift) is not a baseline, it is an incident.
+    Structural — runs wherever the gate runs, no numerics involved."""
+    if block is None:
+        return []  # section ran without the watch stamp (older doc)
+    if not isinstance(block, dict):
+        return [f"{name}: hvdwatch block is not a dict"]
+    n = block.get("anomalies_total")
+    if n is None:
+        return [f"{name}: hvdwatch block missing anomalies_total"]
+    if n:
+        return [f"{name}: {n} hvdwatch anomaly(ies) during the run "
+                f"({block.get('by_detector')}) — a clean run must "
+                f"record zero"]
+    return []
 
 
 def compare(current: dict, baseline: dict, numeric: bool) -> list:
@@ -199,6 +232,7 @@ def check_bench(doc: dict) -> list:
         errs.extend(_check_profile(
             sec, prof,
             {"mfu_source": ["xla", "fallback", "none"]}, numeric=False))
+        errs.extend(_check_watch(sec, val.get("hvdwatch")))
     if not found:
         errs.append("bench JSON carries no perfscope StepProfile "
                     "(HOROVOD_PERFSCOPE=0 on the bench run?)")

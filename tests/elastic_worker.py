@@ -36,6 +36,17 @@ Modes (argv[1]):
             in the allreduce), so only the perfscope phase split — pushed
             to the rendezvous KV and persisted at job end — lets
             hvddoctor name the straggler and its dominant phase.
+  watch   — the hvdwatch e2e (tests/test_watch_e2e.py): every step runs
+            under hvd.perfscope() with model FLOPs declared (so MFU
+            flows); the worker on ELASTIC_SLOWDOWN_HOSTNAME installs a
+            testing/faults.py latency injector at boot
+            (site=worker.step, ms=ELASTIC_SLOWDOWN_MS,
+            after=ELASTIC_SLOWDOWN_AFTER) — a mid-run per-step slowdown
+            on one rank, injected through the same fault plumbing the
+            chaos suite uses. Nobody crashes: the per-rank watcher must
+            detect the local step-time shift, force a flight dump,
+            start an on-demand device trace, and push the `watch` KV
+            record the launcher persists at job end.
 
 Each step passes the `worker.step` fault-injection site
 (horovod_tpu/testing/faults.py), so the chaos suite can add latency or
@@ -68,6 +79,13 @@ STALL_STEP = int(os.environ.get("ELASTIC_STALL_STEP", "5"))
 STALL_EXIT_AFTER = float(os.environ.get("ELASTIC_STALL_EXIT_AFTER", "8"))
 SLOW_INPUT_HOSTNAME = os.environ.get("ELASTIC_SLOW_INPUT_HOSTNAME", "")
 SLOW_INPUT_SEC = float(os.environ.get("ELASTIC_SLOW_INPUT_SEC", "0.35"))
+SLOWDOWN_HOSTNAME = os.environ.get("ELASTIC_SLOWDOWN_HOSTNAME", "")
+SLOWDOWN_MS = os.environ.get("ELASTIC_SLOWDOWN_MS", "500")
+SLOWDOWN_AFTER = os.environ.get("ELASTIC_SLOWDOWN_AFTER", "10")
+# Declared per-step model FLOPs in watch mode: arbitrary but fixed, so
+# the MFU gauge/summary flow on CPU hosts (pair with
+# HOROVOD_BENCH_PEAK_TFLOPS in the job env).
+WATCH_MODEL_FLOPS = 1e9
 
 
 def main():
@@ -83,6 +101,19 @@ def main():
     import horovod_tpu as hvd
 
     hvd.init()
+    if mode == "watch":
+        from horovod_tpu.testing import faults
+        hvd.perfscope().set_model_flops(WATCH_MODEL_FLOPS,
+                                        source="fallback")
+        if my_host == SLOWDOWN_HOSTNAME:
+            # The injected mid-run slowdown rides the same fault
+            # plumbing as the chaos suite — installed in-process so
+            # only THIS host's worker slows down.
+            spec = (f"site=worker.step,kind=latency,"
+                    f"ms={SLOWDOWN_MS},after={SLOWDOWN_AFTER}")
+            faults.install(faults.FaultInjector(faults.parse_spec(spec)))
+            print(f"SLOWDOWN_ARMED host={my_host} "
+                  f"after={SLOWDOWN_AFTER} ms={SLOWDOWN_MS}", flush=True)
     state = hvd.elastic.JaxState(
         params={"w": jnp.zeros((4,), jnp.float32)}, step=0)
     # A worker that joins after round 1 was born resized — it must not
@@ -115,8 +146,20 @@ def main():
             # rank adds exactly 1.0 to w per step regardless of world size,
             # so w == step at all times if and only if state survived.
             from horovod_tpu.testing import faults
-            faults.inject("worker.step")
-            if mode == "slow_input":
+            if mode != "watch":
+                faults.inject("worker.step")
+            if mode == "watch":
+                scope = hvd.perfscope()
+                with scope.step():
+                    with scope.phase("input_wait"):
+                        time.sleep(0.01)
+                    # The injected latency lands in `dispatch` — LOCAL
+                    # time — exactly the signal the step_time detector
+                    # watches; the fast peer parks its wait in comms.
+                    faults.inject("worker.step")
+                    g = hvd.allreduce(np.ones((4,), np.float32),
+                                      op="sum", name="elastic_step_grad")
+            elif mode == "slow_input":
                 scope = hvd.perfscope()
                 with scope.step():
                     with scope.phase("input_wait"):

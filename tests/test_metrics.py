@@ -309,6 +309,68 @@ def test_rendezvous_metrics_route():
         m.reset_for_tests()
 
 
+def test_fresh_snapshots_fake_clock():
+    """Aging is a pure function of the snapshot `time` stamps and an
+    injectable now — dead ranks age out, live ranks and stamp-less
+    snapshots (fail open) stay."""
+    snaps = [{"rank": 0, "time": 100.0},
+             {"rank": 1, "time": 50.0},     # stale
+             {"rank": 2}]                   # no stamp: kept
+    kept = m.fresh_snapshots(snaps, stale_seconds=30.0, now=110.0)
+    assert [s.get("rank") for s in kept] == [0, 2]
+    # 0 disables aging entirely
+    assert len(m.fresh_snapshots(snaps, stale_seconds=0.0,
+                                 now=110.0)) == 3
+
+
+def test_stale_cutoff_defaults_to_push_interval_multiple(monkeypatch):
+    monkeypatch.delenv("HOROVOD_METRICS_STALE_SECONDS", raising=False)
+    monkeypatch.setenv("HOROVOD_METRICS_PUSH_INTERVAL", "2.0")
+    assert m.stale_cutoff_seconds() == pytest.approx(6.0)
+    monkeypatch.setenv("HOROVOD_METRICS_STALE_SECONDS", "42")
+    assert m.stale_cutoff_seconds() == 42.0
+    monkeypatch.setenv("HOROVOD_METRICS_STALE_SECONDS", "0")
+    assert m.stale_cutoff_seconds() == 0.0
+
+
+def test_metrics_route_ages_out_dead_rank_snapshots(monkeypatch):
+    """The ISSUE 11 regression: a rank evicted (or SIGKILL'd) mid-job
+    kept rendering its last snapshot in the job-wide merge forever.
+    Snapshots whose SERVER-side arrival stamp is older than
+    HOROVOD_METRICS_STALE_SECONDS must drop out of the scrape; fresh
+    ones stay — and a skewed WORKER clock in the snapshot body must not
+    matter (the server stamps arrival itself)."""
+    import time as _time
+    from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+    m.reset_for_tests()
+    monkeypatch.setenv("HOROVOD_METRICS_STALE_SECONDS", "30")
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        kv = KVClient("127.0.0.1", port)
+        worker = m.MetricsRegistry(enabled=True)
+        worker.counter("horovod_x_total").inc(5)
+        fresh = worker.snapshot(rank=0)
+        # A live rank whose host clock is badly skewed: its own stamp
+        # claims 1000s ago, but the push just ARRIVED — it must render.
+        fresh["time"] = _time.time() - 1000.0
+        dead = worker.snapshot(rank=1)
+        kv.put("metrics", "rank-0", json.dumps(fresh).encode())
+        kv.put("metrics", "rank-1", json.dumps(dead).encode())
+        # Fake clock on the server stamp: rank 1's last arrival was
+        # long ago (the rank died and stopped refreshing).
+        with srv._handler.lock:
+            srv._handler.put_times["metrics/rank-1"] = \
+                _time.time() - 1000.0
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert 'horovod_x_total{rank="0"} 5' in text
+        assert 'rank="1"' not in text
+    finally:
+        srv.stop()
+        m.reset_for_tests()
+
+
 def test_metrics_route_survives_garbage_snapshot():
     from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
     m.reset_for_tests()

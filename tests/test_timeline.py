@@ -9,6 +9,7 @@ import threading
 import time
 
 import numpy as np
+import pytest
 
 
 def _load_events(path):
@@ -142,6 +143,38 @@ def test_incremental_flush_survives_kill(tmp_path):
         time.sleep(0.05)
     assert len(events) == 5, "events not on disk before stop()"
     tl.stop()  # cleanliness; the assertion above ran pre-finalize
+
+
+@pytest.mark.parametrize("content", [
+    "",                      # empty file
+    "garbage not json",      # unparseable
+    "null",                  # parses, but is no trace
+    "123",                   # ditto
+    '{"foo": 1}',            # dict without traceEvents
+    '{"traceEvents": 7}',    # traceEvents is not a list
+])
+def test_recover_cli_exits_nonzero_on_unrecoverable_trace(
+        tmp_path, capsys, content):
+    """ISSUE 11 satellite: `timeline recover` used to exit 0 (or crash
+    with a bare traceback) on inputs that parse but are not traces —
+    an unrecoverable file must exit nonzero with a diagnostic."""
+    from horovod_tpu.profiler.timeline import _main
+    path = tmp_path / "bad.json"
+    path.write_text(content)
+    assert _main(["recover", str(path)]) == 1
+    err = capsys.readouterr().err
+    assert "cannot repair" in err and str(path) in err
+
+
+def test_recover_trace_rejects_non_trace_json(tmp_path):
+    from horovod_tpu.profiler.timeline import recover_trace
+    path = tmp_path / "null.json"
+    path.write_text("null")
+    with pytest.raises(ValueError):
+        recover_trace(str(path))
+    # a bare event ARRAY is a valid Chrome trace and still loads
+    path.write_text('[{"ph": "i", "ts": 1}]')
+    assert recover_trace(str(path)) == [{"ph": "i", "ts": 1}]
 
 
 def test_counter_events_python_writer(tmp_path):

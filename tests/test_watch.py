@@ -1,0 +1,688 @@
+"""hvdwatch unit suite (observability/watch.py, observability/top.py).
+
+Everything here is fake-clock and in-process — no sleeps, no network
+(a local RendezvousServer on loopback for the hvdtop snapshot test is
+the only socket). The detector state machines are exercised exactly as
+ISSUE 11 specifies: warmup silence, single-step spike vs sustained
+shift, hysteresis/cooldown (no flap on a recompile or an elastic
+round), and the serve burn-rate math.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from horovod_tpu.observability import metrics as m
+from horovod_tpu.observability import watch
+from horovod_tpu.observability.watch import (
+    ChurnDetector, Detector, DetectorConfig, ThresholdDetector, Watcher,
+    burn_rate, over_slo_count,
+)
+from horovod_tpu.profiler import perfscope as P
+
+
+def mk_detector(**kw):
+    base = dict(warmup=5, z=6.0, hysteresis=3, cooldown_s=60.0,
+                window=32, direction=1, min_delta=0.05)
+    base.update(kw)
+    return Detector(DetectorConfig("t", **base))
+
+
+# ------------------------------------------------------------ Detector
+
+def test_warmup_is_silent_even_on_wild_values():
+    d = mk_detector(warmup=8)
+    for i in range(8):
+        assert d.observe(100.0 * (i + 1), float(i)) is None
+        assert d.state == "warmup" or i == 7
+
+
+def test_single_step_spike_does_not_trigger():
+    """A recompile is one (or two) slow steps, then normal — hysteresis
+    must swallow it."""
+    d = mk_detector()
+    now = 0.0
+    for _ in range(6):
+        assert d.observe(0.1, now) is None
+        now += 1
+    assert d.observe(5.0, now) is None       # the spike
+    assert d.observe(5.0, now + 1) is None   # even two in a row
+    assert d.observe(0.1, now + 2) is None   # back to normal
+    assert d.bad_streak == 0 and not d.active
+    # ...and the spike never contaminated the baseline
+    assert d.observe(0.1, now + 3) is None
+    assert abs(d.last_median - 0.1) < 1e-9
+
+
+def test_sustained_shift_triggers_after_hysteresis():
+    d = mk_detector(hysteresis=3)
+    now = 0.0
+    for _ in range(6):
+        d.observe(0.1, now)
+        now += 1
+    assert d.observe(0.5, now) is None
+    assert d.observe(0.5, now + 1) is None
+    a = d.observe(0.5, now + 2)
+    assert a is not None and a["detector"] == "t"
+    assert a["value"] == 0.5 and abs(a["median"] - 0.1) < 1e-9
+    assert d.state == "active"
+    # while active: no re-trigger spam
+    assert d.observe(0.5, now + 3) is None
+
+
+def test_active_clears_after_consecutive_normal_samples():
+    d = mk_detector(hysteresis=2)
+    now = 0.0
+    for _ in range(6):
+        d.observe(0.1, now)
+        now += 1
+    d.observe(0.5, now)
+    assert d.observe(0.5, now + 1) is not None
+    assert d.active
+    d.observe(0.1, now + 2)
+    assert d.active  # one normal sample is not enough
+    d.observe(0.1, now + 3)
+    assert not d.active
+
+
+def test_cooldown_suppresses_immediate_retrigger():
+    d = mk_detector(hysteresis=2, cooldown_s=100.0)
+    now = 0.0
+    for _ in range(6):
+        d.observe(0.1, now)
+        now += 1
+    d.observe(0.5, now)
+    assert d.observe(0.5, now + 1) is not None
+    # clear...
+    for i in range(3):
+        d.observe(0.1, now + 2 + i)
+    assert not d.active
+    # ...shift again INSIDE the cooldown: no second alert
+    d.observe(0.5, now + 6)
+    d.observe(0.5, now + 7)
+    assert d.observe(0.5, now + 8) is None
+    # past the cooldown the same shape alerts again
+    t2 = now + 200.0
+    for i in range(3):
+        d.observe(0.1, t2 + i)
+    d.observe(0.5, t2 + 4)
+    assert d.observe(0.5, t2 + 5) is not None
+    assert d.triggers == 2
+
+
+def test_low_direction_detects_drop_not_rise():
+    d = mk_detector(direction=-1, min_delta=0.05)
+    now = 0.0
+    for _ in range(6):
+        d.observe(0.7, now)
+        now += 1
+    # rising is fine for a low-is-bad detector (MFU going UP)
+    for i in range(4):
+        assert d.observe(0.9, now + i) is None
+    # a sustained drop trips it
+    d.observe(0.2, now + 10)
+    d.observe(0.2, now + 11)
+    assert d.observe(0.2, now + 12) is not None
+
+
+def test_min_delta_floor_blocks_microscopic_shifts():
+    """A perfectly quiet baseline makes any wiggle a huge z-score; the
+    absolute floor keeps microsecond noise from alerting."""
+    d = mk_detector(min_delta=0.5)
+    now = 0.0
+    for _ in range(6):
+        d.observe(0.100, now)
+        now += 1
+    for i in range(6):  # z is enormous, delta is 0.3 < 0.5
+        assert d.observe(0.400, now + i) is None
+
+
+def test_reset_returns_to_warmup():
+    """An elastic round reassigns ranks and changes the perf regime —
+    the watcher resets every detector, which must not alert until a
+    fresh baseline exists (no flap on elastic rounds)."""
+    d = mk_detector(warmup=4, hysteresis=2)
+    now = 0.0
+    for _ in range(6):
+        d.observe(0.1, now)
+        now += 1
+    d.reset()
+    assert d.state == "warmup"
+    # the new regime is 5x slower — silently becomes the new baseline
+    for i in range(4):
+        assert d.observe(0.5, now + i) is None
+    assert d.observe(0.5, now + 5) is None
+    assert not d.active
+
+
+# --------------------------------------------------- ThresholdDetector
+
+def test_threshold_detector_hysteresis_and_cooldown():
+    d = ThresholdDetector("burn", 14.0, hysteresis=2, cooldown_s=50.0)
+    assert d.observe(13.9, 0.0) is None
+    assert d.observe(20.0, 1.0) is None        # first bad sample
+    a = d.observe(20.0, 2.0)                   # second: trigger
+    assert a is not None and a["value"] == 20.0
+    assert d.observe(20.0, 3.0) is None        # active: no spam
+    d.observe(1.0, 4.0)
+    d.observe(1.0, 5.0)
+    assert not d.active
+    d.observe(20.0, 6.0)
+    assert d.observe(20.0, 7.0) is None        # inside cooldown
+    d.reset()
+    d.observe(20.0, 60.0)
+    assert d.observe(20.0, 61.0) is not None   # past cooldown
+
+
+# ------------------------------------------------------- ChurnDetector
+
+def test_churn_detector_counts_events_in_window():
+    d = ChurnDetector(max_events=3, window_s=100.0, cooldown_s=0.0)
+    assert d.observe_event(0.0) is None
+    assert d.observe_event(10.0) is None
+    assert d.observe_event(20.0) is None
+    a = d.observe_event(30.0)  # 4th transition inside the window
+    assert a is not None and a["value"] == 4.0
+
+
+def test_churn_detector_window_expiry():
+    d = ChurnDetector(max_events=3, window_s=100.0)
+    for t in (0.0, 10.0, 20.0):
+        d.observe_event(t)
+    # the early events all age out: the 4th event at t=150 sees only
+    # itself inside the 100s window
+    assert d.observe_event(150.0) is None
+    assert len(d.events) == 1
+
+
+# ------------------------------------------------------ burn-rate math
+
+def test_over_slo_count_bucket_edges():
+    bounds = (0.1, 0.5, 1.0, 2.0)
+    # buckets: <=0.1, <=0.5, <=1.0, <=2.0, +Inf
+    assert over_slo_count(bounds, [5, 3, 2, 1, 4], 0.5) == 7
+    assert over_slo_count(bounds, [5, 3, 2, 1, 4], 2.0) == 4
+    assert over_slo_count(bounds, [5, 3, 0, 0, 0], 1.0) == 0
+    # SLO between bounds: the straddling bucket counts as over
+    assert over_slo_count(bounds, [5, 3, 2, 0, 0], 0.7) == 2
+
+
+def test_burn_rate_math():
+    assert burn_rate(0, 100, 0.01) == 0.0
+    assert burn_rate(1, 100, 0.01) == pytest.approx(1.0)  # on budget
+    assert burn_rate(14, 100, 0.01) == pytest.approx(14.0)  # fast burn
+    assert burn_rate(5, 0, 0.01) == 0.0   # no traffic, no burn
+    assert burn_rate(5, 100, 0.0) == 0.0  # no budget configured
+
+
+# --------------------------------------------------- Watcher (fake clock)
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeKV:
+    def __init__(self):
+        self.puts = []
+        self.store = {}
+
+    def put(self, scope, key, value):
+        self.puts.append((scope, key, value))
+        self.store[f"{scope}/{key}"] = value
+
+    def get(self, scope, key, timeout=0.0):
+        return self.store.get(f"{scope}/{key}")
+
+
+@pytest.fixture()
+def fake_scope(monkeypatch):
+    """A fake-clock perfscope installed as the process-wide scope."""
+    clock = FakeClock()
+    scope = P.PerfScope(window=256, clock=clock)
+    monkeypatch.setattr(P, "_scope", scope)
+    monkeypatch.setenv("HOROVOD_PERFSCOPE", "1")
+    yield clock, scope
+    P.reset_for_tests()
+
+
+@pytest.fixture()
+def fresh_metrics():
+    m.reset_for_tests()
+    yield m.registry()
+    m.reset_for_tests()
+
+
+def make_watcher(clock, monkeypatch, **kw):
+    monkeypatch.setenv("HOROVOD_WATCH_WARMUP", "5")
+    monkeypatch.setenv("HOROVOD_WATCH_HYSTERESIS", "3")
+    monkeypatch.setenv("HOROVOD_WATCH_COOLDOWN_SECONDS", "60")
+    kw.setdefault("dump_fn", lambda trig: None)
+    kw.setdefault("capture_fn", lambda *a, **k: True)
+    return Watcher(clock=clock, **kw)
+
+
+def run_step(clock, scope, dur, comms=0.0, input_wait=0.0):
+    with scope.step():
+        if input_wait:
+            with scope.phase("input_wait"):
+                clock.advance(input_wait)
+        clock.advance(dur)
+        if comms:
+            with scope.phase("comms"):
+                clock.advance(comms)
+
+
+def test_watcher_detects_sustained_local_slowdown(
+        fake_scope, fresh_metrics, monkeypatch, tmp_path):
+    clock, scope = fake_scope
+    monkeypatch.setenv("HOROVOD_WATCH_DIR", str(tmp_path))
+    dumps, captures = [], []
+    w = make_watcher(clock, monkeypatch,
+                     dump_fn=lambda trig: dumps.append(trig),
+                     capture_fn=lambda *a, **k: captures.append(a) or True)
+    for _ in range(10):
+        run_step(clock, scope, 0.15)
+        w.tick()
+    assert w.counts() == {}
+    for _ in range(5):
+        run_step(clock, scope, 0.60)
+        w.tick()
+    assert w.counts().get("step_time") == 1
+    assert "step_time" in w.active()
+    assert dumps == ["anomaly:step_time"]
+    assert len(captures) == 1
+    fam = fresh_metrics.peek("hvdwatch_anomalies_total")
+    assert fam is not None
+    series = {tuple(s["labels"]): s["value"]
+              for s in fam.snapshot_series()}
+    assert series.get(("step_time",)) == 1.0
+    rec = w.records()[0]
+    assert rec["detector"] == "step_time" and rec["z"] > 6
+    assert rec["step"] > 0 and rec["active"]
+
+
+def test_watcher_ignores_peer_wait_in_comms(fake_scope, fresh_metrics,
+                                            monkeypatch):
+    """The fast rank of a 2-rank job parks the slow peer's delta in
+    `comms` — its WALL time doubles but its LOCAL time does not, and
+    it must stay quiet (only the culprit alerts)."""
+    clock, scope = fake_scope
+    w = make_watcher(clock, monkeypatch)
+    for _ in range(10):
+        run_step(clock, scope, 0.15, comms=0.02)
+        w.tick()
+    for _ in range(6):
+        run_step(clock, scope, 0.15, comms=0.50)  # waiting on the peer
+        w.tick()
+    assert w.counts() == {}
+
+
+def test_watcher_detects_input_wait_creep(fake_scope, fresh_metrics,
+                                          monkeypatch):
+    clock, scope = fake_scope
+    w = make_watcher(clock, monkeypatch)
+    for _ in range(10):
+        run_step(clock, scope, 0.05, input_wait=0.01)
+        w.tick()
+    for _ in range(6):
+        run_step(clock, scope, 0.05, input_wait=0.40)
+        w.tick()
+    counts = w.counts()
+    assert counts.get("input_wait") == 1
+    # the creep also shifted local step time — both detectors naming it
+    # is fine; input_wait is the one that names the CAUSE
+    assert "input_wait" in w.active()
+
+
+def test_watcher_resets_baselines_on_elastic_round(
+        fake_scope, fresh_metrics, monkeypatch):
+    """A new elastic round is a new perf regime on a new rank
+    assignment: 5x slower steps after the round change must NOT alert
+    (the baseline restarts), exactly like the detector-level reset."""
+    clock, scope = fake_scope
+    monkeypatch.setenv("HOROVOD_ELASTIC_ROUND", "1")
+    w = make_watcher(clock, monkeypatch)
+    for _ in range(10):
+        run_step(clock, scope, 0.1)
+        w.tick()
+    monkeypatch.setenv("HOROVOD_ELASTIC_ROUND", "2")
+    for _ in range(8):
+        run_step(clock, scope, 0.5)
+        w.tick()
+    assert w.counts().get("step_time") is None
+
+
+def test_watcher_flags_elastic_round_churn(fake_scope, fresh_metrics,
+                                           monkeypatch):
+    clock, scope = fake_scope
+    monkeypatch.setenv("HOROVOD_WATCH_CHURN_ROUNDS", "2")
+    monkeypatch.setenv("HOROVOD_WATCH_CHURN_WINDOW_SECONDS", "1000")
+    monkeypatch.setenv("HOROVOD_ELASTIC_ROUND", "1")
+    w = make_watcher(clock, monkeypatch)
+    w.tick()
+    for rnd in (2, 3, 4):
+        clock.advance(5.0)
+        monkeypatch.setenv("HOROVOD_ELASTIC_ROUND", str(rnd))
+        w.tick()
+    assert w.counts().get("elastic_churn") == 1
+
+
+def test_watcher_serve_burn_rate_trips_and_sets_gauge(
+        fake_scope, fresh_metrics, monkeypatch):
+    clock, scope = fake_scope
+    monkeypatch.setenv("HOROVOD_WATCH_SERVE_SLO_MS", "1000")
+    monkeypatch.setenv("HOROVOD_WATCH_SERVE_BUDGET", "0.01")
+    monkeypatch.setenv("HOROVOD_WATCH_BURN_RATE", "14")
+    w = make_watcher(clock, monkeypatch)
+    hist = fresh_metrics.histogram(
+        "horovod_serve_request_seconds", buckets=m.TIME_BUCKETS)
+    w.tick()  # no serve traffic yet: no burn sample
+    # healthy traffic: everything under the SLO
+    for _ in range(4):
+        for _ in range(50):
+            hist.observe(0.01)
+        clock.advance(5.0)
+        w.tick()
+    assert w.counts() == {}
+    # tail blowup: half of each window slower than 1s
+    for _ in range(4):
+        for _ in range(25):
+            hist.observe(0.01)
+        for _ in range(25):
+            hist.observe(4.0)
+        clock.advance(5.0)
+        w.tick()
+    assert w.counts().get("serve_burn") == 1
+    burn = fresh_metrics.peek("horovod_serve_slo_burn_rate")
+    assert burn is not None and burn.value == pytest.approx(50.0)
+
+
+def test_watcher_kv_record_is_rank_round_keyed(fake_scope, fresh_metrics,
+                                               monkeypatch):
+    clock, scope = fake_scope
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HOROVOD_ELASTIC_ROUND", "2")
+    kv = FakeKV()
+    w = make_watcher(clock, monkeypatch, kv_factory=lambda: kv)
+    for _ in range(10):
+        run_step(clock, scope, 0.1)
+        w.tick()
+    assert not kv.puts  # quiet rank pushes nothing
+    for _ in range(5):
+        run_step(clock, scope, 0.6)
+        w.tick()
+    scopes_keys = {(s, k) for s, k, _ in kv.puts}
+    assert (watch.SCOPE, "rank-3.r2") in scopes_keys
+    body = json.loads(kv.puts[-1][2])
+    assert body["watch"] == watch.WATCH_VERSION
+    assert body["rank"] == 3 and body["round"] == 2
+    assert body["counts"]["step_time"] == 1
+    assert body["anomalies"][0]["detector"] == "step_time"
+    assert "step_time" in body["active"]
+
+
+def test_watcher_rank0_sink_aggregates_and_webhooks(
+        fake_scope, fresh_metrics, monkeypatch):
+    clock, scope = fake_scope
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    monkeypatch.setenv("HOROVOD_WATCH_WEBHOOK", "http://sink.test/hook")
+    monkeypatch.setenv("HOROVOD_WATCH_AGGREGATE_SECONDS", "1")
+    kv = FakeKV()
+    # a peer's record already sits in the KV
+    kv.store[f"{watch.SCOPE}/rank-1.r0"] = json.dumps({
+        "watch": 1, "rank": 1, "round": 0,
+        "anomalies": [{"detector": "mfu", "value": 0.1, "median": 0.5,
+                       "z": -9.0, "rank": 1, "round": 0, "step": 7,
+                       "wall_time": 1.0, "active": True}],
+        "counts": {"mfu": 1}, "active": ["mfu"]}).encode()
+    hooks = []
+    w = make_watcher(clock, monkeypatch, kv_factory=lambda: kv,
+                     webhook_fn=lambda url, a: hooks.append((url, a)))
+    for _ in range(3):
+        clock.advance(2.0)
+        w.tick()
+    assert any(a["detector"] == "mfu" and a["rank"] == 1
+               for _, a in hooks)
+    # dedupe: further passes do not re-alert the same anomaly
+    n = len(hooks)
+    clock.advance(2.0)
+    w.tick()
+    assert len(hooks) == n
+
+
+def test_noop_shell_under_env_off(monkeypatch):
+    monkeypatch.setenv("HOROVOD_WATCH", "0")
+    watch.reset_for_tests()
+    try:
+        w = watch.get()
+        assert w is watch.NOOP
+        assert w.tick() == []
+        assert w.kv_payload() is None and w.counts() == {}
+    finally:
+        watch.reset_for_tests()
+
+
+def test_persist_kv_records_writes_files(tmp_path):
+    class Store:
+        def scope_items(self, scope):
+            assert scope == watch.SCOPE
+            return {"rank-0.r1": b'{"watch": 1, "anomalies": []}'}
+
+    out = watch.persist_kv_records(Store(), str(tmp_path))
+    assert out and os.path.basename(out[0]) == "watch-rank-0.r1.json"
+    assert json.load(open(out[0]))["watch"] == 1
+
+
+def test_persist_kv_records_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("HOROVOD_WATCH_DIR", raising=False)
+    monkeypatch.delenv("HOROVOD_FLIGHT_DIR", raising=False)
+
+    class Store:
+        def scope_items(self, scope):  # pragma: no cover - not reached
+            raise AssertionError("must not be consulted without a dir")
+
+    assert watch.persist_kv_records(Store()) == []
+
+
+# -------------------------------------------------- device capture hook
+
+def test_capture_hook_serializes_and_produces_artifact(tmp_path):
+    from horovod_tpu.profiler import device_profile as dp
+    import jax.numpy as jnp
+    steps = [0]
+    out = str(tmp_path / "trace")
+    ok = dp.start_on_demand_capture(out, steps=1,
+                                    step_count_fn=lambda: steps[0],
+                                    timeout_s=10.0, poll_s=0.01)
+    assert ok and dp.capture_active()
+    # a second trigger while one runs is SKIPPED, not queued
+    assert not dp.start_on_demand_capture(str(tmp_path / "t2"), steps=1,
+                                          step_count_fn=lambda: steps[0])
+    jnp.ones((8, 8)).block_until_ready()  # something to trace
+    steps[0] = 5  # the "job" advanced past the capture window
+    # Generous deadline: the profiler's first start/stop in a process
+    # can take tens of seconds on sandboxed runners — which is exactly
+    # why the hook runs it off-thread.
+    import time as _t
+    deadline = _t.monotonic() + 90.0
+    while dp.capture_active() and _t.monotonic() < deadline:
+        _t.sleep(0.02)
+    assert not dp.capture_active()
+    assert glob.glob(out + "/**/*", recursive=True)
+
+
+# ------------------------------------------------- doctor [anomalies]
+
+def _watch_record(rank, rnd, detector="step_time", step=12, **kw):
+    a = {"detector": detector, "value": 0.6, "median": 0.15, "z": 20.0,
+         "rank": rank, "round": rnd, "step": step,
+         "wall_time": 100.0 + rank, "active": True}
+    a.update(kw)
+    return {"watch": 1, "rank": rank, "round": rnd, "size": 2,
+            "wall_time": 101.0, "anomalies": [a],
+            "counts": {detector: 1}, "active": [detector]}
+
+
+def test_doctor_anomalies_section_names_rank_and_detector(tmp_path,
+                                                          capsys):
+    from horovod_tpu.observability import doctor
+    rec = _watch_record(0, 1)
+    (tmp_path / "watch-rank-0.r1.json").write_text(json.dumps(rec))
+    perf = {"rank": 0, "round": 1, "perfscope": 1, "wall_time": 1.0,
+            "summary": {"steps": 20, "wall": {"mean_s": 0.6,
+                                              "p50_s": 0.6,
+                                              "p95_s": 0.7, "max_s": 0.8},
+                        "local_mean_s": 0.55,
+                        "dominant_local_phase": "dispatch",
+                        "phase_fractions": {}}}
+    peer = {"rank": 1, "round": 1, "perfscope": 1, "wall_time": 1.0,
+            "summary": {"steps": 20, "wall": {"mean_s": 0.6,
+                                              "p50_s": 0.6,
+                                              "p95_s": 0.7, "max_s": 0.8},
+                        "local_mean_s": 0.05,
+                        "dominant_local_phase": "dispatch",
+                        "phase_fractions": {}}}
+    (tmp_path / "perf-rank-0.r1.json").write_text(json.dumps(perf))
+    (tmp_path / "perf-rank-1.r1.json").write_text(json.dumps(peer))
+    assert doctor.main(["--dir", str(tmp_path), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    an = report["anomalies"]
+    assert an["total"] == 1
+    assert an["detectors"] == {"step_time": 1}
+    entry = an["anomalies"][0]
+    assert entry["rank"] == 0 and entry["detector"] == "step_time"
+    # the anomalous rank is also the perf straggler: corroborated
+    assert any("perf straggler" in c for c in entry["corroborated_by"])
+    # text rendering names it too
+    assert doctor.main(["--dir", str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    assert "[anomalies]" in text
+    assert "ANOMALY rank 0" in text and "step_time" in text
+
+
+def test_doctor_dedupes_watch_records_per_rank_round():
+    from horovod_tpu.observability import doctor
+    early = _watch_record(0, 1)
+    late = _watch_record(0, 1)
+    late["counts"] = {"step_time": 3}
+    late["anomalies"] = late["anomalies"] * 3
+    out = doctor.dedupe_watch([early, late])
+    assert len(out) == 1 and out[0]["counts"] == {"step_time": 3}
+
+
+def test_doctor_survives_malformed_watch_record(tmp_path, capsys):
+    """A truncated/hand-edited record must never cost the whole report:
+    entries missing the numeric fields render() formats are dropped at
+    the parse boundary, the rest of the record (and report) survives."""
+    from horovod_tpu.observability import doctor
+    rec = {"watch": 1, "rank": "0", "round": None, "size": 2,
+           "anomalies": [
+               {"detector": "step_time"},            # no value/median
+               "not-a-dict",
+               {"detector": "mfu", "value": "x", "median": 1},
+               {"detector": "input_wait", "value": 0.5,
+                "median": 0.1, "z": "bad", "step": 3},
+           ],
+           "counts": {"input_wait": 1, "junk": "NaNish"}}
+    (tmp_path / "watch-rank-0.r0.json").write_text(json.dumps(rec))
+    assert doctor.main(["--dir", str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    assert "[anomalies]" in text
+    assert "input_wait" in text and "junk" not in text
+    assert doctor.main(["--dir", str(tmp_path), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    an = report["anomalies"]
+    # the non-dict and uncoercible entries were dropped; the merely
+    # field-less one fails OPEN (value/median default to 0.0)
+    dets = sorted(a["detector"] for a in an["anomalies"])
+    assert dets == ["input_wait", "step_time"], an
+    by_det = {a["detector"]: a for a in an["anomalies"]}
+    assert by_det["step_time"]["value"] == 0.0
+    assert by_det["input_wait"]["rank"] == 0
+    assert by_det["input_wait"]["z"] is None
+
+
+def test_doctor_rejects_newer_watch_version(capsys):
+    from horovod_tpu.observability import doctor
+    rec = _watch_record(0, 1)
+    rec["watch"] = 99
+    raw = json.dumps(rec).encode()
+    assert doctor._parse_watch(raw, "x") is None
+
+
+# --------------------------------------------------------------- hvdtop
+
+def test_parse_metrics_text_and_rank_filter():
+    from horovod_tpu.observability import top
+    text = (
+        "# HELP horovod_mfu whatever\n"
+        "# TYPE horovod_mfu gauge\n"
+        'horovod_mfu{rank="0"} 0.25\n'
+        'horovod_mfu{rank="1"} 0.5\n'
+        'horovod_step_phase_seconds{phase="comms",rank="0"} 0.01\n'
+        "horovod_kv_requests_total 12\n")
+    doc = top.parse_metrics_text(text)
+    assert top.series_by_rank(doc, "horovod_mfu") == {0: 0.25, 1: 0.5}
+    assert top.series_by_rank(doc, "horovod_step_phase_seconds",
+                              phase="comms") == {0: 0.01}
+    assert doc["horovod_kv_requests_total"][0] == ({}, 12.0)
+
+
+def test_hvdtop_snapshot_and_render_against_live_server(monkeypatch):
+    """End-to-end over loopback: a RendezvousServer primed with pushed
+    perf/watch/flight records and worker metric snapshots must come
+    back as one per-rank view with step time, MFU and the active
+    anomaly — the `--once --json` contract."""
+    from horovod_tpu.observability import top
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+    m.reset_for_tests()
+    monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        perf = {"rank": 0, "round": 0, "perfscope": 1, "size": 1,
+                "wall_time": 1.0,
+                "summary": {"steps": 40,
+                            "wall": {"mean_s": 0.2, "p50_s": 0.2,
+                                     "p95_s": 0.3, "max_s": 0.4},
+                            "local_mean_s": 0.18,
+                            "dominant_phase": "dispatch",
+                            "mfu": 0.31, "mfu_source": "xla",
+                            "phase_fractions": {"dispatch": 0.9,
+                                                "comms": 0.1}}}
+        srv.put("perf", "rank-0.r0", json.dumps(perf).encode())
+        srv.put("watch", "rank-0.r0",
+                json.dumps(_watch_record(0, 0)).encode())
+        snap = top.snapshot("127.0.0.1", port, max_ranks=4)
+        row = snap["ranks"]["0"]
+        assert row["step_ms"]["mean"] == pytest.approx(200.0)
+        assert row["mfu"] == pytest.approx(0.31)
+        assert row["active_anomalies"] == ["step_time"]
+        assert snap["job"]["anomalies_total"] == 1
+        assert "rank0:step_time" in snap["job"]["active_anomalies"]
+        text = top.render(snap)
+        assert "hvdtop" in text and "step_time!" in text
+        assert "0.310" in text
+    finally:
+        srv.stop()
+        m.reset_for_tests()
+
+
+def test_hvdtop_cli_requires_addr(monkeypatch, capsys):
+    from horovod_tpu.observability import top
+    for var in ("HOROVOD_GLOO_RENDEZVOUS_ADDR",
+                "HOROVOD_GLOO_RENDEZVOUS_PORT",
+                "HOROVOD_RENDEZVOUS_PORT_FILE"):
+        monkeypatch.delenv(var, raising=False)
+    assert top.main([]) == 2
+    assert top.main(["--addr", "nonsense"]) == 2
