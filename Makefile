@@ -57,9 +57,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline metrics race doctor-smoke serve-smoke watch-smoke fusion-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline metrics race doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate
 
-test: lint hlo-lint test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke fusion-smoke perf-gate entry
+test: lint hlo-lint test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -114,6 +114,19 @@ serve-smoke:
 perf-gate:
 	$(PYTHON) scripts/perf_gate.py --run \
 	    --baseline scripts/perf_baseline.json
+
+# Conv fast path (docs/perf.md): the fused-vs-reference equivalence
+# suite for the conv+BN+ReLU block kernels + the layout pass, then the
+# hvdhlo lint of the lane-padded ResNet-block step program — the
+# C=64 50%-waste fixture's live twin must lower CLEAN (zero HVD204)
+# under the default layout config; HOROVOD_LAYOUT_PAD=0 or a layout
+# regression trips it, on CPU-only CI.
+conv-smoke:
+	$(PYTEST) tests/test_conv_block.py tests/test_layout.py
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step resnet_block \
+	    --baseline scripts/hvdhlo_baseline.json
 
 # Fusion-cliff guard (docs/perf.md): interleaved threshold sweep on the
 # 8-rank virtual mesh asserting no >1.5x latency cliff between adjacent

@@ -254,6 +254,47 @@ def _watch_stamp():
 # --------------------------------------------------------------------------
 
 
+def _stage_inputs(mesh, rng, batch, img, dtype, num_classes=1000):
+    """The ONE synthetic input-staging path for the conv sections
+    (images + labels onto the mesh) — through the device-resident
+    double-buffered feed (data/data_loader.DeviceFeed, docs/perf.md
+    "conv fast path"), so the conv sections measure the input pipeline
+    they recommend: the host→device transfer happens on the feed's
+    prefetch thread, off the critical path, and any starvation would
+    land in perfscope ``input_wait``. The staged arrays then ride the
+    scan carry (fully device-resident steps). Returns
+    (images, labels, input_pipeline stamp)."""
+    from horovod_tpu.data import DeviceFeed
+
+    sh = NamedSharding(mesh, P("hvd"))
+    host = (rng.standard_normal((batch, img, img, 3),
+                                np.float32).astype(dtype),
+            rng.integers(0, num_classes, (batch,)))
+    feed = DeviceFeed(iter([host]), sharding=sh, depth=2)
+    images, labels = next(iter(feed))
+    feed.close()
+    stamp = {"mode": "device_double_buffered", "depth": 2,
+             "staged_mb": round(
+                 (images.nbytes + labels.nbytes) / 2**20, 1)}
+    return images, labels, stamp
+
+
+def _layout_stamp(plan=None, note=None):
+    """Per-section layout stamp (scripts/perf_gate.py asserts its
+    presence and, for the ResNet sections, the padded mode — a revert
+    to the unpadded layout fails the gate structurally)."""
+    from horovod_tpu.ops.conv_block import conv_block_enabled
+
+    if plan is not None:
+        s = plan.summary()
+    else:
+        s = {"mode": "as_declared"}
+        if note:
+            s["note"] = note
+    s["conv_block_fused"] = conv_block_enabled()
+    return s
+
+
 def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
     img = 32 if on_cpu else 224
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
@@ -261,6 +302,14 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
 
     params, stats = resnet.init(jax.random.PRNGKey(0), depth=depth,
                                 num_classes=1000, dtype=dtype)
+    # Conv fast path (docs/perf.md): lane-pad the declared conv stack so
+    # the compiled program clears hvdhlo HVD204 — the stage-0 width-64
+    # convs otherwise run the MXU at 50% padding waste on every step.
+    # HOROVOD_LAYOUT_PAD=0 reverts (and the perf gate's layout stamp
+    # check then fails, by design).
+    from horovod_tpu.ops import layout as L
+    lay = L.plan(params, resnet.conv_stack(depth))
+    params, stats = lay.pad(params), lay.pad(stats)
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(params)
 
@@ -280,11 +329,8 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
                          check_vma=False)
 
     rng = np.random.default_rng(0)
-    images = jax.device_put(
-        rng.standard_normal((batch, img, img, 3), np.float32).astype(dtype),
-        NamedSharding(mesh, P("hvd")))
-    labels = jax.device_put(rng.integers(0, 1000, (batch,)),
-                            NamedSharding(mesh, P("hvd")))
+    images, labels, feed_stamp = _stage_inputs(mesh, rng, batch, img,
+                                               dtype)
 
     def body(carry):
         p, s, o, im, lb, _ = carry
@@ -312,6 +358,8 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
         "step_ms": round(sec_per_step * 1e3, 2),
         "model_flops_per_image": flops_per_img,
         "timing": f"slope over calls of a {chain}-step device-side scan",
+        "layout": _layout_stamp(lay),
+        "input_pipeline": feed_stamp,
     }
     # CPU smoke shrinks the image to 32px — the @224 constants would be
     # ~50x off there, so the fallback (and the vs-XLA ratio) is TPU-only.
@@ -355,11 +403,8 @@ def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
                          out_specs=(P(), P(), P(), P()),
                          check_vma=False)
     rng = np.random.default_rng(0)
-    images = jax.device_put(
-        rng.standard_normal((batch, img, img, 3), np.float32).astype(dtype),
-        NamedSharding(mesh, P("hvd")))
-    labels = jax.device_put(rng.integers(0, 1000, (batch,)),
-                            NamedSharding(mesh, P("hvd")))
+    images, labels, feed_stamp = _stage_inputs(mesh, rng, batch, img,
+                                               dtype)
 
     def body(carry):
         p, s, o, im, lb, _ = carry
@@ -378,7 +423,11 @@ def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
          "step_ms": round(sec * 1e3, 2),
          "model_flops_per_image":
              F.inception_v3_train_flops_per_image("macs")
-             if not on_cpu else None}
+             if not on_cpu else None,
+         "layout": _layout_stamp(
+             note="no conv_stack declaration yet (mixed 5x5/7x1 "
+                  "channel plan; HVD204 stamp names the dims)"),
+         "input_pipeline": feed_stamp}
     # @299 constants vs the 80px CPU smoke: fallback is TPU-only.
     return _perf_stamp(
         r, "inception_v3", flops_info, prof,
@@ -479,11 +528,8 @@ def bench_vgg16(mesh, k, steps=12, warmup=2):
                          in_specs=(P(), P(), P("hvd")),
                          out_specs=(P(), P(), P()), check_vma=False)
     rng = np.random.default_rng(0)
-    images = jax.device_put(
-        rng.standard_normal((batch, img, img, 3), np.float32).astype(dtype),
-        NamedSharding(mesh, P("hvd")))
-    labels = jax.device_put(rng.integers(0, 1000, (batch,)),
-                            NamedSharding(mesh, P("hvd")))
+    images, labels, feed_stamp = _stage_inputs(mesh, rng, batch, img,
+                                               dtype)
 
     def body(carry):
         p, o, im, lb, _ = carry
@@ -499,7 +545,12 @@ def bench_vgg16(mesh, k, steps=12, warmup=2):
     r = {"images_per_sec_per_chip": round(b / sec, 2),
          "per_chip_batch": b, "image_size": img,
          "step_ms": round(sec * 1e3, 2),
-         "model_flops_per_image": F.vgg16_train_flops_per_image("macs")}
+         "model_flops_per_image": F.vgg16_train_flops_per_image("macs"),
+         "layout": _layout_stamp(
+             note="no conv_stack declaration yet (all-3x3 body — the "
+                  "1x1 fast path does not apply; HVD204 stamp names "
+                  "any unaligned dims)"),
+         "input_pipeline": feed_stamp}
     return _perf_stamp(r, "vgg16", flops_info, prof,
                        F.vgg16_train_flops_per_image("flops") * b,
                        hlo_info=hlo_info)

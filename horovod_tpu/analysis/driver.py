@@ -321,11 +321,12 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                              "StableHLO/HLO text dumps and run the "
                              "HVD2xx rules over the program structure")
     parser.add_argument("--hlo-step", default=None, metavar="PROGRAM",
-                        choices=("lm",),
+                        choices=("lm", "resnet_block"),
                         help="hvdhlo mode: lower the named canonical "
-                             "step program under the current fusion "
-                             "config on the virtual CPU mesh and lint "
-                             "it (the `make hlo-lint` CI gate)")
+                             "step program under the current fusion/"
+                             "layout config on the virtual CPU mesh "
+                             "and lint it (the `make hlo-lint` / "
+                             "`make conv-smoke` CI gates)")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule IDs to run (default all)")
     parser.add_argument("--ignore", default="",

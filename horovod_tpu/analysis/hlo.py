@@ -490,6 +490,8 @@ def lower_step_text(kind: str = "lm") -> str:
     pre-PR-6 single-giant-allreduce plan (or lifting the cap while
     raising the threshold) resurfaces a >cap payload and trips HVD201.
     """
+    if kind == "resnet_block":
+        return _resnet_block_step_text()
     if kind != "lm":
         raise ValueError(f"unknown --hlo-step program {kind!r}")
     jax = _force_cpu_mesh()
@@ -546,6 +548,92 @@ def lower_step_text(kind: str = "lm") -> str:
                          in_specs=(P(), P("hvd"), P("hvd")), out_specs=P(),
                          check_vma=False)
     return jax.jit(step, donate_argnums=0).lower(params, tok, tgt).as_text()
+
+
+def _resnet_block_step_text() -> str:
+    """StableHLO text of a C=64 ResNet bottleneck-block train step under
+    the CURRENT layout config — the `make conv-smoke` gate.
+
+    The block is the live twin of the checked-in
+    ``hvd204_resnet_block`` fixture (stage-0 shape: trunk 64, width 64
+    — every conv channel dim at 50% MXU padding waste, the exact
+    HVD204 canary). The layout pass (ops/layout.py) pads the declared
+    stack to the 128-lane width before lowering, so the DEFAULT config
+    lints clean; reverting the pass (HOROVOD_LAYOUT_PAD=0, or a
+    regression in plan()/pad()) resurfaces the unaligned dims and
+    trips HVD204 — pinned both ways by tests/test_hvdhlo.py.
+    """
+    jax = _force_cpu_mesh()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.ops import layout as L
+    from horovod_tpu.ops.layout import Site
+
+    C, W = 64, 64  # stage-0 trunk/width: the 50%-waste fixture shape
+    rng = np.random.default_rng(0)
+
+    def conv_init(kh, kw, cin, cout):
+        return jnp.asarray(
+            rng.standard_normal((kh, kw, cin, cout))
+            * (2.0 / (kh * kw * cin)) ** 0.5, jnp.float32)
+
+    def bn_init(c):
+        return {"scale": jnp.ones((c,), jnp.float32),
+                "bias": jnp.zeros((c,), jnp.float32)}
+
+    params = {"conv1": conv_init(1, 1, C, W), "bn1": bn_init(W),
+              "conv2": conv_init(3, 3, W, W), "bn2": bn_init(W),
+              "conv3": conv_init(1, 1, W, 4 * W), "bn3": bn_init(4 * W),
+              "proj": conv_init(1, 1, C, 4 * W), "bnp": bn_init(4 * W),
+              "fc": jnp.asarray(rng.standard_normal((4 * W, 1000))
+                                * (4 * W) ** -0.5, jnp.float32)}
+    stack = [Site("conv1", {2: "in", 3: "c1"}),
+             Site("bn1/scale", {0: "c1"}), Site("bn1/bias", {0: "c1"}),
+             Site("conv2", {2: "c1", 3: "c2"}),
+             Site("bn2/scale", {0: "c2"}), Site("bn2/bias", {0: "c2"}),
+             Site("conv3", {2: "c2", 3: "out"}),
+             Site("bn3/scale", {0: "out"}), Site("bn3/bias", {0: "out"}),
+             Site("proj", {2: "in", 3: "out"}),
+             Site("bnp/scale", {0: "out"}), Site("bnp/bias", {0: "out"}),
+             Site("fc", {0: "out"})]
+    plan = L.plan(params, stack)
+    params = plan.pad(params)
+    cin = plan.edges["in"].padded  # activations enter on the padded trunk
+
+    def bn(x, p):
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        var = jnp.mean(jnp.square(x), axis=(0, 1, 2)) - jnp.square(mean)
+        inv = jax.lax.rsqrt(var + 1e-5)
+        return (x - mean) * inv * p["scale"] + p["bias"]
+
+    def conv(x, w, stride=1):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(stride, stride), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def loss(p, x, yl):
+        h = jax.nn.relu(bn(conv(x, p["conv1"]), p["bn1"]))
+        h = jax.nn.relu(bn(conv(h, p["conv2"]), p["bn2"]))
+        h = bn(conv(h, p["conv3"]), p["bn3"])
+        sc = bn(conv(x, p["proj"]), p["bnp"])
+        h = jnp.mean(jax.nn.relu(h + sc), axis=(1, 2))
+        logp = jax.nn.log_softmax(h @ p["fc"])
+        return -jnp.mean(jnp.take_along_axis(logp, yl[:, None], axis=1))
+
+    def step(p, x, yl):
+        g = jax.grad(loss)(p, x, yl)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.01 * b, p, g)
+
+    # Bench-canonical batch and class count: the BACKWARD contracts over
+    # the batch (conv dW) and the classes (softmax dlogits), so an
+    # unaligned batch would self-inflict the very HVD204 findings this
+    # program exists to prove the LAYOUT pass removes. B=128 is the
+    # measured conv sweet spot (docs/benchmarks.md); 1000 classes sits
+    # under the padding-waste floor, exactly like the real model.
+    x = jnp.asarray(rng.standard_normal((128, 8, 8, cin)), jnp.float32)
+    yl = jnp.asarray(rng.integers(0, 1000, (128,)))
+    return jax.jit(step, donate_argnums=0).lower(params, x, yl).as_text()
 
 
 #: Stable pseudo-path for --hlo-step findings, so baseline entries

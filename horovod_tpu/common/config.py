@@ -87,6 +87,11 @@ HOROVOD_BUCKET_AUTOTUNE = "HOROVOD_BUCKET_AUTOTUNE"
 HOROVOD_BUCKET_AUTOTUNE_INTERVAL = "HOROVOD_BUCKET_AUTOTUNE_INTERVAL"
 HOROVOD_BUCKET_AUTOTUNE_MAX_ADJUSTMENTS = \
     "HOROVOD_BUCKET_AUTOTUNE_MAX_ADJUSTMENTS"
+# Conv fast path (docs/perf.md): online layout arbitration between the
+# lane-padded and as-declared model layouts (ops/layout.py,
+# core/autotune.OnlineLayoutTuner).
+HOROVOD_LAYOUT_AUTOTUNE = "HOROVOD_LAYOUT_AUTOTUNE"
+HOROVOD_LAYOUT_AUTOTUNE_INTERVAL = "HOROVOD_LAYOUT_AUTOTUNE_INTERVAL"
 # (HOROVOD_BATCH_D2D_MEMCOPIES and HOROVOD_ENABLE_ASYNC_COMPLETION have no
 # TPU analog — XLA fuses the copies and JAX dispatch is always async — so
 # those knobs are intentionally absent rather than parsed-and-dead.)
@@ -179,6 +184,11 @@ class Config:
     bucket_autotune: bool = False
     bucket_autotune_interval: int = 20
     bucket_autotune_max_adjustments: int = 4
+    # Per-model layout arbitration (ops/layout.py, docs/perf.md): score
+    # NHWC-lane-padded vs as-declared by measured step time; rank 0
+    # decides and broadcasts (core/autotune.OnlineLayoutTuner).
+    layout_autotune: bool = False
+    layout_autotune_interval: int = 20
 
     # Timeline / autotune
     timeline_path: str = ""
@@ -274,6 +284,9 @@ class Config:
                 HOROVOD_BUCKET_AUTOTUNE_INTERVAL, 20),
             bucket_autotune_max_adjustments=_env_int(
                 HOROVOD_BUCKET_AUTOTUNE_MAX_ADJUSTMENTS, 4),
+            layout_autotune=_env_bool(HOROVOD_LAYOUT_AUTOTUNE),
+            layout_autotune_interval=_env_int(
+                HOROVOD_LAYOUT_AUTOTUNE_INTERVAL, 20),
             donate_buffers=_env_bool(HOROVOD_TPU_DONATE_BUFFERS),
             timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),

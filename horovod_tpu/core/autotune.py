@@ -613,3 +613,130 @@ class OnlineBucketTuner:
                     f"{self.max_adjustments})")
         except Exception:
             pass  # telemetry must never break the tuner
+
+
+# --------------------------------------------------------------------------
+# Online layout tuner (HOROVOD_LAYOUT_AUTOTUNE; docs/perf.md)
+# --------------------------------------------------------------------------
+
+class OnlineLayoutTuner:
+    """Arbitrate the per-model layout choice — NHWC lane-padded vs
+    as-declared (ops/layout.py) — by measured step time, online.
+
+    The layout pass is exact math either way; which one is FASTER is a
+    property of the model's channel dims, the batch, and the compiler
+    version, so it is measured, not assumed: each arm runs for
+    `layout_autotune_interval` optimizer steps, recorded step walls
+    accumulate per arm, and once every arm has a window rank 0 picks
+    the lower mean and broadcasts — every rank applies the SAME layout
+    at the SAME step (a split would feed differently-shaped programs
+    to the collectives; the broadcast itself is a named consistent
+    collective, same machinery as OnlineBucketTuner). One decision per
+    job: layout changes recompile everything downstream, so the tuner
+    freezes immediately after the playoff instead of re-arbitrating.
+
+    Drive it from the training loop:
+
+        tuner = OnlineLayoutTuner(cfg, arms=("as_declared",
+                                             "nhwc_padded"))
+        while training:
+            t0 = time.perf_counter()
+            step(...)
+            tuner.record_step(time.perf_counter() - t0)
+            if tuner.update():
+                params = swap_layout(params, tuner.choice)
+    """
+
+    def __init__(self, config, arms: Tuple[str, ...] = ("as_declared",
+                                                        "nhwc_padded")):
+        if len(arms) < 2:
+            raise ValueError("layout tuner needs at least two arms")
+        self.cfg = config
+        self.enabled = bool(config.layout_autotune)
+        self.interval = max(int(config.layout_autotune_interval), 2)
+        self.arms = tuple(arms)
+        self._arm = 0
+        self._warmup = 2  # discard the recompile step(s) after a swap
+        self._walls: dict = {a: [] for a in self.arms}
+        self._frozen = not self.enabled
+        self.choice: str = self.arms[0]
+        self.result: Optional[dict] = None
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    def record_step(self, seconds: float) -> None:
+        """One optimizer step's wall time under the current arm. The
+        first `2` steps of every arm window are discarded — they pay
+        the layout swap's retrace/recompile and would bias every new
+        arm ~100x worse than the warm incumbent."""
+        if self._frozen or seconds <= 0:
+            return
+        if self._warmup > 0:
+            self._warmup -= 1
+            return
+        self._walls[self.arms[self._arm]].append(float(seconds))
+
+    def _decide(self):
+        """Rank-0 decision once every arm has a full window: the arm
+        with the lower mean recorded wall wins."""
+        means = {a: sum(w) / len(w) for a, w in self._walls.items() if w}
+        best = min(means, key=lambda a: means[a])
+        self.result = {
+            "winner": best,
+            "mean_step_s": {a: round(m, 6) for a, m in means.items()},
+        }
+        return best
+
+    def update(self) -> bool:
+        """Advance the tuner; call once per optimizer step on EVERY
+        rank. Returns True when the arm (layout) to run under changed
+        this step — the caller swaps the param tree and expects a
+        recompile."""
+        if self._frozen:
+            return False
+        done = len(self._walls[self.arms[self._arm]]) >= self.interval
+        if not done:
+            return False
+        if self._arm + 1 < len(self.arms):
+            self._arm += 1
+            self._warmup = 2
+            self.choice = self.arms[self._arm]
+            self._observe()
+            return True
+        import jax
+
+        if jax.process_count() > 1:
+            from horovod_tpu.core import topology
+            from horovod_tpu.optim.functions import broadcast_object
+            decision = self._decide() if topology.rank() == 0 else None
+            winner = broadcast_object(decision, root_rank=0,
+                                      name="layout_tuner_decision")
+        else:
+            winner = self._decide()
+        changed = winner != self.choice
+        self.choice = winner
+        self._frozen = True
+        self._observe()
+        return changed
+
+    def _observe(self) -> None:
+        try:
+            from horovod_tpu.observability import metrics as m
+            reg = m.registry()
+            if reg.enabled:
+                reg.gauge("horovod_layout_autotune_frozen",
+                          "1 once the online layout tuner froze").set(
+                              1.0 if self._frozen else 0.0)
+                reg.gauge("horovod_layout_autotune_arm",
+                          "Layout arm currently applied (index into "
+                          "the tuner's arm list)").set(
+                              float(self.arms.index(self.choice)))
+            if self._frozen and self.result:
+                from horovod_tpu.observability import flight
+                flight.record(
+                    "autotune", f"layout tuner froze on "
+                    f"{self.choice!r} ({self.result['mean_step_s']})")
+        except Exception:
+            pass  # telemetry must never break the tuner

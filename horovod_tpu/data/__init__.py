@@ -1,5 +1,5 @@
 """Data loading helpers (reference: horovod/data/data_loader_base.py)."""
 
 from horovod_tpu.data.data_loader import (  # noqa: F401
-    AsyncDataLoaderMixin, BaseDataLoader, ShardedDataset,
+    AsyncDataLoaderMixin, BaseDataLoader, DeviceFeed, ShardedDataset,
 )

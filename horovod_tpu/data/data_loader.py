@@ -1,4 +1,5 @@
-"""Data loaders: per-rank sharding + background prefetch.
+"""Data loaders: per-rank sharding, background prefetch, and the
+device-resident double-buffered feed.
 
 Reference: horovod/data/data_loader_base.py — `BaseDataLoader` and
 `AsyncDataLoaderMixin` (:48-135, background-thread prefetch queue) — plus
@@ -8,14 +9,18 @@ TPU notes: the prefetch thread overlaps host-side batch assembly with
 device steps (JAX dispatch is async, so one queue depth of prefetch hides
 most input latency); `ShardedDataset` shards by (rank, size) the way every
 reference example does (`dataset.shard(num_shards=hvd.size(),
-index=hvd.rank())`).
+index=hvd.rank())`). `DeviceFeed` goes one level further (ROADMAP conv-MFU
+item, docs/perf.md "conv fast path"): the prefetch thread also stages the
+*next* batch onto the device (`jax.device_put` off the critical path), so
+the training thread's `next()` hands back an already-device-resident batch
+and the step never pays a host→device transfer on the critical path.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Iterable, Iterator, Optional
+from typing import Any, Callable, Iterable, Iterator, Optional
 
 
 class BaseDataLoader:
@@ -146,3 +151,169 @@ class ShardedDataset(BaseDataLoader):
         for i in range(0, len(mine) - self.batch_size + 1, self.batch_size):
             batch_idx = mine[i:i + self.batch_size]
             yield [self.data[int(j)] for j in batch_idx]
+
+
+class DeviceFeed:
+    """Device-resident double-buffered input feed (docs/perf.md).
+
+    A background thread pulls host batches from `source`, stages each
+    one onto the device with ``jax.device_put`` (under `sharding` when
+    given), and parks the resulting device arrays in a bounded queue.
+    While the current step runs, the NEXT batch's host→device transfer
+    is already in flight — `depth=2` is classic double buffering: one
+    slot being consumed, one being staged, alternating. Consumed slots
+    are simply dropped (JAX frees the donated-out buffer as soon as the
+    training step's last reference dies), so at most `depth` batches
+    are ever device-resident.
+
+    perfscope integration: the ONLY blocking point — the queue get when
+    the producer has fallen behind — is wrapped in the ambient scope's
+    ``input_wait`` phase, so starvation is *measured*, not guessed
+    (the acceptance metric for the device-resident pipeline:
+    ``input_wait`` < 5% of step wall). A fully prefetched feed spends
+    ~0 there; a starved one parks exactly the starvation time.
+
+    ``depth=0`` degrades to the synchronous path (pull + stage inline
+    inside ``input_wait``) — the "before" configuration the perfscope
+    regression test pins against the double-buffered "after".
+
+    The producer's per-batch hook ``data.feed.produce`` is a
+    testing/faults.py injection site (latency there simulates a slow
+    preprocessing tier, docs/resilience.md).
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, source: Iterable[Any], sharding=None,
+                 depth: int = 2, put: Optional[Callable] = None,
+                 scope=None):
+        self.source = iter(source)
+        self.sharding = sharding
+        self.depth = int(depth)
+        self._put_fn = put
+        self._scope = scope
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        if self.depth > 0:
+            self._q = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(target=self._produce,
+                                            name="hvd-device-feed",
+                                            daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------ staging
+    def _stage(self, batch):
+        """Host batch → device arrays (every array leaf device_put,
+        non-array leaves passed through)."""
+        if self._put_fn is not None:
+            return self._put_fn(batch)
+        import jax
+
+        def put(leaf):
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                return jax.device_put(leaf, self.sharding) \
+                    if self.sharding is not None else jax.device_put(leaf)
+            return leaf
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def _produce(self) -> None:
+        from horovod_tpu.testing import faults
+        try:
+            for batch in self.source:
+                if self._stop.is_set():
+                    return
+                faults.inject("data.feed.produce")
+                staged = self._stage(batch)
+                if not self._bounded_put(staged):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            self._error = e
+        finally:
+            self._bounded_put(self._SENTINEL)
+
+    def _bounded_put(self, item) -> bool:
+        """Bounded-queue put that stays responsive to close() — same
+        rationale as data/service._Stream._put: a plain put() leaks the
+        producer thread blocked forever once the consumer is gone."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ----------------------------------------------------------- consume
+    def _perfscope(self):
+        if self._scope is not None:
+            return self._scope
+        from horovod_tpu.profiler import perfscope
+        return perfscope.get()
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        if self.depth <= 0:
+            # synchronous "before" path: pull + stage on the critical
+            # path, all of it measured as input_wait
+            from horovod_tpu.testing import faults
+            with self._perfscope().phase("input_wait"):
+                batch = next(self.source)
+                faults.inject("data.feed.produce")
+                return self._stage(batch)
+        with self._perfscope().phase("input_wait"):
+            # Stop-aware poll, not a bare get(): close() drains the
+            # queue and the stopped producer's sentinel put is refused
+            # (_bounded_put), so a consumer already blocked here — or
+            # arriving after close() — would otherwise hang forever.
+            while True:
+                try:
+                    item = self._q.get(timeout=0.2)
+                    break
+                except queue.Empty:
+                    if self._stop.is_set():
+                        raise StopIteration  # feed closed under us
+        if item is self._SENTINEL:
+            self._q.put(item)  # keep raising for later calls
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def _drain(self) -> None:
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def close(self, timeout: float = 2.0) -> bool:
+        """Stop the producer and drop staged batches (their device
+        buffers free when the last consumer reference dies). Returns
+        True when the producer thread actually exited.
+
+        A producer blocked INSIDE the source — a data-service stream's
+        framed-TCP recv, say — cannot be interrupted from here: the
+        stop flag is only checked between batches and in the bounded
+        put. The (daemon) thread then exits at the source's next
+        yield/raise; unblock it by closing the source's transport
+        (stopping the data workers / dispatcher). In that case the
+        thread reference is deliberately KEPT — returning False with
+        the thread observable beats pretending it is gone — and the
+        queue is left empty, so `_bounded_put` (stop flag set) can
+        never park another device batch."""
+        self._stop.set()
+        if self._q is not None:
+            self._drain()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                return False
+            self._thread = None
+        if self._q is not None:
+            self._drain()  # a put that raced the first drain
+        return True

@@ -440,6 +440,22 @@ class DataServiceClient:
                     f"before timeout")
             time.sleep(0.1)
 
+    def device_stream(self, name: str, sharding=None, depth: int = 2,
+                      timeout: float = 60.0):
+        """`stream(name)` through the device-resident double-buffered
+        feed (data/data_loader.DeviceFeed, docs/perf.md): the feed's
+        prefetch thread pulls the next batch off the workers AND stages
+        it onto the device while the current step runs, so the trainer
+        never pays worker latency or the host→device transfer on the
+        critical path, and any residual starvation is measured as
+        perfscope ``input_wait``. Call `.close()` when done (stops the
+        prefetch thread; the underlying worker connections close when
+        the wrapped stream iterator is collected)."""
+        from horovod_tpu.data.data_loader import DeviceFeed
+
+        return DeviceFeed(self.stream(name, timeout=timeout),
+                          sharding=sharding, depth=depth)
+
     def stream(self, name: str, timeout: float = 60.0) -> Iterator[Any]:
         """Yield batches from every worker's shard, round-robin fan-in.
 

@@ -178,14 +178,47 @@ def _fused_conv_bn_site(x, w, p, stats, axis_name, momentum=0.9, eps=1e-5):
     return z, new_stats
 
 
+def _conv_block() -> bool:
+    """Fully fused conv+BN+ReLU block family (ops/conv_block.py,
+    docs/perf.md "conv fast path"): fused forward (stats ride the
+    matmul pass) AND fused masked backward. HOROVOD_CONV_BLOCK=1 opts
+    in; supersedes the backward-only HOROVOD_FUSE_CONV_BN."""
+    from horovod_tpu.ops.conv_block import conv_block_enabled
+    return conv_block_enabled()
+
+
+def _fused_conv_block_site(x, w, p, stats, axis_name, relu,
+                           momentum=0.9, eps=1e-5):
+    """conv1x1 + train-mode BN (+ ReLU) through the fused block op,
+    emitting the same (out, new_stats) contract as
+    _conv + batch_norm (+ jax.nn.relu)."""
+    from horovod_tpu.ops.conv_block import conv1x1_bn_act_nhwc
+
+    z, (mean, var) = conv1x1_bn_act_nhwc(x, w, p["scale"], p["bias"],
+                                         eps, axis_name, relu)
+    new_stats = {"mean": stats["mean"] * momentum + mean * (1 - momentum),
+                 "var": stats["var"] * momentum + var * (1 - momentum)}
+    return z, new_stats
+
+
 def apply(params, stats, x: jax.Array, depth: int = 50, train: bool = True,
           axis_name=None) -> Tuple[jax.Array, Dict]:
     """x: (N, H, W, 3) NHWC. Returns (logits, new_batch_stats)."""
     bn = functools.partial(batch_norm, train=train, axis_name=axis_name)
-    # Train-mode 1x1-conv+BN pairs ride the fused-backward op on TPU
-    # (_fuse_conv_bn); eval mode and 3x3 sites keep the unfused path.
-    fuse = train and _fuse_conv_bn()
-    cbn = functools.partial(_fused_conv_bn_site, axis_name=axis_name)
+    # Train-mode 1x1-conv+BN(+ReLU) triplets ride the fully fused block
+    # op (HOROVOD_CONV_BLOCK) or the fused-backward-only op
+    # (HOROVOD_FUSE_CONV_BN); eval mode and 3x3 sites keep the unfused
+    # path.
+    block = train and _conv_block()
+    fuse = block or (train and _fuse_conv_bn())
+    if block:
+        cbn = functools.partial(_fused_conv_block_site,
+                                axis_name=axis_name, relu=False)
+        cbnr = functools.partial(_fused_conv_block_site,
+                                 axis_name=axis_name, relu=True)
+    else:
+        cbn = functools.partial(_fused_conv_bn_site, axis_name=axis_name)
+        cbnr = None
     new_stats: Dict[str, Any] = {}
     if x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
         h = _stem_conv_s2d(x, params["stem"]["conv"])
@@ -202,12 +235,17 @@ def apply(params, stats, x: jax.Array, depth: int = 50, train: bool = True,
             blk, st = params[name], stats[name]
             stride = 2 if (b == 0 and s > 0) else 1
             ns = {}
-            if fuse and _fused_site_profitable(blk["conv1"]):
+            if block and _fused_site_profitable(blk["conv1"]):
+                # conv1's ReLU folds into the block op — no separate pass
+                y, ns["bn1"] = cbnr(h, blk["conv1"], blk["bn1"],
+                                    st["bn1"])
+            elif fuse and _fused_site_profitable(blk["conv1"]):
                 y, ns["bn1"] = cbn(h, blk["conv1"], blk["bn1"], st["bn1"])
+                y = jax.nn.relu(y)
             else:
                 y = _conv(h, blk["conv1"])
                 y, ns["bn1"] = bn(y, blk["bn1"], st["bn1"])
-            y = jax.nn.relu(y)
+                y = jax.nn.relu(y)
             y = _conv(y, blk["conv2"], stride=stride)
             y, ns["bn2"] = bn(y, blk["bn2"], st["bn2"])
             y = jax.nn.relu(y)
@@ -231,6 +269,53 @@ def apply(params, stats, x: jax.Array, depth: int = 50, train: bool = True,
     h = jnp.mean(h, axis=(1, 2))
     logits = h @ params["fc"]["w"] + params["fc"]["b"]
     return logits, new_stats
+
+
+def conv_stack(depth: int = 50):
+    """One-time declaration of the conv stack for the layout pass
+    (ops/layout.py): every channel-carrying dim of every param/stat
+    array, tagged with the named channel EDGE it rides. Edges that two
+    arrays share (a conv's output channels and its BN vectors; the
+    residual trunk an entire stage adds over) MUST pad together for the
+    padded model to stay exact — declaring the stack once here is what
+    lets the pass guarantee that.
+
+    Edge map: "img" is the 3-channel input (never padded — the growth
+    cap rejects 3→128), "stem" the stem output / stage-0 trunk input,
+    "s{s}" stage s's residual trunk (width*4), "s{s}b{b}.c1"/".c2" the
+    block-internal widths.
+    """
+    from horovod_tpu.ops.layout import Site
+
+    blocks = STAGE_BLOCKS[depth]
+    sites = [Site("stem/conv", {2: "img", 3: "stem"}),
+             Site("stem/bn/scale", {0: "stem"}),
+             Site("stem/bn/bias", {0: "stem"}),
+             Site("stem/mean", {0: "stem"}),
+             Site("stem/var", {0: "stem"})]
+    in_edge = "stem"
+    for s, n in enumerate(blocks):
+        out_edge = f"s{s}"
+        for b in range(n):
+            name = f"s{s}b{b}"
+            c1, c2 = f"{name}.c1", f"{name}.c2"
+            sites += [Site(f"{name}/conv1", {2: in_edge, 3: c1}),
+                      Site(f"{name}/conv2", {2: c1, 3: c2}),
+                      Site(f"{name}/conv3", {2: c2, 3: out_edge})]
+            for bn, edge in (("bn1", c1), ("bn2", c2), ("bn3", out_edge)):
+                sites += [Site(f"{name}/{bn}/scale", {0: edge}),
+                          Site(f"{name}/{bn}/bias", {0: edge}),
+                          Site(f"{name}/{bn}/mean", {0: edge}),
+                          Site(f"{name}/{bn}/var", {0: edge})]
+            if b == 0:
+                sites += [Site(f"{name}/proj", {2: in_edge, 3: out_edge}),
+                          Site(f"{name}/bnp/scale", {0: out_edge}),
+                          Site(f"{name}/bnp/bias", {0: out_edge}),
+                          Site(f"{name}/bnp/mean", {0: out_edge}),
+                          Site(f"{name}/bnp/var", {0: out_edge})]
+            in_edge = out_edge
+    sites.append(Site("fc/w", {0: in_edge}))
+    return sites
 
 
 def loss_fn(params, stats, batch, depth: int = 50, train: bool = True,

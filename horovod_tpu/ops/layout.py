@@ -1,0 +1,277 @@
+"""NHWC-canonical layout pass: channel padding to the MXU lane width.
+
+The static face of the conv-MFU gap (ROADMAP item 1) is hvdhlo rule
+HVD204: a conv/dot channel dim that is not a multiple of the 128-wide
+vector lanes makes the MXU pad every tile up — at ResNet-50's stage-0
+width of 64 that is 50% pure padding FLOPs on every conv touching the
+dim, silently, on every step. This pass applies the fix HVD204's
+finding text prescribes: pad the channel dims to the lane width ONCE,
+in the parameters, so the compiled program only ever sees lane-aligned
+shapes and the padding FLOPs become real FLOPs the MXU was spending
+anyway.
+
+Mechanics
+---------
+
+A model declares its conv stack once (`models/resnet.conv_stack`): every
+channel-carrying dim of every param/stat array, tagged with the named
+channel EDGE it rides. Edges capture the sharing the pass must respect —
+a conv's output channels, its BatchNorm vectors, and the residual trunk
+a whole stage adds over must all pad together or shapes stop lining up.
+`plan()` then decides per edge, using the same thresholds hvdhlo HVD204
+lints with (the 128-lane width, the padding-waste floor):
+
+* pad an edge up to the next lane multiple when its waste is at or
+  above the floor (default: the HVD204 floor) AND the growth stays
+  within ``HOROVOD_LAYOUT_MAX_GROWTH`` (default 2.0 — 64→128 pads,
+  the 3-channel image input's 42x blow-up never does);
+* otherwise leave it as declared.
+
+Zero padding is EXACT for conv+BN+ReLU stacks, forward and backward:
+
+* padded weight columns produce zero activations; BN on an all-zero
+  channel has mean 0 / var 0 (``rsqrt(eps)`` — finite), and zero
+  scale/bias keep the normalized output zero through ReLU and residual
+  adds;
+* padded weight ROWS (input channels) multiply the zero activations, so
+  real outputs are untouched;
+* gradients into padded channels are identically zero (the masked
+  upstream gradient is zero there, and dx through zero weight rows is
+  zero), so SGD/momentum/Adam leave the padding at zero — training
+  never drifts into the padded lanes (pinned by tests/test_layout.py).
+
+``plan.pad(tree)`` rewrites params/activations-stats to the padded-lane
+shapes; ``plan.strip(tree)`` removes the padding at the boundary
+(checkpointing, eval export). `core/autotune.OnlineLayoutTuner` scores
+padded vs as-declared by measured step time and broadcasts rank 0's
+choice, so all ranks agree under the consistency verifier
+(docs/perf.md "conv fast path").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+# The SAME analysis HVD204 lints with (docs/static_analysis.md): one
+# lane-width constant and one waste formula, shared so the pass and the
+# lint can never disagree about what "aligned" means.
+from horovod_tpu.analysis.hlo_rules import (LANE, _min_pad_waste_pct,
+                                            _pad_waste_pct)
+
+LAYOUT_PAD_ENV = "HOROVOD_LAYOUT_PAD"
+LAYOUT_MIN_WASTE_ENV = "HOROVOD_LAYOUT_MIN_WASTE_PCT"
+LAYOUT_MAX_GROWTH_ENV = "HOROVOD_LAYOUT_MAX_GROWTH"
+
+#: The layout modes the autotuner arbitrates between (docs/perf.md).
+AS_DECLARED = "as_declared"
+NHWC_PADDED = "nhwc_padded"
+
+
+def layout_pad_enabled() -> bool:
+    """HOROVOD_LAYOUT_PAD=0 turns plan() into an as-declared no-op."""
+    return os.environ.get(LAYOUT_PAD_ENV, "").strip() not in (
+        "0", "false", "False")
+
+
+def _min_waste_pct() -> float:
+    """Waste floor below which an unaligned edge is left alone —
+    defaults to hvdhlo HVD204's own floor so pass and lint agree."""
+    v = os.environ.get(LAYOUT_MIN_WASTE_ENV, "").strip()
+    try:
+        return float(v) if v else _min_pad_waste_pct()
+    except ValueError:
+        return _min_pad_waste_pct()
+
+
+def _max_growth() -> float:
+    """Cap on padded/original size: 2.0 admits the 64→128 stage-0 pad
+    but rejects padding the 3-channel image input 42x."""
+    v = os.environ.get(LAYOUT_MAX_GROWTH_ENV, "").strip()
+    try:
+        return float(v) if v else 2.0
+    except ValueError:
+        return 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    """One declared array: `path` (slash-separated keys into the nested
+    param/stat dict) and `dims` mapping each channel-carrying dim index
+    to its named edge."""
+
+    path: str
+    dims: Mapping[int, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    """One named channel stream's layout decision."""
+
+    name: str
+    size: int
+    padded: int
+    waste_pct: float  # MXU padding waste of the UNPADDED size
+
+    @property
+    def is_padded(self) -> bool:
+        return self.padded != self.size
+
+
+class LayoutError(ValueError):
+    pass
+
+
+def _get(tree: Any, path: str):
+    node = tree
+    for key in path.split("/"):
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    return node
+
+
+def _set(tree: Dict, path: str, value) -> None:
+    keys = path.split("/")
+    node = tree
+    for key in keys[:-1]:
+        node = node[key]
+    node[keys[-1]] = value
+
+
+def _copy_tree(tree: Any) -> Any:
+    if isinstance(tree, dict):
+        return {k: _copy_tree(v) for k, v in tree.items()}
+    return tree
+
+
+class LayoutPlan:
+    """The per-edge padding decisions for one declared conv stack, and
+    the pad/strip rewrites they imply."""
+
+    def __init__(self, edges: Dict[str, Edge], sites: List[Site]):
+        self.edges = edges
+        self.sites = sites
+
+    @property
+    def mode(self) -> str:
+        return NHWC_PADDED if any(e.is_padded for e in
+                                  self.edges.values()) else AS_DECLARED
+
+    def padded_edges(self) -> Dict[str, Tuple[int, int]]:
+        return {e.name: (e.size, e.padded)
+                for e in self.edges.values() if e.is_padded}
+
+    def _site_pads(self, tree, site: Site, reverse: bool):
+        arr = _get(tree, site.path)
+        if arr is None:
+            return None, None  # site lives in the other tree (stats)
+        pads = []
+        changed = False
+        for d in range(getattr(arr, "ndim", 0)):
+            edge = site.dims.get(d)
+            e = self.edges.get(edge) if edge else None
+            if e is None or not e.is_padded:
+                pads.append((0, 0))
+                continue
+            want, have = (e.size, e.padded) if reverse else (e.padded,
+                                                             e.size)
+            if arr.shape[d] == want:
+                pads.append((0, 0))  # already in the target layout
+                continue
+            if arr.shape[d] != have:
+                raise LayoutError(
+                    f"layout: {site.path} dim {d} is {arr.shape[d]}, "
+                    f"expected {have} (edge {edge!r} "
+                    f"{e.size}->{e.padded})")
+            pads.append((0, want - have))
+            changed = True
+        return arr, (pads if changed else None)
+
+    def pad(self, tree):
+        """Zero-pad every declared array of `tree` to its padded-lane
+        shape (a copy; undeclared leaves are shared). Sites whose path
+        is absent are skipped — one stack declares params AND stats,
+        each pad() call rewrites the tree it was given."""
+        import jax.numpy as jnp
+
+        out = _copy_tree(tree)
+        for site in self.sites:
+            arr, pads = self._site_pads(out, site, reverse=False)
+            if pads is not None:
+                _set(out, site.path, jnp.pad(arr, pads))
+        return out
+
+    def strip(self, tree):
+        """Inverse of pad(): slice every declared array back to its
+        as-declared shape (the boundary rewrite — checkpoints and eval
+        exports must never see padded lanes)."""
+        out = _copy_tree(tree)
+        for site in self.sites:
+            arr, pads = self._site_pads(out, site, reverse=True)
+            if pads is not None:
+                # pads carry (0, want-have) with want < have here: a
+                # negative hi cuts the dim back down to as-declared
+                sl = tuple(slice(0, arr.shape[d] + p[1])
+                           for d, p in enumerate(pads))
+                _set(out, site.path, arr[sl])
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact stamp for bench sections / perf_gate
+        (docs/perf.md): the mode, which edges padded, and the HVD204
+        waste the padding removed."""
+        padded = self.padded_edges()
+        worst = max((e.waste_pct for e in self.edges.values()
+                     if e.is_padded), default=0.0)
+        return {
+            "mode": self.mode,
+            "lane": LANE,
+            "edges": len(self.edges),
+            "padded_edges": {k: list(v) for k, v in sorted(
+                padded.items())},
+            "max_waste_removed_pct": round(worst, 1),
+        }
+
+
+def plan(tree, stack: List[Site], min_waste_pct: Optional[float] = None,
+         max_growth: Optional[float] = None) -> LayoutPlan:
+    """Decide the layout for one declared conv stack against the
+    as-declared `tree` (typically the params; stats sites simply
+    resolve to nothing here and pad along by edge at pad() time).
+
+    HOROVOD_LAYOUT_PAD=0 (or a floor/growth that rejects every edge)
+    yields an AS_DECLARED plan whose pad()/strip() are identity.
+    """
+    floor = _min_waste_pct() if min_waste_pct is None else min_waste_pct
+    growth = _max_growth() if max_growth is None else max_growth
+    enabled = layout_pad_enabled()
+    edges: Dict[str, Edge] = {}
+    for site in stack:
+        arr = _get(tree, site.path)
+        if arr is None:
+            continue
+        for d, edge in site.dims.items():
+            if d >= getattr(arr, "ndim", 0):
+                raise LayoutError(
+                    f"layout: {site.path} has no dim {d} "
+                    f"(shape {getattr(arr, 'shape', None)})")
+            size = arr.shape[d]
+            prev = edges.get(edge)
+            if prev is not None:
+                if prev.size != size:
+                    raise LayoutError(
+                        f"layout: edge {edge!r} declared at two sizes "
+                        f"({prev.size} vs {size} at {site.path})")
+                continue
+            padded = size
+            if enabled and size % LANE:
+                up = -(-size // LANE) * LANE
+                if _pad_waste_pct(size, LANE) >= floor \
+                        and up <= growth * size:
+                    padded = up
+            edges[edge] = Edge(edge, size, padded,
+                               _pad_waste_pct(size, LANE)
+                               if size % LANE else 0.0)
+    return LayoutPlan(edges, list(stack))

@@ -52,6 +52,19 @@ MIN_COVERAGE = 0.9
 
 DEFAULT_TOLERANCE = 1.0  # +-100% band when numeric checks are armed
 
+#: Conv fast path structural contract (docs/perf.md): every conv bench
+#: section must stamp the layout it ran under and the
+#: device-double-buffered input pipeline, so a regression to the
+#: unpadded/synchronous path fails the gate STRUCTURALLY — on any
+#: host — not just numerically on a perf host.
+CONV_SECTIONS = ("resnet50", "resnet101", "inception_v3", "vgg16")
+#: Sections whose declared conv stack the layout pass pads (ResNet's
+#: stage-0 width-64 edges); "as_declared" there means the pass is off.
+PADDED_SECTIONS = ("resnet50", "resnet101")
+#: Acceptance bar for the device-resident feed: measured input_wait
+#: must stay under 5% of the step wall.
+MAX_INPUT_WAIT_FRACTION = 0.05
+
 
 # ----------------------------------------------------------------- emit
 
@@ -215,15 +228,56 @@ def compare(current: dict, baseline: dict, numeric: bool) -> list:
     return errs
 
 
+def _check_conv_section(name: str, val: dict) -> list:
+    """The conv-fast-path structural stamps (docs/perf.md): layout mode
+    (ResNet sections must be lane-padded), the device-double-buffered
+    input pipeline, measured input_wait under the 5% bar, and — when
+    the chip peak was known — an actual MFU number."""
+    errs = []
+    lay = val.get("layout")
+    if not isinstance(lay, dict) or "mode" not in lay:
+        errs.append(f"{name}: layout stamp missing — the conv section "
+                    "no longer reports what layout it measured")
+    elif name in PADDED_SECTIONS and lay.get("mode") != "nhwc_padded":
+        errs.append(f"{name}: layout mode {lay.get('mode')!r} != "
+                    "'nhwc_padded' — the lane-padding pass is off "
+                    "(HOROVOD_LAYOUT_PAD=0 or a plan() regression)")
+    pipe = val.get("input_pipeline")
+    if not isinstance(pipe, dict) or \
+            pipe.get("mode") != "device_double_buffered":
+        errs.append(f"{name}: input_pipeline "
+                    f"{(pipe or {}).get('mode')!r} != "
+                    "'device_double_buffered' — the section regressed "
+                    "to the synchronous host feed")
+    prof = val.get("perfscope")
+    if isinstance(prof, dict) and prof.get("steps"):
+        frac = (prof.get("phase_fractions") or {}).get("input_wait")
+        if frac is not None and frac > MAX_INPUT_WAIT_FRACTION:
+            errs.append(
+                f"{name}: input_wait is {frac:.1%} of the step wall "
+                f"(> {MAX_INPUT_WAIT_FRACTION:.0%}) — the feed is "
+                "starving the step")
+        if prof.get("peak_flops_per_chip") and prof.get("mfu") is None:
+            errs.append(f"{name}: mfu missing from the StepProfile "
+                        "despite a known chip peak — the conv MFU "
+                        "acceptance number is gone")
+    return errs
+
+
 def check_bench(doc: dict) -> list:
     """Structure-check every perfscope-stamped section of a bench.py
-    JSON line (the StepProfile acceptance: phases cover >=90% of wall).
-    Self-contained — no baseline involved."""
+    JSON line (the StepProfile acceptance: phases cover >=90% of wall),
+    plus the conv sections' fast-path stamps. Self-contained — no
+    baseline involved."""
     extra = doc.get("extra") or {}
     errs = []
     found = 0
     for sec, val in sorted(extra.items()):
-        if not isinstance(val, dict) or "perfscope" not in val:
+        if not isinstance(val, dict):
+            continue
+        if sec in CONV_SECTIONS:
+            errs.extend(_check_conv_section(sec, val))
+        if "perfscope" not in val:
             continue
         prof = val["perfscope"]
         if not isinstance(prof, dict) or not prof.get("steps"):

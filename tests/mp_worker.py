@@ -355,6 +355,41 @@ def scenario_bucket_tuner_sync(hvd, rank, size):
     cfg.bucket_autotune = False
 
 
+def scenario_layout_tuner_sync(hvd, rank, size):
+    """Online layout tuner (core/autotune.OnlineLayoutTuner) on 2
+    processes: every rank feeds DELIBERATELY CONTRADICTORY local step
+    timings (rank 0 measures the padded layout faster, every other
+    rank the opposite), and the rank-0-decides+broadcast playoff must
+    still land every rank on rank 0's winner — a layout split would
+    feed differently-shaped programs to the collectives."""
+    import numpy as np
+
+    from horovod_tpu.core.autotune import OnlineLayoutTuner
+    from horovod_tpu.core.topology import raw_state
+
+    cfg = raw_state().config
+    cfg.layout_autotune = True
+    cfg.layout_autotune_interval = 3
+    tuner = OnlineLayoutTuner(cfg)
+    walls = ({"as_declared": 0.2, "nhwc_padded": 0.1} if rank == 0
+             else {"as_declared": 0.1, "nhwc_padded": 0.2})
+    for _ in range(200):
+        if tuner.frozen:
+            break
+        tuner.record_step(walls[tuner.choice])
+        tuner.update()
+    check(tuner.frozen, "layout tuner never froze")
+    check(tuner.choice == "nhwc_padded",
+          f"rank {rank} did not follow rank 0's decision: "
+          f"{tuner.choice}")
+    got = hvd.allgather(
+        np.asarray([[float(tuner.arms.index(tuner.choice))]]),
+        name="layout_tuner_choices")
+    vals = set(float(v) for v in np.asarray(got).ravel())
+    check(len(vals) == 1, f"ranks disagree on the layout: {vals}")
+    cfg.layout_autotune = False
+
+
 def scenario_autotune_sync(hvd, rank, size):
     """Multi-process autotune broadcast path (autotune.py:212-230)."""
     from horovod_tpu.core.autotune import ParameterManager
@@ -486,6 +521,7 @@ SCENARIOS = {
     "grouped": scenario_grouped,
     "bucketed": scenario_bucketed,
     "bucket_tuner_sync": scenario_bucket_tuner_sync,
+    "layout_tuner_sync": scenario_layout_tuner_sync,
     "broadcast": scenario_broadcast,
     "allgather_uneven": scenario_allgather_uneven,
     "alltoall": scenario_alltoall,

@@ -6,8 +6,6 @@ Gradients are checked against jax.grad of the identical forward math —
 the ground truth XLA would compute unfused.
 """
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -203,78 +201,24 @@ def test_kernel_lowers_through_real_tpu_compiler(monkeypatch):
     for a real v5e topology (compile-only client, zero chips) at a
     representative site AND at the VMEM-tightest site that OOM'd during
     development (Cin=512, C=2048 — the resident f32 dW accumulator).
-    Skips where the TPU compile-only client is unavailable."""
+    Probe/skip logic shared with the conv_block suite
+    (tests/tpu_probe.py); skips where the compile-only client is
+    unavailable."""
+    from tpu_probe import compile_kernel_text, tpu_topology
+
     # conftest pins the CPU backend, which flips the kernel to interpret
     # mode — force the real Mosaic lowering for this TPU-target compile
     from horovod_tpu.ops import conv_bn_backward as cbb
     monkeypatch.setattr(cbb, "_interpret", lambda: False)
-    import glob
-    import os
-    cpu_only_host = not (glob.glob("/dev/accel*")
-                         or os.environ.get("TPU_ACCELERATOR_TYPE")
-                         or os.environ.get("TPU_WORKER_HOSTNAMES"))
-    if cpu_only_host:
-        # Without this, libtpu retries the GCP instance-metadata server
-        # 30x per variable (~8 minutes of wall clock on a CPU-only CI
-        # host) before giving up on hostname resolution. Compile-only
-        # needs none of that metadata.
-        monkeypatch.setenv("TPU_SKIP_MDS_QUERY", "1")
-
-    def _env_unavailable(e: Exception) -> bool:
-        s = str(e)
-        return any(m in s for m in (
-            "worker hostname", "TPU_WORKER_HOSTNAMES", "instance metadata",
-            "Failed to fetch", "could not determine TPU", "libtpu"))
-
-    try:
-        from jax.experimental import topologies
-        topo = topologies.get_topology_desc(platform="tpu",
-                                            topology_name="v5e:2x2")
-    except Exception as e:  # pragma: no cover - CI without libtpu
-        pytest.skip(f"TPU compile-only client unavailable: {e}")
+    topo = tpu_topology(monkeypatch)
     from horovod_tpu.ops.conv_bn_backward import conv1x1_bn_bwd_fused
 
-    dev = topo.devices[0]
-    sh = jax.sharding.SingleDeviceSharding(dev)
     for m, cin, c in ((128 * 28 * 28, 128, 512), (6272, 512, 2048)):
         def st(shape, dt=jnp.bfloat16):
-            return jax.ShapeDtypeStruct(shape, dt, sharding=sh)
+            return jax.ShapeDtypeStruct(shape, dt)
         vec = lambda: st((c,), jnp.float32)  # noqa: E731
-        try:
-            txt = jax.jit(conv1x1_bn_bwd_fused).lower(
-                st((m, c)), st((m, c)), st((m, cin)), st((cin, c)),
-                vec(), vec(), vec(), vec(), vec()).compile().as_text()
-        except Exception as e:
-            if "failed to legalize" in str(e):
-                # this image's LOCAL libtpu (compile-only client) lags
-                # the terminal's Mosaic pipeline and can't legalize the
-                # kernel's MLIR at all; the kernel compiles and runs
-                # through the real device path (scripts/bn_conv_bwd_ab).
-                # ONLY this toolchain-mismatch error skips — VMEM OOM or
-                # other real lowering failures must still fail the test.
-                pytest.skip(f"local Mosaic pipeline mismatch: "
-                            f"{str(e).splitlines()[0][:120]}")
-            if cpu_only_host and _env_unavailable(e):
-                # libtpu could not even initialize its compile-only
-                # client (no TPU metadata / unresolvable worker
-                # hostnames): an environment limitation, not a kernel
-                # regression — but only ever skippable where no TPU
-                # could exist.
-                pytest.skip(f"TPU compile-only client unavailable on "
-                            f"CPU-only host: {str(e).splitlines()[0][:120]}")
-            raise
-        # the pallas kernel survives to the scheduled module as a
-        # custom-call named after the op (Mosaic lowering succeeded —
-        # VMEM budgets, dynamic column stores, and accumulators all
-        # passed the real TPU compiler)
-        if not re.search(r"conv1x1_bn_bwd_fused\S* = .* custom-call\(",
-                         txt) and cpu_only_host:
-            # The local (CPU-host) libtpu compiles the kernel but
-            # inlines/renames the custom-call in its scheduled module —
-            # another flavor of the pipeline mismatch above. On a real
-            # TPU host a missing custom-call still fails.
-            pytest.skip("local libtpu scheduled module does not preserve "
-                        "the kernel custom-call name (toolchain "
-                        "mismatch on a CPU-only host)")
-        assert re.search(r"conv1x1_bn_bwd_fused\S* = .* custom-call\(",
-                         txt), (m, cin, c)
+        compile_kernel_text(
+            topo, conv1x1_bn_bwd_fused,
+            (st((m, c)), st((m, c)), st((m, cin)), st((cin, c)),
+             vec(), vec(), vec(), vec(), vec()),
+            "conv1x1_bn_bwd_fused")

@@ -47,6 +47,22 @@ def test_stream_covers_all_shards_exactly_once(service):
     assert got == list(range(12))
 
 
+def test_device_stream_covers_all_shards_on_device(service):
+    """device_stream = stream through the DeviceFeed (docs/perf.md):
+    same coverage contract, batches arrive as device arrays."""
+    import jax
+
+    disp, workers, client, _ = service
+    client.register_dataset("dev", _range_dataset)
+    feed = client.device_stream("dev")
+    got = []
+    for b in feed:
+        assert isinstance(b["x"], jax.Array)
+        got.append(int(b["x"][0]))
+    feed.close()
+    assert sorted(got) == list(range(12))
+
+
 def test_two_clients_same_dataset_distinct_streams(service):
     """Each worker's stream is consumed once; a second dataset name gets
     fresh shard assignment."""

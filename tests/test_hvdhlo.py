@@ -314,6 +314,36 @@ def test_lower_step_unknown_program():
         hlo.lower_step_text("nope")
 
 
+# ---------------------------------- acceptance: --hlo-step resnet_block
+
+def test_hlo_step_resnet_block_clean_when_padded(monkeypatch, capsys):
+    """The `make conv-smoke` gate (ISSUE 12): the C=64 ResNet-block
+    step — the live twin of the hvd204_resnet_block fixture — lowers
+    CLEAN against the checked-in (empty) baseline once the layout pass
+    (ops/layout.py) pads the declared stack to the lane width."""
+    monkeypatch.delenv("HOROVOD_LAYOUT_PAD", raising=False)
+    baseline = os.path.join(os.path.dirname(HERE), "scripts",
+                            "hvdhlo_baseline.json")
+    rc = run_cli(["--hlo-step", "resnet_block", "--baseline", baseline])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_hlo_step_resnet_block_unpadded_trips_hvd204(monkeypatch):
+    """The regression canary both ways: reverting the layout pass
+    (HOROVOD_LAYOUT_PAD=0) resurfaces the width-64 channel dims and
+    HVD204 reports the 50% padding waste — exactly what the checked-in
+    C=64 fixture pins statically, now pinned against the LIVE step
+    program too."""
+    monkeypatch.setenv("HOROVOD_LAYOUT_PAD", "0")
+    text = hlo.lower_step_text("resnet_block")
+    findings = hlo.lint_text(text, path=hlo.step_path("resnet_block"))
+    hvd204 = [f for f in findings if f.rule_id == "HVD204"]
+    assert hvd204, [f.render() for f in findings]
+    assert any("= 64 " in f.message and "50.0%" in f.message
+               for f in hvd204), [f.render() for f in hvd204]
+
+
 # ----------------------------------------------------- bench stamping
 
 def test_bench_scan_timed_stamps_hlo_lint(monkeypatch):

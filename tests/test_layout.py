@@ -1,0 +1,226 @@
+"""Layout pass (ops/layout.py) + online layout tuner
+(core/autotune.OnlineLayoutTuner).
+
+The pass's whole value proposition is EXACTNESS: zero-padding the
+declared conv stack to the 128-lane width must change nothing but the
+shapes — same loss, same (stripped) gradients, padded lanes pinned at
+zero through the backward so training never drifts into them.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.core.autotune import OnlineLayoutTuner
+from horovod_tpu.models import resnet
+from horovod_tpu.ops import layout
+from horovod_tpu.ops.layout import LayoutError, Site
+
+
+def _close(a, b, tol):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    assert np.max(np.abs(a - b)) <= tol * (np.max(np.abs(a)) + 1e-9), \
+        (np.max(np.abs(a - b)), np.max(np.abs(a)))
+
+
+@pytest.fixture
+def mini_resnet():
+    resnet.STAGE_BLOCKS[8] = (1, 1)  # test-only mini depth
+    try:
+        params, stats = resnet.init(jax.random.PRNGKey(0), depth=8,
+                                    num_classes=10)
+        yield params, stats
+    finally:
+        resnet.STAGE_BLOCKS.pop(8, None)
+
+
+def test_plan_pads_stage0_edges_only(mini_resnet):
+    """ResNet's width-64 stage-0 edges (the HVD204 50%-waste shapes)
+    pad to 128; already-aligned trunks (256/512) and the 3-channel
+    image edge (growth cap) stay as declared."""
+    params, _ = mini_resnet
+    plan = layout.plan(params, resnet.conv_stack(8))
+    assert plan.mode == layout.NHWC_PADDED
+    padded = plan.padded_edges()
+    assert padded["stem"] == (64, 128)
+    assert padded["s0b0.c1"] == (64, 128)
+    assert all(orig == 64 for orig, _ in padded.values())
+    assert "img" not in padded      # 3→128 rejected by the growth cap
+    assert "s0" not in padded       # 256 already aligned
+    assert plan.edges["img"].padded == 3
+
+
+def test_pad_strip_roundtrip_exact(mini_resnet):
+    params, stats = mini_resnet
+    plan = layout.plan(params, resnet.conv_stack(8))
+    for tree in (params, stats):
+        rt = plan.strip(plan.pad(tree))
+        for a, b in zip(jax.tree_util.tree_leaves(rt),
+                        jax.tree_util.tree_leaves(tree)):
+            assert a.shape == b.shape
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_padded_model_is_exact(mini_resnet):
+    """Loss and (stripped) gradients of the padded model match the
+    as-declared model, and gradients into the padded lanes are
+    identically zero — the optimizer can never drift into them."""
+    params, stats = mini_resnet
+    plan = layout.plan(params, resnet.conv_stack(8))
+    pp, ps = plan.pad(params), plan.pad(stats)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3),
+                          jnp.float32)
+    yl = jax.random.randint(jax.random.PRNGKey(2), (2,), 0, 10)
+
+    def loss(p, s):
+        return resnet.loss_fn(p, s, (x, yl), depth=8)[0]
+
+    l0, l1 = loss(params, stats), loss(pp, ps)
+    assert abs(float(l0) - float(l1)) < 1e-5
+    g0 = jax.grad(loss)(params, stats)
+    g1 = jax.grad(loss)(pp, ps)
+    key = lambda kv: jax.tree_util.keystr(kv[0])  # noqa: E731
+    stripped = sorted(jax.tree_util.tree_leaves_with_path(
+        plan.strip(g1)), key=key)
+    for (ka, a), (_, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(g0), key=key),
+            stripped):
+        assert a.shape == b.shape, ka
+        _close(a, b, 5e-5)
+    gc1 = np.asarray(g1["s0b0"]["conv1"])
+    assert np.abs(gc1[:, :, :, 64:]).max() == 0.0  # padded out lanes
+    assert np.abs(gc1[:, :, 64:, :]).max() == 0.0  # padded in lanes
+
+
+def test_disabled_by_env(mini_resnet, monkeypatch):
+    monkeypatch.setenv("HOROVOD_LAYOUT_PAD", "0")
+    params, _ = mini_resnet
+    plan = layout.plan(params, resnet.conv_stack(8))
+    assert plan.mode == layout.AS_DECLARED
+    assert not plan.padded_edges()
+    pad = plan.pad(params)
+    for a, b in zip(jax.tree_util.tree_leaves(pad),
+                    jax.tree_util.tree_leaves(params)):
+        assert a.shape == b.shape
+
+
+def test_waste_floor_and_growth_cap():
+    """An edge under the waste floor stays unpadded (1000 classes at
+    2.3% waste); the growth cap rejects tiny dims (3→128)."""
+    tree = {"a": jnp.zeros((1000, 16)), "b": jnp.zeros((3, 16))}
+    stack = [Site("a", {0: "cls"}), Site("b", {0: "img"})]
+    plan = layout.plan(tree, stack)
+    assert not plan.padded_edges()
+    # floor lowered: 1000 (2.3% waste) now pads; 3 still growth-capped
+    plan = layout.plan(tree, stack, min_waste_pct=1.0)
+    assert plan.padded_edges() == {"cls": (1000, 1024)}
+
+
+def test_edge_size_conflict_raises():
+    tree = {"a": jnp.zeros((64, 8)), "b": jnp.zeros((96, 8))}
+    stack = [Site("a", {0: "e"}), Site("b", {0: "e"})]
+    with pytest.raises(LayoutError, match="two sizes"):
+        layout.plan(tree, stack)
+
+
+def test_pad_rejects_unexpected_shape(mini_resnet):
+    """pad() on a tree whose declared array is neither as-declared nor
+    already-padded is a hard error, not silent corruption."""
+    params, _ = mini_resnet
+    plan = layout.plan(params, resnet.conv_stack(8))
+    bad = plan.pad(params)
+    bad["s0b0"]["conv1"] = jnp.zeros((1, 1, 100, 100))
+    with pytest.raises(LayoutError, match="dim"):
+        plan.pad(bad)
+
+
+def test_pad_is_idempotent(mini_resnet):
+    """pad() of an already-padded tree is a no-op (shapes recognized as
+    the target layout) — elastic restarts can re-enter the pass."""
+    params, _ = mini_resnet
+    plan = layout.plan(params, resnet.conv_stack(8))
+    once = plan.pad(params)
+    twice = plan.pad(once)
+    for a, b in zip(jax.tree_util.tree_leaves(once),
+                    jax.tree_util.tree_leaves(twice)):
+        assert a.shape == b.shape
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_summary_stamp(mini_resnet):
+    params, _ = mini_resnet
+    s = layout.plan(params, resnet.conv_stack(8)).summary()
+    assert s["mode"] == "nhwc_padded"
+    assert s["lane"] == 128
+    assert s["max_waste_removed_pct"] == 50.0
+    assert s["padded_edges"]["stem"] == [64, 128]
+
+
+# ---------------------------------------------------------------- tuner
+
+def _tuner(interval=3, arms=("as_declared", "nhwc_padded")):
+    cfg = dataclasses.replace(Config(), layout_autotune=True,
+                              layout_autotune_interval=interval)
+    return OnlineLayoutTuner(cfg, arms=arms)
+
+
+def _drive(t, walls, max_steps=200):
+    """Feed per-arm wall times until the tuner freezes; returns the
+    steps at which update() reported an arm change."""
+    changes = []
+    for step in range(max_steps):
+        if t.frozen:
+            break
+        t.record_step(walls[t.choice])
+        if t.update():
+            changes.append((step, t.choice))
+    return changes
+
+
+def test_layout_tuner_picks_faster_arm():
+    t = _tuner()
+    changes = _drive(t, {"as_declared": 0.2, "nhwc_padded": 0.1})
+    assert t.frozen
+    assert t.choice == "nhwc_padded"
+    assert t.result["winner"] == "nhwc_padded"
+    # one swap into the second arm's window; the playoff kept it
+    assert [c for _, c in changes] == ["nhwc_padded"]
+
+
+def test_layout_tuner_reverts_to_declared_when_padding_loses():
+    t = _tuner()
+    changes = _drive(t, {"as_declared": 0.1, "nhwc_padded": 0.2})
+    assert t.frozen and t.choice == "as_declared"
+    # swap in, measure, swap back: the final update reports the change
+    assert [c for _, c in changes] == ["nhwc_padded", "as_declared"]
+
+
+def test_layout_tuner_discards_recompile_steps():
+    """The first steps of every arm window are discarded — a recompile
+    spike on the new arm's first step must not bias the playoff."""
+    t = _tuner()
+    seen = {"as_declared": 0, "nhwc_padded": 0}
+    for _ in range(200):
+        if t.frozen:
+            break
+        seen[t.choice] += 1
+        # recompile spike on the first step after every swap
+        spike = 50.0 if seen[t.choice] <= 1 else None
+        t.record_step(spike if spike else
+                      (0.2 if t.choice == "as_declared" else 0.1))
+        t.update()
+    assert t.frozen and t.choice == "nhwc_padded"
+    assert t.result["mean_step_s"]["nhwc_padded"] == pytest.approx(0.1)
+
+
+def test_layout_tuner_disabled_is_inert():
+    cfg = dataclasses.replace(Config(), layout_autotune=False)
+    t = OnlineLayoutTuner(cfg)
+    assert t.frozen
+    t.record_step(1.0)
+    assert not t.update()
+    assert t.choice == "as_declared"

@@ -70,6 +70,16 @@ def test_bucket_tuner_threshold_sync(tmp_path):
         assert f"MP_WORKER_OK bucket_tuner_sync rank={rank}" in text
 
 
+def test_layout_tuner_choice_sync(tmp_path):
+    """ISSUE 12: the online layout tuner's playoff is rank-0-decides +
+    broadcast — ranks fed contradictory local timings still freeze on
+    ONE layout (a split would feed differently-shaped programs to the
+    collectives)."""
+    text = run_scenarios(2, "layout_tuner_sync", tmp_path)
+    for rank in range(2):
+        assert f"MP_WORKER_OK layout_tuner_sync rank={rank}" in text
+
+
 def test_worker_failure_propagates(tmp_path):
     """A worker that dies must fail the whole launch with its exit code
     (reference: gloo_run terminates all workers when one fails)."""

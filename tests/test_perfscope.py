@@ -539,11 +539,69 @@ def test_perf_gate_checked_in_baseline_is_valid(fresh):
         assert spec["require_phases"]
 
 
+def _conv_stamps(mode="nhwc_padded"):
+    """The conv-fast-path stamps bench sections carry (docs/perf.md)."""
+    return {"layout": {"mode": mode},
+            "input_pipeline": {"mode": "device_double_buffered",
+                               "depth": 2}}
+
+
 def test_perf_gate_bench_mode(fresh):
-    doc = {"extra": {"resnet50": {"perfscope": _gate_profile()},
+    doc = {"extra": {"resnet50": {"perfscope": _gate_profile(),
+                                  **_conv_stamps()},
                      "vgg16": None, "autotune": {"frozen": True}}}
     assert perf_gate.check_bench(doc) == []
     assert perf_gate.check_bench({"extra": {}})  # nothing stamped
+
+
+def test_perf_gate_conv_section_requires_stamps(fresh):
+    """ISSUE 12 satellite: a conv section without the layout /
+    input_pipeline stamps fails the gate STRUCTURALLY."""
+    doc = {"extra": {"resnet50": {"perfscope": _gate_profile()}}}
+    errs = perf_gate.check_bench(doc)
+    assert any("layout stamp missing" in e for e in errs)
+    assert any("input_pipeline" in e for e in errs)
+    # non-conv sections carry no such obligation
+    doc = {"extra": {"transformer_lm": {"perfscope": _gate_profile()}}}
+    assert perf_gate.check_bench(doc) == []
+
+
+def test_perf_gate_conv_section_unpadded_resnet_fails(fresh):
+    """A ResNet section measured under the as-declared (unpadded)
+    layout is a structural regression; inception may legitimately run
+    as-declared (no conv_stack declaration yet)."""
+    doc = {"extra": {"resnet50": {"perfscope": _gate_profile(),
+                                  **_conv_stamps("as_declared")}}}
+    errs = perf_gate.check_bench(doc)
+    assert any("nhwc_padded" in e for e in errs)
+    doc = {"extra": {"inception_v3": {"perfscope": _gate_profile(),
+                                      **_conv_stamps("as_declared")}}}
+    assert perf_gate.check_bench(doc) == []
+
+
+def test_perf_gate_conv_section_input_wait_bar(fresh):
+    """Measured input_wait above 5% of the step wall fails — the
+    device-resident pipeline acceptance (docs/perf.md)."""
+    prof = _gate_profile()
+    prof["phase_fractions"] = {"input_wait": 0.2}
+    doc = {"extra": {"resnet50": {"perfscope": prof, **_conv_stamps()}}}
+    errs = perf_gate.check_bench(doc)
+    assert any("starving" in e for e in errs)
+    prof["phase_fractions"] = {"input_wait": 0.01}
+    assert perf_gate.check_bench(doc) == []
+
+
+def test_perf_gate_conv_section_mfu_presence(fresh):
+    """With a known chip peak the StepProfile must carry an actual
+    `mfu` number (the conv-MFU acceptance metric); without a peak
+    (CPU hosts) its absence is fine."""
+    prof = _gate_profile()
+    prof["peak_flops_per_chip"] = 197e12
+    doc = {"extra": {"vgg16": {"perfscope": prof, **_conv_stamps()}}}
+    errs = perf_gate.check_bench(doc)
+    assert any("mfu missing" in e for e in errs)
+    prof["mfu"] = 0.41
+    assert perf_gate.check_bench(doc) == []
 
 
 # ------------------------------------------------------------- flops
