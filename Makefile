@@ -46,6 +46,15 @@
 #                    host-sync / donation / padding / upcast) against
 #                    scripts/hvdhlo_baseline.json — the regression guard
 #                    that keeps ops/fusion.py reverts out of the HLO
+#   make shard-lint  hvdshard static sharding & per-device memory lint
+#                    (docs/static_analysis.md): the HVD3xx fixture/
+#                    liveness unit suite, then the canonical 2-D
+#                    (batch x model) mesh LM step lowered pre- AND
+#                    post-SPMD under a 1 GiB per-device HBM budget,
+#                    gated against scripts/hvdshard_baseline.json —
+#                    the static gate in front of the GSPMD backend
+#                    (replicated tables, partitioner-inserted
+#                    resharding, compile-time OOM)
 #   make race        hvdrace: the concurrency/hammer suites (timeline,
 #                    metrics, elastic driver, rendezvous KV, verifier)
 #                    run under the runtime lockset race detector
@@ -57,9 +66,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline metrics race doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline metrics race doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate
 
-test: lint hlo-lint test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate entry
+test: lint hlo-lint shard-lint test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -165,6 +174,29 @@ hlo-lint-baseline:
 	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm \
 	    --format json > scripts/hvdhlo_baseline.json || true
+
+# hvdshard static sharding & per-device memory lint
+# (docs/static_analysis.md): the fixture/liveness unit suite pins every
+# HVD3xx rule both ways (incl. the replicated-twin acceptance: forced
+# fully-replicated params trip HVD301+HVD302 on CPU CI), then the
+# canonical 2-D-mesh LM step is lowered pre- and post-SPMD and gated
+# against the checked-in EMPTY baseline. The 1 GiB budget arms HVD303:
+# the canonical program's static per-device peak is ~25 MB — a 40x
+# regression margin before the compile-time OOM gate trips.
+shard-lint:
+	$(PYTEST) tests/test_hvdshard.py
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    HOROVOD_HLO_LINT_HBM_BUDGET=1G \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_sharded \
+	    --baseline scripts/hvdshard_baseline.json
+
+shard-lint-baseline:
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    HOROVOD_HLO_LINT_HBM_BUDGET=1G \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_sharded \
+	    --format json > scripts/hvdshard_baseline.json || true
 
 # The warm-compile-cache test is a wall-clock subprocess benchmark, not
 # a concurrency test — load-sensitive, and none of its work runs through

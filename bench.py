@@ -68,9 +68,60 @@ def _hlo_lint_lowered(lowered):
         return {}
 
 
+def _memory_stamp(compiled):
+    """Per-section `memory` stamp (docs/perf.md): the static per-device
+    peak-HBM estimate from the section's already-compiled program
+    (analysis/shard.py donation-aware liveness over the post-opt
+    schedule) next to the live ``device.memory_stats()`` actuals, plus
+    their ratio. scripts/perf_gate.py structurally requires this stamp
+    and fails any section whose estimate exceeds the chip budget.
+    Returns {} on any analysis failure — a diagnostic, never a
+    bench-killer."""
+    try:
+        from horovod_tpu.analysis import shard
+        est = shard.estimate_compiled_text(compiled.as_text())
+    except Exception:
+        return {}
+    if est is None:
+        return {}
+    out = {
+        "static_peak_device_bytes": est.peak_bytes,
+        "static_peak_device_mb": round(est.peak_bytes / 2**20, 2),
+        "args_mb": round(est.args_bytes / 2**20, 2),
+        "donated_mb": round(est.donated_bytes / 2**20, 2),
+        "model": "donation-aware liveness over the post-opt schedule "
+                 "(analysis/shard.py)",
+    }
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        measured = (stats.get("peak_bytes_in_use")
+                    or stats.get("bytes_in_use"))
+        if measured:
+            out["measured_peak_device_bytes"] = int(measured)
+            out["measured_peak_device_mb"] = round(measured / 2**20, 2)
+            # >1: the estimate overshoots the device's observed peak
+            # (safe side); <1: other live programs/arenas dominate.
+            out["static_vs_measured_ratio"] = round(
+                est.peak_bytes / measured, 3)
+    except Exception:
+        pass  # CPU devices expose no memory_stats
+    try:
+        from horovod_tpu.analysis.shard_rules import hbm_budget_bytes
+    except Exception:
+        return out
+    # NOT exception-guarded: a malformed HOROVOD_HLO_LINT_HBM_BUDGET /
+    # HOROVOD_BENCH_HBM_GB raises by design — swallowing it would
+    # silently disarm the budget gate in exactly the runs that set it.
+    budget = hbm_budget_bytes() or F.hbm_bytes_per_chip()
+    if budget:
+        out["hbm_budget_bytes"] = budget
+        out["within_budget"] = est.peak_bytes <= budget
+    return out
+
+
 def _scan_timed(local_body, state, chain, reps, warmup=2,
                 flops_out=None, profile_out=None, profile_steps=3,
-                hlo_out=None):
+                hlo_out=None, mem_out=None):
     """Time `chain` training steps chained inside ONE compiled program
     (lax.scan), returning seconds per step via a latency-cancelling slope.
 
@@ -115,6 +166,7 @@ def _scan_timed(local_body, state, chain, reps, warmup=2,
         hlo_out.update(_hlo_lint_lowered(lowered))
     if lowered is not None and flops_out is not None \
             and F.xla_flops_enabled():
+        compiled = None
         try:
             compiled = lowered.compile()
             total = F.compiled_cost_flops(compiled)
@@ -123,7 +175,15 @@ def _scan_timed(local_body, state, chain, reps, warmup=2,
                 flops_out["source"] = "xla"
             body = compiled  # reuse: one compile for analysis AND timing
         except Exception:
+            compiled = None
             body = jbody  # AOT path unavailable: timing still works
+        if compiled is not None and mem_out is not None:
+            # Free off the compile the cost analysis already paid for —
+            # same executable that gets timed below. OUTSIDE the AOT
+            # try: a malformed budget knob must raise loudly (its
+            # design), not silently demote the section to the non-AOT
+            # body after mfu_source="xla" was already recorded.
+            mem_out.update(_memory_stamp(compiled))
 
     def sync(s):
         # block + read back a DERIVED SCALAR of the first leaf: the tiny
@@ -180,7 +240,7 @@ def _scan_timed(local_body, state, chain, reps, warmup=2,
 
 
 def _perf_stamp(r, name, flops_info, prof, fallback_flops_per_step,
-                hlo_info=None):
+                hlo_info=None, mem_info=None):
     """Attach the section's StepProfile (docs/perf.md) to its result
     dict: per-step wall percentiles, the perfscope phase breakdown, and
     MFU with its source — "xla" when the FLOPs came from cost analysis
@@ -215,6 +275,8 @@ def _perf_stamp(r, name, flops_info, prof, fallback_flops_per_step,
     r["mfu_source"] = source
     if hlo_info:
         r["hlo_lint"] = hlo_info
+    if mem_info:
+        r["memory"] = mem_info
     if wall:
         r["step_time_percentiles_ms"] = {
             k: round(wall[f"{k}_s"] * 1e3, 2)
@@ -339,11 +401,11 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
 
     state = (params, stats, opt_state, images, labels, jnp.zeros(()))
     chain = max(steps // 3, 1)
-    flops_info, prof, hlo_info = {}, {}, {}
+    flops_info, prof, hlo_info, mem_info = {}, {}, {}, {}
     sec_per_step = _scan_timed(body, state, chain=chain,
                                reps=3, warmup=max(warmup // 2, 1),
                                flops_out=flops_info, profile_out=prof,
-                               hlo_out=hlo_info)
+                               hlo_out=hlo_info, mem_out=mem_info)
 
     ips = batch / sec_per_step
     # Training FLOPs ≈ 3× forward. MAC convention (flops.py) — the
@@ -367,7 +429,7 @@ def bench_resnet(mesh, k, on_cpu, per_chip_batch, steps, warmup, depth=50):
         r, f"resnet{depth}", flops_info, prof,
         None if on_cpu else
         F.resnet_train_flops_per_image(depth, "flops") * per_chip_batch,
-        hlo_info=hlo_info)
+        hlo_info=hlo_info, mem_info=mem_info)
 
 
 def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
@@ -412,10 +474,11 @@ def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
         return (p, s, o, im, lb, l)
 
     state = (params, stats, opt_state, images, labels, jnp.zeros(()))
-    flops_info, prof, hlo_info = {}, {}, {}
+    flops_info, prof, hlo_info, mem_info = {}, {}, {}, {}
     sec = _scan_timed(body, state, chain=max(steps // 3, 1), reps=3,
                       warmup=warmup, flops_out=flops_info,
-                      profile_out=prof, hlo_out=hlo_info)
+                      profile_out=prof, hlo_out=hlo_info,
+                      mem_out=mem_info)
     # Inception V3 fwd @299 ≈ 5.73 GMAC/img (torchvision convention,
     # flops.py) → training step ≈ 3×.
     r = {"images_per_sec_per_chip": round(b / sec, 2),
@@ -433,7 +496,7 @@ def bench_inception(mesh, k, on_cpu, steps=12, warmup=2):
         r, "inception_v3", flops_info, prof,
         None if on_cpu else
         F.inception_v3_train_flops_per_image("flops") * b,
-        hlo_info=hlo_info)
+        hlo_info=hlo_info, mem_info=mem_info)
 
 
 # --------------------------------------------------------------------------
@@ -537,10 +600,11 @@ def bench_vgg16(mesh, k, steps=12, warmup=2):
         return (p, o, im, lb, l)
 
     state = (params, opt_state, images, labels, jnp.zeros(()))
-    flops_info, prof, hlo_info = {}, {}, {}
+    flops_info, prof, hlo_info, mem_info = {}, {}, {}, {}
     sec = _scan_timed(body, state, chain=max(steps // 3, 1), reps=3,
                       warmup=warmup, flops_out=flops_info,
-                      profile_out=prof, hlo_out=hlo_info)
+                      profile_out=prof, hlo_out=hlo_info,
+                      mem_out=mem_info)
     # VGG-16 fwd @224 ≈ 15.5 GMAC/img (flops.py) → train ≈ 3×.
     r = {"images_per_sec_per_chip": round(b / sec, 2),
          "per_chip_batch": b, "image_size": img,
@@ -553,7 +617,7 @@ def bench_vgg16(mesh, k, steps=12, warmup=2):
          "input_pipeline": feed_stamp}
     return _perf_stamp(r, "vgg16", flops_info, prof,
                        F.vgg16_train_flops_per_image("flops") * b,
-                       hlo_info=hlo_info)
+                       hlo_info=hlo_info, mem_info=mem_info)
 
 
 def bench_transformer(on_cpu, steps, warmup):
@@ -589,10 +653,11 @@ def bench_transformer(on_cpu, steps, warmup):
 
     state = (params, opt_state, tokens, targets, jnp.zeros(()))
     chain = max(steps // 3, 1)
-    flops_info, prof, hlo_info = {}, {}, {}
+    flops_info, prof, hlo_info, mem_info = {}, {}, {}, {}
     sec = _scan_timed(body, state, chain=chain, reps=3,
                       warmup=max(warmup // 2, 1), flops_out=flops_info,
-                      profile_out=prof, hlo_out=hlo_info)
+                      profile_out=prof, hlo_out=hlo_info,
+                      mem_out=mem_info)
     dt, steps = sec * steps, steps  # keep downstream arithmetic unchanged
 
     # Analytical model FLOPs: the standard 6N + attention accounting
@@ -613,7 +678,8 @@ def bench_transformer(on_cpu, steps, warmup):
             cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab) / 1e6, 1),
     }
     return _perf_stamp(r, "transformer_lm", flops_info, prof,
-                       flops_tok * toks, hlo_info=hlo_info)
+                       flops_tok * toks, hlo_info=hlo_info,
+                       mem_info=mem_info)
 
 
 def _slope_ms(run, k, reps=2):
@@ -688,22 +754,28 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
         return opt.step(g, params, state)[1], l
 
     out = {}
-    # Model FLOPs for the StepProfile: XLA cost analysis of the jitted
-    # fwd+bwd when available (one extra compile of a small program),
-    # else the analytic 6N fallback.
-    xla_flops = F.jit_cost_flops(grad_fn, params) \
-        if F.xla_flops_enabled() else None
-    # hvdhlo stamp for the eager migration path: lint the fwd+bwd
-    # program (the part that lowers here; the allreduce rides the eager
-    # collective engine, covered by the SPMD sections' stamps). The
-    # enabled check comes FIRST — lowering BERT fwd+bwd just to throw
-    # it away under HOROVOD_HLO_LINT=0 would defeat the knob.
-    hlo_info = {}
-    if _hlo_lint_enabled():
+    # ONE AOT lower+compile of the jitted fwd+bwd feeds all three
+    # stamps: the XLA cost-analysis FLOPs for the StepProfile, the
+    # hvdhlo lint of the eager migration path (the allreduce rides the
+    # eager collective engine, covered by the SPMD sections' stamps),
+    # and the static peak-HBM memory stamp. The enabled checks come
+    # FIRST — lowering BERT fwd+bwd just to throw it away under
+    # HOROVOD_HLO_LINT=0 + XLA-flops-off would defeat both knobs.
+    xla_flops = None
+    hlo_info, mem_info = {}, {}
+    compiled = None
+    if F.xla_flops_enabled() or _hlo_lint_enabled():
         try:
-            hlo_info = _hlo_lint_lowered(grad_fn.lower(params))
+            lowered = grad_fn.lower(params)
+            if _hlo_lint_enabled():
+                hlo_info = _hlo_lint_lowered(lowered)
+            if F.xla_flops_enabled():
+                compiled = lowered.compile()
+                xla_flops = F.compiled_cost_flops(compiled)
         except Exception:
             pass
+    if compiled is not None:
+        mem_info = _memory_stamp(compiled)
     fallback_flops = F.transformer_train_flops_per_token(
         cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab, seq) * batch * seq
     for name, opt in (("adasum", dist_opt), ("predivide", pre_opt)):
@@ -749,7 +821,8 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
             _perf_stamp(out, "bert_base_finetune",
                         {"program_flops_per_step": xla_flops}
                         if xla_flops else {},
-                        prof, fallback_flops, hlo_info=hlo_info)
+                        prof, fallback_flops, hlo_info=hlo_info,
+                        mem_info=mem_info)
     out["config"] = f"L{cfg.n_layers} D{cfg.d_model} H{cfg.n_heads} " \
                     f"S{seq} B{batch} (BERT-base shape)"
     return out

@@ -26,6 +26,20 @@ package moves that detection LEFT of the job launch:
   declared lock held — including stale annotations whose lock is never
   held at all.
 
+* ``hlo`` / ``hlo_rules`` (**hvdhlo**, ``--hlo`` / ``--hlo-step`` /
+  ``make hlo-lint``) lint the *lowered* XLA step program (HVD2xx:
+  giant-allreduce plans, host round-trips, missing donation, lane
+  padding, bf16 upcasts) — perf contracts invisible to an AST linter.
+
+* ``shard`` / ``shard_rules`` (**hvdshard**, ``--shard`` /
+  ``--hlo-step lm_sharded`` / ``make shard-lint``) are the
+  sharding-aware layer over the same lowered forms (HVD3xx):
+  replicated tables, partitioner-inserted resharding collectives, a
+  donation-aware static per-device peak-HBM estimate gating
+  compile-time OOM, unused mesh axes, and
+  all-reduce-that-should-be-reduce-scatter — the static gate in front
+  of the GSPMD backend (ROADMAP item 3).
+
 * ``verifier`` is the runtime companion (``HOROVOD_CHECK_COLLECTIVES=1``):
   each rank hashes its rolling sequence of
   ``(op, name, shape, dtype, process_set)`` tuples at the dispatch choke

@@ -320,13 +320,22 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                         help="hvdhlo mode: treat paths as lowered "
                              "StableHLO/HLO text dumps and run the "
                              "HVD2xx rules over the program structure")
+    parser.add_argument("--shard", action="store_true",
+                        help="hvdshard mode: treat paths as lowered "
+                             "StableHLO/post-SPMD HLO dumps and run "
+                             "the HVD3xx sharding/memory rules; "
+                             "combine with --hlo to run both families "
+                             "over the same dumps")
     parser.add_argument("--hlo-step", default=None, metavar="PROGRAM",
-                        choices=("lm", "resnet_block"),
+                        choices=("lm", "resnet_block", "lm_sharded"),
                         help="hvdhlo mode: lower the named canonical "
                              "step program under the current fusion/"
                              "layout config on the virtual CPU mesh "
                              "and lint it (the `make hlo-lint` / "
-                             "`make conv-smoke` CI gates)")
+                             "`make conv-smoke` / `make shard-lint` "
+                             "CI gates); lm_sharded lints the 2-D "
+                             "(batch x model) mesh program under BOTH "
+                             "rule families, pre- and post-SPMD")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule IDs to run (default all)")
     parser.add_argument("--ignore", default="",
@@ -350,17 +359,19 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.list_rules:
         from horovod_tpu.analysis import env_rule as env_mod
-        from horovod_tpu.analysis import hlo_rules
+        from horovod_tpu.analysis import hlo_rules, shard_rules
         reg = dict(registry())
         reg[env_mod.RULE_ID] = (env_mod.DESCRIPTION, None)
         reg[HVD000] = ("suppression comment lacks a rationale", None)
         for rule_id, (desc, _check) in hlo_rules.RULES.items():
             reg[rule_id] = (f"[--hlo] {desc}", None)
+        for rule_id, (desc, _check) in shard_rules.RULES.items():
+            reg[rule_id] = (f"[--shard] {desc}", None)
         for rule_id in sorted(reg):
             print(f"{rule_id}  {reg[rule_id][0]}")
         return 0
 
-    hlo_mode = args.hlo or args.hlo_step is not None
+    hlo_mode = args.hlo or args.shard or args.hlo_step is not None
     if not args.paths and not args.hlo_step:
         parser.error("no paths given (try: horovod_tpu/ examples/)")
 
@@ -374,26 +385,72 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
     ignore = [s.strip() for s in args.ignore.split(",") if s.strip()]
     if hlo_mode:
         from horovod_tpu.analysis import hlo as hlo_mod
-        findings = hlo_mod.lint_files(args.paths, select=select,
-                                      ignore=ignore)
-        if args.hlo_step is not None:
-            # Lowering failures must fail the gate loudly — a CI host
-            # that cannot build the step program is not a clean lint.
-            try:
-                text = hlo_mod.lower_step_text(args.hlo_step)
-            except Exception as e:
-                print(f"hvdhlo: cannot lower step program "
-                      f"{args.hlo_step!r}: {e}", file=sys.stderr)
-                return 2
-            findings.extend(hlo_mod.lint_text(
-                text, path=hlo_mod.step_path(args.hlo_step),
-                select=select, ignore=ignore))
-            findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+        from horovod_tpu.analysis import shard as shard_mod
+        findings = []
+        try:
+            # File mode: --hlo runs HVD2xx, --shard runs HVD3xx, both
+            # flags run both families over the same dumps. A bare
+            # --hlo-step adds no file findings (paths empty).
+            if args.hlo or (args.paths and not args.shard):
+                findings.extend(hlo_mod.lint_files(
+                    args.paths, select=select, ignore=ignore))
+            if args.shard:
+                findings.extend(shard_mod.lint_files(
+                    args.paths, select=select, ignore=ignore))
+            if args.hlo and args.shard:
+                findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+            if args.hlo_step == "lm_sharded":
+                # The 2-D-mesh gate lints BOTH textual forms: the
+                # HVD2xx program rules on the pre-partition MLIR
+                # (global shapes) and the HVD3xx sharding/memory rules
+                # on both it and the post-SPMD module (per-device
+                # shapes + schedule).
+                try:
+                    texts = shard_mod.lower_sharded_step_texts()
+                except Exception as e:
+                    print(f"hvdshard: cannot lower step program "
+                          f"'lm_sharded': {e}", file=sys.stderr)
+                    return 2
+                base = hlo_mod.step_path("lm_sharded")
+                findings.extend(hlo_mod.lint_text(
+                    texts["stablehlo"], path=base,
+                    select=select, ignore=ignore))
+                for fmt, suffix in (("stablehlo", ""), ("hlo", ":spmd")):
+                    findings.extend(shard_mod.lint_text(
+                        texts[fmt], path=base[:-1] + suffix + ">",
+                        select=select, ignore=ignore))
+                findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+            elif args.hlo_step is not None:
+                # Lowering failures must fail the gate loudly — a CI
+                # host that cannot build the step program is not a
+                # clean lint.
+                try:
+                    text = hlo_mod.lower_step_text(args.hlo_step)
+                except Exception as e:
+                    print(f"hvdhlo: cannot lower step program "
+                          f"{args.hlo_step!r}: {e}", file=sys.stderr)
+                    return 2
+                findings.extend(hlo_mod.lint_text(
+                    text, path=hlo_mod.step_path(args.hlo_step),
+                    select=select, ignore=ignore))
+                findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+        except ValueError as e:
+            # A malformed knob (HOROVOD_HLO_LINT_HBM_BUDGET=16GiB)
+            # raises by design — but it is a TOOL error, not findings:
+            # the driver's error convention is one line + exit 2
+            # (lowering failures, unreadable baselines), never a
+            # traceback that exits 1 as if findings were found.
+            name = ("hvdshard" if args.shard
+                    or args.hlo_step == "lm_sharded" else "hvdhlo")
+            print(f"{name}: {e}", file=sys.stderr)
+            return 2
     else:
         findings = lint_paths(args.paths, select=select, ignore=ignore,
                               root=root, env_rule=not args.no_env)
     matched = 0
-    name = "hvdhlo" if hlo_mode else "hvdlint"
+    shard_mode = args.shard or args.hlo_step == "lm_sharded"
+    name = ("hvdshard" if shard_mode
+            else "hvdhlo" if hlo_mode else "hvdlint")
     if args.baseline is not None:
         try:
             baseline = load_baseline(args.baseline)
@@ -405,7 +462,14 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
         findings, matched = apply_baseline(findings, baseline)
     if hlo_mode:
         from horovod_tpu.analysis import hlo as hlo_mod
-        hlo_mod.record_metrics(findings)
+        from horovod_tpu.analysis import shard as shard_mod
+        # Each family owns its metric: HVD3xx ->
+        # hvdshard_findings_total, the rest -> hvdhlo_findings_total.
+        shard_f = [f for f in findings
+                   if re.fullmatch(r"HVD3\d\d", f.rule_id)]
+        hlo_mod.record_metrics([f for f in findings
+                                if f not in shard_f])
+        shard_mod.record_metrics(shard_f)
     else:
         _record_metrics(findings)
     if args.fmt == "json":

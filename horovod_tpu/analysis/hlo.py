@@ -96,7 +96,9 @@ class HloOp:
 
 @dataclasses.dataclass(frozen=True)
 class HloParam:
-    """One entry-computation parameter."""
+    """One computation parameter (entry, `call`ee, or shard_map body —
+    sharding/donation attrs are recorded uniformly at every function
+    boundary, not just the entry signature)."""
 
     index: int
     name: str                     # "%arg0" / "%p.1"
@@ -104,6 +106,10 @@ class HloParam:
     donated: bool
     scope: str
     line: int
+    #: Raw sharding annotation text ("{replicated}",
+    #: "{devices=[2,4]<=[8]}", ...) or None when unannotated. The
+    #: sharding-aware layer (analysis/shard.py) interprets it.
+    sharding: Optional[str] = None
 
 
 class HloProgram:
@@ -111,12 +117,15 @@ class HloProgram:
 
     def __init__(self, path: str, ops: List[HloOp],
                  params: List[HloParam], entry_scope: str,
-                 fmt: str) -> None:
+                 fmt: str, num_partitions: int = 1) -> None:
         self.path = path
         self.ops = ops
         self.params = params
         self.entry_scope = entry_scope
         self.fmt = fmt  # "stablehlo" | "hlo"
+        #: SPMD partition count (mhlo.num_partitions module attr /
+        #: HloModule header); 1 for unpartitioned programs.
+        self.num_partitions = num_partitions
         self._defs: Dict[Tuple[str, str], HloOp] = {}
         self._uses: Dict[Tuple[str, str], List[HloOp]] = {}
         for op in ops:
@@ -208,11 +217,34 @@ _MLIR_OP_RE = re.compile(
     r'"?([a-zA-Z_][\w$]*\.)?([a-zA-Z_][\w$-]*)"?\s*(?=[ (%<"@]|$)')
 _MLIR_FUNC_RE = re.compile(
     r"^\s*func\.func\s+(?:(public|private)\s+)?@([\w$-]+)\s*\((.*)$")
-# The attr dict may nest braces one level (mhlo.sharding strings like
-# {jax.buffer_donor = true, mhlo.sharding = "{replicated}"}) — the
-# donation bit must survive a sharding annotation riding alongside it.
+# The attr dict may nest braces two levels (mhlo.sharding strings like
+# {jax.buffer_donor = true, mhlo.sharding = "{devices=[2,4]<=[8]
+# last_tile_dims={replicated}}"}) — the donation bit and the sharding
+# string must both survive riding alongside each other.
 _MLIR_ARG_RE = re.compile(
-    r"(%arg\d+):\s*([^,){]+(?:\{(?:[^{}]|\{[^{}]*\})*\})?)")
+    r"(%arg\d+):\s*"
+    r"([^,){]+(?:\{(?:[^{}]|\{(?:[^{}]|\{[^{}]*\})*\})*\})?)")
+_MLIR_SHARDING_RE = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')
+_MLIR_NUM_PARTITIONS_RE = re.compile(
+    r"mhlo\.num_partitions\s*=\s*(\d+)")
+# HLO text: `sharding={devices=[4,1,2]<=[2,4]T(1,0)
+# last_tile_dim_replicate}` / `sharding={replicated}` instruction attr
+# (entry parameters keep their annotation through SPMD partitioning).
+_HLO_SHARDING_RE = re.compile(
+    r"sharding=(\{(?:[^{}]|\{[^{}]*\})*\})")
+_HLO_NUM_PARTITIONS_RE = re.compile(r"\bnum_partitions=(\d+)")
+
+
+def op_sharding(op: HloOp) -> Optional[str]:
+    """The raw sharding annotation carried by one instruction, for BOTH
+    textual forms: ``mhlo.sharding = "..."`` on a StableHLO custom-call
+    (`@Sharding` = `with_sharding_constraint`), ``sharding={...}`` on an
+    HLO-text instruction. None when the op is unannotated."""
+    m = _MLIR_SHARDING_RE.search(op.attrs)
+    if m:
+        return m.group(1)
+    m = _HLO_SHARDING_RE.search(op.attrs)
+    return m.group(1) if m else None
 
 #: MLIR keywords the op regex would otherwise read as opcodes.
 _MLIR_NOISE = {"module", "func", "}", "{", "^bb0", "cond", "do"}
@@ -223,6 +255,7 @@ def _parse_stablehlo(text: str, path: str) -> HloProgram:
     params: List[HloParam] = []
     entry_scope = ""
     scope = ""
+    num_partitions = 1
     # stack of (op, brace_balance_at_open) for region ops whose result
     # type arrives on the closing `}) : (...) -> ...` line
     pending: List[HloOp] = []
@@ -230,6 +263,11 @@ def _parse_stablehlo(text: str, path: str) -> HloProgram:
     for lineno, raw in enumerate(lines, 1):
         line = raw.strip()
         if not line or line.startswith("//"):
+            continue
+        if line.startswith("module"):
+            pm = _MLIR_NUM_PARTITIONS_RE.search(line)
+            if pm:
+                num_partitions = int(pm.group(1))
             continue
         fm = _MLIR_FUNC_RE.match(raw)
         if fm:
@@ -242,8 +280,10 @@ def _parse_stablehlo(text: str, path: str) -> HloProgram:
                 types = _mlir_types(typetext)
                 donated = ("jax.buffer_donor" in typetext
                            or "tf.aliasing_output" in typetext)
+                sm = _MLIR_SHARDING_RE.search(typetext)
                 params.append(HloParam(i, arg, types[0] if types else None,
-                                       donated, scope, lineno))
+                                       donated, scope, lineno,
+                                       sm.group(1) if sm else None))
             continue
         if line.startswith("})"):
             # close of a region op: its functional type rides here
@@ -282,7 +322,7 @@ def _parse_stablehlo(text: str, path: str) -> HloProgram:
         if rest.count("({") > rest.count("})"):
             pending.append(op)
     return HloProgram(path, ops, params, entry_scope or "main",
-                      "stablehlo")
+                      "stablehlo", num_partitions)
 
 
 # HLO text: `  %all-reduce.2 = f32[256,256]{1,0} all-reduce(f32[...] %x),
@@ -326,10 +366,14 @@ def _parse_hlo_text(text: str, path: str) -> HloProgram:
     scope = ""
     in_entry = False
     donated: Set[int] = set()
+    num_partitions = 1
     lines = text.splitlines()
     for lineno, raw in enumerate(lines, 1):
         if raw.startswith("HloModule"):
             donated = _hlo_alias_params(raw)
+            pm = _HLO_NUM_PARTITIONS_RE.search(raw)
+            if pm:
+                num_partitions = int(pm.group(1))
             continue
         im = _HLO_INSTR_RE.match(raw)
         if im:
@@ -345,7 +389,8 @@ def _parse_hlo_text(text: str, path: str) -> HloProgram:
                 idx = int(pm.group(1)) if pm else len(params)
                 params.append(HloParam(
                     idx, result, op.result_types[0] if op.result_types
-                    else None, in_entry and idx in donated, scope, lineno))
+                    else None, in_entry and idx in donated, scope, lineno,
+                    op_sharding(op)))
             continue
         cm = _HLO_COMP_RE.match(raw)
         if cm and "=" not in raw.split("->")[0]:
@@ -353,9 +398,12 @@ def _parse_hlo_text(text: str, path: str) -> HloProgram:
             scope = cm.group(2)
             if in_entry:
                 entry_scope = scope
-    # parameters of non-entry computations are never donation candidates;
-    # keep only entry ones plus none else need donation bits
-    return HloProgram(path, ops, params, entry_scope, "hlo")
+    # parameters of non-entry computations are never donation candidates
+    # (only the entry alias map carries donation bits), but they DO keep
+    # their sharding attrs — call/shard_map boundaries are recorded
+    # uniformly with the entry signature.
+    return HloProgram(path, ops, params, entry_scope, "hlo",
+                      num_partitions)
 
 
 def parse(text: str, path: str = "<hlo>") -> HloProgram:

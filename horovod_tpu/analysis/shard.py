@@ -1,0 +1,696 @@
+"""hvdshard: static sharding & per-device memory analysis (HVD3xx).
+
+The GSPMD path (ROADMAP item 3; Xu et al., arXiv:2105.04663) makes
+parallelism *annotation-driven*: the program you write is global, the
+partitioner decides what every device holds and which collectives move
+data between them. That is exactly why its classic failure modes are
+statically visible long before a 40-minute compile-and-OOM run:
+
+* a 700 M-param table nobody annotated is silently **replicated** on
+  every device (HVD301);
+* two inconsistent annotations make the partitioner **insert** an
+  all-gather/all-to-all nobody asked for, moving whole-tensor payloads
+  inside the step body (HVD302);
+* the per-device working set quietly exceeds HBM — discovered at run
+  time today, computable at lint time from the post-SPMD module
+  (HVD303);
+* a mesh axis is paid for (devices reserved, collectives sized for it)
+  but shards nothing (HVD304);
+* an ``all_reduce`` whose consumers each keep only their own shard
+  should have been a ``reduce_scatter``/``psum_scatter`` — the
+  Megatron-LM resharding-traffic observation (HVD305).
+
+This module is the sharding-aware layer over the same two textual
+forms ``analysis/hlo.py`` already parses:
+
+* **StableHLO MLIR** (pre-partition): sharding arrives as
+  ``mhlo.sharding`` attributes on function arguments and on
+  ``custom_call @Sharding`` ops (``with_sharding_constraint``); shapes
+  are *global*.
+* **post-SPMD HLO text** (``lowered.compile().as_text()``): shapes are
+  already *per-device*, entry parameters keep their ``sharding={...}``
+  attrs, the module is ``is_scheduled`` — its printed instruction
+  order is the schedule the donation-aware liveness pass walks to
+  produce the static per-device peak-HBM estimate.
+
+Rules live in ``analysis/shard_rules.py``; findings ride the shared
+driver machinery (``--format json``/``--baseline``/``--list-rules``)
+and feed ``hvdshard_findings_total{rule}``. ``make shard-lint`` gates
+the canonical 2-D (batch x model) mesh step program
+(``--hlo-step lm_sharded``) against ``scripts/hvdshard_baseline.json``.
+See docs/static_analysis.md for the catalog and the peak-memory model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from horovod_tpu.analysis.driver import Finding
+from horovod_tpu.analysis.hlo import (
+    HloOp, HloProgram, TensorType, op_sharding, parse,
+)
+
+_MB = 1024 * 1024
+
+
+def _bytes_env(name: str, default: Optional[int]) -> Optional[int]:
+    """Byte-count env knob accepting plain ints or K/M/G suffixes
+    (``HOROVOD_HLO_LINT_HBM_BUDGET=16G``). Unset -> default; a
+    malformed value raises — silently falling back would disarm the
+    very gate (HVD303 and friends) the knob was set to arm, in exactly
+    the runs that set it (the flops.py loud-on-garbage policy)."""
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return default
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)\s*([kKmMgG]?)[bB]?", v)
+    if not m:
+        raise ValueError(
+            f"{name}={v!r} is not a byte count (use plain bytes or a "
+            "K/M/G suffix, e.g. 16G)")
+    mult = {"": 1, "k": 1024, "m": _MB, "g": 1024 * _MB}[m.group(2).lower()]
+    return int(float(m.group(1)) * mult)
+
+
+# ---------------------------------------------------- sharding strings
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """One parsed HLO sharding annotation.
+
+    ``tile_dims`` are the per-tensor-dimension shard counts;
+    ``replicate_factor`` is how many devices hold each shard (the
+    trailing ``last_tile_dim_replicate`` group, or every device for
+    ``{replicated}``); ``assignment`` is the flat device-id order over
+    the C-order tile grid (+ the replication dim innermost), or None
+    when the kind carries no grid (replicated/maximal/manual).
+    """
+
+    kind: str                     # replicated | tiled | maximal | manual
+    tile_dims: Tuple[int, ...] = ()
+    replicate_factor: int = 1
+    assignment: Optional[Tuple[int, ...]] = None
+
+    @property
+    def shard_factor(self) -> int:
+        n = 1
+        for d in self.tile_dims:
+            n *= d
+        return n
+
+    @property
+    def fully_replicated(self) -> bool:
+        return self.kind == "replicated" or (
+            self.kind == "tiled" and self.shard_factor == 1)
+
+    def shard_of(self, num_devices: int) -> Optional[Tuple[int, ...]]:
+        """device id -> shard index, as a tuple indexed by device id;
+        devices in the same replication group share a shard index.
+        None when the annotation doesn't describe `num_devices` devices
+        (foreign dump) or carries no grid to map."""
+        if self.kind == "replicated":
+            return tuple(0 for _ in range(num_devices))
+        if self.assignment is None or len(self.assignment) != num_devices:
+            return None
+        out = [0] * num_devices
+        rep = max(self.replicate_factor, 1)
+        for flat, dev in enumerate(self.assignment):
+            if not 0 <= dev < num_devices:
+                return None
+            out[dev] = flat // rep   # same shard for the rep-group
+        return tuple(out)
+
+
+def _iota_order(dims: Sequence[int], perm: Sequence[int]) -> List[int]:
+    """Flat C-order device ids of ``iota(prod(dims)).reshape(dims)
+    .transpose(perm)`` — the V2 tile-assignment ``<=[dims]T(perm)``
+    encoding, expanded without numpy (lint must not need the runtime
+    deps)."""
+    strides = [1] * len(dims)
+    for i in range(len(dims) - 2, -1, -1):
+        strides[i] = strides[i + 1] * dims[i + 1]
+    t_dims = [dims[p] for p in perm]
+    t_strides = [strides[p] for p in perm]
+    out = []
+    for idx in itertools.product(*(range(d) for d in t_dims)):
+        out.append(sum(i * s for i, s in zip(idx, t_strides)))
+    return out
+
+
+_TILED_RE = re.compile(
+    r"devices=\[([\d,]+)\]"
+    r"(?:<=\[([\d,]+)\](?:T\(([\d,]+)\))?|((?:\d+,?)+))")
+_LAST_TILE_DIMS_RE = re.compile(r"last_tile_dims=\{([^{}]*)\}")
+
+
+def parse_sharding(text: Optional[str]) -> Optional[ShardSpec]:
+    """Parse one HLO sharding annotation string (either textual form
+    prints the same grammar): ``{replicated}``, ``{maximal device=0}``,
+    ``{manual}``, V1 explicit device lists ``{devices=[2,2]0,1,2,3}``
+    and V2 iota forms ``{devices=[4,1,2]<=[2,4]T(1,0)
+    last_tile_dim_replicate}``. None on no/unrecognized annotation
+    (size-based rules must skip, not guess)."""
+    if not text:
+        return None
+    body = text.strip()
+    # Strip exactly ONE outer brace pair: .strip("{}") would also eat
+    # the closing brace of a trailing `last_tile_dims={replicated}`.
+    if body.startswith("{") and body.endswith("}"):
+        body = body[1:-1].strip()
+    if not body:
+        return None
+    if body.startswith("replicated"):
+        return ShardSpec("replicated")
+    if body.startswith("maximal"):
+        return ShardSpec("maximal")
+    if body.startswith("manual"):
+        return ShardSpec("manual")
+    m = _TILED_RE.search(body)
+    if not m:
+        return None
+    printed = [int(d) for d in m.group(1).split(",") if d]
+    if m.group(2):                           # V2 iota [+ transpose]
+        reshape = [int(d) for d in m.group(2).split(",") if d]
+        perm = ([int(p) for p in m.group(3).split(",") if p]
+                if m.group(3) else list(range(len(reshape))))
+        if sorted(perm) != list(range(len(reshape))):
+            return None
+        assignment = _iota_order(reshape, perm)
+    else:                                    # V1 explicit device list
+        assignment = [int(d) for d in m.group(4).split(",") if d]
+    total = 1
+    for d in printed:
+        total *= d
+    if total != len(assignment) or total == 0:
+        return None
+    # Trailing non-data tile dims: one for last_tile_dim_replicate,
+    # len(list) for last_tile_dims={...}; all treated as replication
+    # (a manual trailing dim still means "these devices hold the same
+    # data-sharded tile").
+    trailing = 0
+    if "last_tile_dim_replicate" in body:
+        trailing = 1
+    else:
+        lt = _LAST_TILE_DIMS_RE.search(body)
+        if lt:
+            trailing = len([t for t in lt.group(1).split(",") if t.strip()])
+    if trailing >= len(printed):
+        return None
+    tile_dims = tuple(printed[:len(printed) - trailing])
+    rep = 1
+    for d in printed[len(printed) - trailing:]:
+        rep *= d
+    return ShardSpec("tiled", tile_dims, rep, tuple(assignment))
+
+
+def per_device_bytes(ttype: Optional[TensorType],
+                     spec: Optional[ShardSpec],
+                     fmt: str) -> Optional[int]:
+    """Bytes one device holds for a tensor under `spec`. Post-SPMD HLO
+    shapes are already per-device — bytes pass through; StableHLO
+    shapes are global and divide by the (ceil-per-dim) tiling."""
+    if ttype is None:
+        return None
+    nb = ttype.nbytes
+    if nb is None:
+        return None
+    if fmt == "hlo" or spec is None or spec.kind != "tiled":
+        return nb
+    itemsize = ttype.itemsize
+    elems = 1
+    for i, d in enumerate(ttype.dims):
+        t = spec.tile_dims[i] if i < len(spec.tile_dims) else 1
+        elems *= -(-d // max(t, 1))
+    return elems * itemsize
+
+
+# ----------------------------------------------- annotated tensor sweep
+
+@dataclasses.dataclass(frozen=True)
+class AnnotatedTensor:
+    """One sharding-annotated value: an entry parameter or an explicit
+    constraint (`custom_call @Sharding` / an op-level ``sharding=``)."""
+
+    name: str
+    type: Optional[TensorType]
+    spec: Optional[ShardSpec]
+    line: int
+    origin: str                   # "param" | "constraint"
+
+
+def annotated_tensors(prog: HloProgram) -> List[AnnotatedTensor]:
+    out: List[AnnotatedTensor] = []
+    for p in prog.entry_params:
+        if p.sharding is not None:
+            out.append(AnnotatedTensor(p.name, p.type,
+                                       parse_sharding(p.sharding),
+                                       p.line, "param"))
+    for op in prog.ops:
+        if op.scope != prog.entry_scope:
+            continue
+        s = op_sharding(op)
+        if s is None:
+            continue
+        t = (op.result_types[0] if op.result_types else
+             (op.operand_types[0] if op.operand_types else None))
+        out.append(AnnotatedTensor(op.result or op.opcode, t,
+                                   parse_sharding(s), op.line,
+                                   "constraint"))
+    return out
+
+
+def partition_classes(tensors: Sequence[AnnotatedTensor],
+                      num_devices: int) -> Optional[int]:
+    """Number of distinct device classes under the common refinement of
+    every tensor's shard partition: two devices in the same class hold
+    identical shards of EVERY tensor in `tensors` — paid-for devices
+    that add no parallelism. None when any annotation can't be mapped
+    onto `num_devices` devices (foreign/partial dump: don't guess)."""
+    if num_devices <= 1:
+        return None
+    keys: List[Tuple] = [() for _ in range(num_devices)]
+    for t in tensors:
+        if t.spec is None:
+            return None
+        shard = t.spec.shard_of(num_devices)
+        if shard is None:
+            return None
+        keys = [k + (s,) for k, s in zip(keys, shard)]
+    return len(set(keys))
+
+
+# --------------------------------------- collective provenance (HVD302)
+
+#: jax collective primitive names as they appear as the LAST component
+#: of a post-opt ``metadata={op_name="jit(f)/.../psum"}`` path: a
+#: collective carrying one of these was asked for by user code; one
+#: carrying the op it was inserted FOR (dot_general, gather, ...) — or
+#: no metadata at all — came from the SPMD partitioner.
+USER_COLLECTIVE_MARKERS = frozenset({
+    "psum", "psum_scatter", "pmean", "pmax", "pmin", "all_gather",
+    "all_to_all", "ppermute", "pshuffle", "reduce_scatter",
+    "all_reduce", "collective_permute",
+})
+
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def traceable_to_user_collective(op: HloOp) -> bool:
+    m = _OP_NAME_RE.search(op.attrs)
+    if not m:
+        return False
+    last = m.group(1).rsplit("/", 1)[-1]
+    last = re.split(r"[\[\s(]", last, 1)[0]
+    return last in USER_COLLECTIVE_MARKERS
+
+
+# ------------------------------------------- per-device peak-HBM model
+
+#: Result-aliases-operand opcodes: no new buffer is materialized.
+_ALIAS_OPCODES = {"bitcast", "get_tuple_element", "tuple"}
+
+_CALLEE_RE = re.compile(
+    r"(?:to_apply|calls|body|condition)=(%[\w.-]+)")
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^{}]*)\}")
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    """Static per-device peak-HBM estimate of one post-SPMD module."""
+
+    peak_bytes: int
+    peak_line: int
+    args_bytes: int               # entry parameter buffers
+    donated_bytes: int            # of which donated (reusable)
+    out_bytes: int                # root/result buffers
+    num_partitions: int
+    #: largest live buffers at the peak program point, for messages
+    top: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"peak_bytes": self.peak_bytes,
+                "peak_mb": round(self.peak_bytes / _MB, 2),
+                "args_bytes": self.args_bytes,
+                "donated_bytes": self.donated_bytes,
+                "out_bytes": self.out_bytes,
+                "num_partitions": self.num_partitions,
+                "top_live": [
+                    {"buffer": n, "mb": round(b / _MB, 2)}
+                    for n, b in self.top]}
+
+
+def _result_bytes(op: HloOp) -> int:
+    total = 0
+    for t in op.result_types:
+        if t is not None and t.nbytes is not None:
+            total += t.nbytes
+    return total
+
+
+def _callees(op: HloOp) -> List[str]:
+    names = [m.group(1) for m in _CALLEE_RE.finditer(op.attrs)]
+    bm = _BRANCHES_RE.search(op.attrs)
+    if bm:
+        names.extend(t.strip() for t in bm.group(1).split(",")
+                     if t.strip())
+    return names
+
+
+class _PeakWalker:
+    """Donation-aware liveness over the post-opt (scheduled) printed
+    instruction order. The model errs structural, not optimistic:
+
+    * every op result materializes its full result bytes (except the
+      alias opcodes and ``fusion``/``call``-wrapped fusions, whose
+      interiors never hit HBM — that is what fusion means);
+    * operands die at their last textual use; donated entry parameters
+      die like temps (XLA reuses the buffer), undonated ones live to
+      the end next to the outputs — the exact cost HVD203 describes;
+    * a ``while``/``call``/conditional adds its callee's *interior*
+      peak (params and root excluded — those alias the caller's
+      buffers, already counted) on top of the caller's live set.
+    """
+
+    def __init__(self, prog: HloProgram) -> None:
+        self.prog = prog
+        self.by_scope: Dict[str, List[HloOp]] = {}
+        for op in prog.ops:
+            self.by_scope.setdefault(op.scope, []).append(op)
+        self._interior: Dict[str, int] = {}
+        self._visiting: Set[str] = set()
+
+    def _interior_of(self, scope: str) -> int:
+        if scope in self._interior:
+            return self._interior[scope]
+        if scope in self._visiting or scope not in self.by_scope:
+            return 0
+        self._visiting.add(scope)
+        peak, _, root, _ = self._walk(scope, count_params=False)
+        self._visiting.discard(scope)
+        interior = max(0, peak - root)
+        self._interior[scope] = interior
+        return interior
+
+    def _walk(self, scope: str, count_params: bool
+              ) -> Tuple[int, int, int, Dict[str, int]]:
+        """(peak bytes, peak line, root result bytes, live-at-peak
+        snapshot) for one scope."""
+        ops = self.by_scope.get(scope, [])
+        params = {p.name: p for p in self.prog.params
+                  if p.scope == scope}
+        # Alias chains first (aliases are defined before their uses in
+        # SSA order), so liveness is keyed on CANONICAL buffers — a
+        # bitcast's last use must not free the underlying buffer while
+        # the original name is still consumed later, and vice versa.
+        # get-tuple-element resolves to the tupled ELEMENT when the
+        # tuple is scope-local (a tuple aliases ALL its operands, not
+        # just the first).
+        root_of: Dict[str, str] = {}
+
+        def root(name: str) -> str:
+            seen = set()
+            while name in root_of and name not in seen:
+                seen.add(name)
+                name = root_of[name]
+            return name
+
+        defs = {op.result: op for op in ops if op.result}
+        for op in ops:
+            if not op.result or not op.operands:
+                continue
+            if op.opcode == "bitcast":
+                root_of[op.result] = op.operands[0]
+            elif op.opcode == "get_tuple_element":
+                d = defs.get(op.operands[0])
+                im = re.search(r"index=(\d+)", op.attrs)
+                idx = int(im.group(1)) if im else None
+                if d is not None and d.opcode == "tuple" \
+                        and idx is not None and idx < len(d.operands):
+                    root_of[op.result] = d.operands[idx]
+                else:
+                    root_of[op.result] = op.operands[0]
+        last_use: Dict[str, int] = {}
+        for i, op in enumerate(ops):
+            for o in op.operands:
+                last_use[root(o)] = i
+        # A live tuple keeps EVERY element alive: extend each element's
+        # lifetime to the tuple's own last use (a gte at index i would
+        # otherwise let the tuple op count as element i+1's last use
+        # and free a buffer still reachable through the tuple).
+        for op in ops:
+            if op.opcode != "tuple" or not op.result:
+                continue
+            tl = last_use.get(op.result)
+            if tl is None:
+                continue
+            for o in op.operands:
+                r = root(o)
+                last_use[r] = max(last_use.get(r, -1), tl)
+        live: Dict[str, int] = {}
+        if count_params:
+            for p in params.values():
+                nb = p.type.nbytes if p.type is not None else None
+                live[p.name] = nb or 0
+        peak = sum(live.values())
+        peak_line = ops[0].line if ops else 0
+        snapshot: Dict[str, int] = dict(live)
+        for i, op in enumerate(ops):
+            if op.opcode == "parameter":
+                continue  # accounted above (or free in interior scopes)
+            rb = (0 if op.opcode in _ALIAS_OPCODES
+                  else _result_bytes(op))
+            interior = 0
+            if op.opcode not in ("fusion",):
+                for callee in _callees(op):
+                    # fusion computations reached through the CPU
+                    # backend's parallel-call wrappers recurse to ~0
+                    interior = max(interior, self._interior_of(callee))
+            here = sum(live.values()) + rb + interior
+            if here > peak:
+                peak = here
+                peak_line = op.line
+                snapshot = dict(live)
+                if rb and op.result:
+                    snapshot[op.result] = rb
+            if rb and op.result:
+                live[op.result] = rb
+            # free buffers whose last use was this op
+            for o in op.operands:
+                r = root(o)
+                if last_use.get(r) != i:
+                    continue
+                if r in params:
+                    p = params[r]
+                    if not count_params or not p.donated:
+                        continue  # undonated args live to program end
+                live.pop(r, None)
+            if op.result and root(op.result) not in last_use \
+                    and op.opcode not in _ALIAS_OPCODES \
+                    and i < len(ops) - 1:
+                live.pop(op.result, None)  # unused result: short-lived
+        root_bytes = 0
+        if ops:
+            last = ops[-1]
+            root_bytes = (_result_bytes(last)
+                          if last.opcode not in _ALIAS_OPCODES else 0)
+        return peak, peak_line, root_bytes, snapshot
+
+    def estimate(self) -> Optional[MemoryEstimate]:
+        scope = self.prog.entry_scope
+        if scope not in self.by_scope:
+            return None
+        peak, line, root_bytes, snapshot = self._walk(
+            scope, count_params=True)
+        args = donated = 0
+        for p in self.prog.entry_params:
+            nb = p.type.nbytes if p.type is not None else None
+            args += nb or 0
+            if p.donated:
+                donated += nb or 0
+        top = sorted(snapshot.items(), key=lambda kv: -kv[1])[:3]
+        return MemoryEstimate(peak, line, args, donated, root_bytes,
+                              self.prog.num_partitions, top)
+
+
+def peak_memory(prog: HloProgram) -> Optional[MemoryEstimate]:
+    """Static per-device peak-HBM estimate. Only meaningful on the
+    post-SPMD form (per-device shapes, scheduled order); None on
+    StableHLO input or an empty module."""
+    if prog.fmt != "hlo":
+        return None
+    return _PeakWalker(prog).estimate()
+
+
+def estimate_compiled_text(text: str) -> Optional[MemoryEstimate]:
+    """Convenience for bench/serve stamping: parse + estimate one
+    ``compiled.as_text()`` dump."""
+    try:
+        return peak_memory(parse(text, "<compiled>"))
+    except Exception:
+        return None
+
+
+# ------------------------------------------------------------- linting
+
+def registry() -> Dict[str, Tuple[str, object]]:
+    from horovod_tpu.analysis import shard_rules
+    return dict(shard_rules.RULES)
+
+
+def lint_text(text: str, path: str = "<hlo>",
+              select: Optional[Sequence[str]] = None,
+              ignore: Sequence[str] = ()) -> List[Finding]:
+    """Run the HVD3xx sharding rules over one lowered module's text
+    (either form; each rule self-selects the form it can judge)."""
+    prog = parse(text, path)
+    return lint_program(prog, select=select, ignore=ignore)
+
+
+def lint_program(prog: HloProgram,
+                 select: Optional[Sequence[str]] = None,
+                 ignore: Sequence[str] = ()) -> List[Finding]:
+    wanted = {r.upper() for r in select} if select is not None else None
+    ignored = {r.upper() for r in ignore}
+    out: List[Finding] = []
+    for rule_id, (_desc, check) in sorted(registry().items()):
+        if wanted is not None and rule_id not in wanted:
+            continue
+        if rule_id in ignored:
+            continue
+        out.extend(check(prog))
+    out.sort(key=lambda f: (f.line, f.rule_id))
+    return out
+
+
+def lint_files(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               ignore: Sequence[str] = ()) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as e:
+            findings.append(Finding(str(p), 1, "HVD999",
+                                    f"unreadable: {e}"))
+            continue
+        findings.extend(lint_text(text, path=str(p), select=select,
+                                  ignore=ignore))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings
+
+
+def record_metrics(findings: Sequence[Finding]) -> None:
+    """hvdshard_findings_total{rule}; analysis must work without the
+    runtime deps, so failures are swallowed."""
+    try:
+        from horovod_tpu.observability import metrics as m
+        counter = m.registry().counter(
+            "hvdshard_findings_total", "hvdshard findings by rule",
+            labelnames=("rule",))
+        for f in findings:
+            counter.labels(rule=f.rule_id).inc()
+    except Exception:
+        pass
+
+
+# ---------------------------------------- canonical 2-D mesh step lower
+
+def replicated_twin_forced() -> bool:
+    """HOROVOD_SHARD_LINT_REPLICATED=1: lower `lm_sharded` with every
+    parameter fully replicated — the acceptance twin that must trip
+    HVD301 (replicated tables) + HVD302 (partitioner-inserted
+    all-gather materializing the unsharded embedding gradient)."""
+    from horovod_tpu.common.config import _env_bool
+    return _env_bool("HOROVOD_SHARD_LINT_REPLICATED")
+
+
+def lower_sharded_step_texts(replicated: Optional[bool] = None
+                             ) -> Dict[str, str]:
+    """Both textual forms of the canonical 2-D (batch x model) mesh
+    train step — the program ``make shard-lint`` gates.
+
+    A tied-embedding transformer LM is laid out on the
+    ``parallel/mesh.py`` mesh (``MeshSpec.infer(8, tp=4)``: dp=2 x
+    tp=4, the first real consumer of that module — deliberately
+    scouting ROADMAP item 3): the embedding and FFN weights shard over
+    ``tp``, the batch over ``dp``, the logits carry an explicit
+    batch x model constraint. Under this config the compiled module is
+    resharding-free and every per-device shard stays lane-aligned.
+    The replicated twin (`replicated=True`, or
+    HOROVOD_SHARD_LINT_REPLICATED=1) keeps the same step body but
+    stores every parameter fully replicated — the "forgot to annotate
+    the params" failure GSPMD makes so easy — which trips HVD301 on
+    the 16 MB embedding and HVD302 on the all-gather the partitioner
+    inserts to materialize its unsharded gradient.
+
+    Returns ``{"stablehlo": ..., "hlo": ...}`` — the pre-partition
+    MLIR (global shapes + annotations) and the post-SPMD scheduled
+    module (per-device shapes; what HVD302/303 and the peak-HBM
+    model consume).
+    """
+    if replicated is None:
+        replicated = replicated_twin_forced()
+    from horovod_tpu.analysis.hlo import _force_cpu_mesh
+    jax = _force_cpu_mesh()
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    ndev = len(jax.devices())
+    tp = 4 if ndev % 4 == 0 else 2
+    mesh = build_mesh(MeshSpec.infer(ndev, tp=tp))
+
+    def sh(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    if replicated:
+        s_emb = s_wi = s_wo = sh()
+    else:
+        s_emb = sh("tp", None)       # vocab-sharded embedding table
+        s_wi = sh(None, "tp")        # column-parallel FFN in
+        s_wo = sh("tp", None)        # row-parallel FFN out
+    s_tok = sh("dp", None)
+    s_logits = sh("dp", None, "tp")
+
+    D, F, V, NL = 512, 2048, 8192, 2
+    B, S = 16, 64
+    rng = np.random.default_rng(0)
+    params = {"emb": jnp.asarray(
+        rng.standard_normal((V, D)) * 0.02, jnp.float32)}
+    shardings = {"emb": s_emb}
+    for i in range(NL):
+        params[f"wi{i}"] = jnp.asarray(
+            rng.standard_normal((D, F)) * 0.02, jnp.float32)
+        params[f"wo{i}"] = jnp.asarray(
+            rng.standard_normal((F, D)) * 0.02, jnp.float32)
+        shardings[f"wi{i}"] = s_wi
+        shardings[f"wo{i}"] = s_wo
+
+    def loss(p, tok, tgt):
+        h = p["emb"][tok]
+        for i in range(NL):
+            h = h + jnp.tanh(h @ p[f"wi{i}"]) @ p[f"wo{i}"]
+        logits = h @ p["emb"].T    # tied embedding: vocab-parallel
+        logits = jax.lax.with_sharding_constraint(logits, s_logits)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], -1))
+
+    def step(p, tok, tgt):
+        g = jax.grad(loss)(p, tok, tgt)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.01 * b, p, g)
+
+    tok = jnp.asarray(rng.integers(0, V, (B, S)))
+    tgt = jnp.roll(tok, -1, axis=1)
+    jit = jax.jit(step, in_shardings=(shardings, s_tok, s_tok),
+                  out_shardings=shardings, donate_argnums=0)
+    lowered = jit.lower(
+        jax.device_put(params, shardings),
+        jax.device_put(tok, s_tok), jax.device_put(tgt, s_tok))
+    return {"stablehlo": lowered.as_text(),
+            "hlo": lowered.compile().as_text()}

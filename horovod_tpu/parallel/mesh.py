@@ -42,6 +42,16 @@ class MeshSpec:
     sp: int = 1
     tp: int = 1
 
+    def __post_init__(self) -> None:
+        # A zero/negative axis silently reshapes to an empty device
+        # grid and every later error is a numpy shape crash — fail at
+        # construction with the axis named.
+        for a in AXIS_ORDER:
+            if getattr(self, a) < 1:
+                raise HorovodTpuError(
+                    f"mesh axis {a}={getattr(self, a)} must be >= 1 "
+                    "(use 1 for an unused axis)")
+
     def sizes(self) -> Tuple[int, ...]:
         return tuple(getattr(self, a) for a in AXIS_ORDER)
 
@@ -53,8 +63,10 @@ class MeshSpec:
     def infer(n_devices: int, tp: int = 1, sp: int = 1, pp: int = 1,
               ep: int = 1) -> "MeshSpec":
         """Fix the model axes; give every remaining device to dp."""
+        if n_devices < 1:
+            raise HorovodTpuError(f"n_devices={n_devices} must be >= 1")
         inner = tp * sp * pp * ep
-        if n_devices % inner:
+        if inner < 1 or n_devices % inner:
             raise HorovodTpuError(
                 f"n_devices={n_devices} not divisible by tp*sp*pp*ep={inner}")
         return MeshSpec(dp=n_devices // inner, pp=pp, ep=ep, sp=sp, tp=tp)
@@ -74,7 +86,12 @@ def build_mesh(spec: MeshSpec,
         raise HorovodTpuError(
             f"mesh spec {spec.sizes()} needs {spec.total} devices, "
             f"got {len(devs)}")
-    arr = np.asarray(devs).reshape(spec.sizes())
+    if len({id(d) for d in devs}) != len(devs):
+        raise HorovodTpuError(
+            "duplicate devices in the mesh device list — a repeated "
+            "device aliases two mesh coordinates and every collective "
+            "over the affected axes deadlocks or double-counts")
+    arr = np.asarray(devs, dtype=object).reshape(spec.sizes())
     return Mesh(arr, AXIS_ORDER)
 
 

@@ -49,6 +49,15 @@ PEAK_TFLOPS = {
 #: degraded and bench numbers are noise (docs/benchmarks.md).
 HEALTHY_MATMUL_TFLOPS = 80.0
 
+#: HBM GiB per chip by device kind (public specs) — the budget the
+#: static per-device peak-HBM estimate (analysis/shard.py, bench.py
+#: `memory` stamp, scripts/perf_gate.py) is judged against.
+HBM_GIB = {
+    "TPU v4": 32.0, "TPU v5 lite": 16.0, "TPU v5litepod": 16.0,
+    "TPU v5": 95.0, "TPU v5p": 95.0, "TPU v6 lite": 32.0,
+    "TPU v6e": 32.0,
+}
+
 #: Forward GMACs per image @224 (torchvision multiply-add convention —
 #: see module docstring; the roofline doc's 4.1 GFLOP ResNet-50 number).
 RESNET_FWD_GMACS = {50: 4.1, 101: 7.8, 152: 11.5}
@@ -84,6 +93,33 @@ def peak_flops_per_chip(device_kind: Optional[str] = None
     for name, tf in PEAK_TFLOPS.items():
         if device_kind.startswith(name):
             return tf * 1e12
+    return None
+
+
+def hbm_bytes_per_chip(device_kind: Optional[str] = None
+                       ) -> Optional[int]:
+    """HBM bytes per chip (None on unknown chip/CPU).
+
+    HOROVOD_BENCH_HBM_GB overrides (non-standard boards, or arming the
+    memory gate on CPU hosts)."""
+    env = os.environ.get("HOROVOD_BENCH_HBM_GB")
+    if env:
+        # Loud on garbage: a silent fallback would skew the memory
+        # gate in exactly the runs that set this knob.
+        try:
+            return int(float(env) * (1 << 30))
+        except ValueError:
+            raise ValueError(
+                f"HOROVOD_BENCH_HBM_GB={env!r} is not a number")
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    for name, gib in HBM_GIB.items():
+        if device_kind.startswith(name):
+            return int(gib * (1 << 30))
     return None
 
 

@@ -182,6 +182,161 @@ def hvd205_upcast_accum():
     return f.lower(jnp.ones((128, 256), jnp.bfloat16)).as_text()
 
 
+# --------------------------------------------------- HVD3xx (hvdshard)
+
+def _mesh_2d():
+    """2 x 4 (batch x model) mesh over the 8 virtual CPU devices."""
+    from jax.sharding import NamedSharding
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("batch", "model"))
+    return mesh, (lambda *spec: NamedSharding(mesh, P(*spec)))
+
+
+def _emb_program_text(replicated):
+    """Tied-embedding lookup + vocab-parallel logits on the 2-D mesh.
+    The 8 MB table replicated across all 8 partitions is the HVD301
+    positive; the vocab-sharded twin is clean. The full-mesh logits
+    constraint keeps HVD304 out of the picture (every device class is
+    distinguished), so the pair isolates HVD301."""
+    mesh, sh = _mesh_2d()
+    V, D = 8192, 256
+    s_emb = sh() if replicated else sh("model", None)
+    s_tok = sh("batch", None)
+
+    def f(emb, tok):
+        h = emb[tok]
+        logits = h @ emb.T
+        logits = lax.with_sharding_constraint(
+            logits, sh("batch", None, "model"))
+        return jnp.sum(logits)
+
+    emb = jnp.ones((V, D), jnp.float32)
+    tok = jnp.zeros((16, 64), jnp.int32)
+    return jax.jit(f, in_shardings=(s_emb, s_tok)).lower(
+        jax.device_put(emb, s_emb), jax.device_put(tok, s_tok)).as_text()
+
+
+def hvd301_replicated_emb():
+    return _emb_program_text(replicated=True)
+
+
+def hvd301_sharded_emb():
+    return _emb_program_text(replicated=False)
+
+
+def _matmul_chain_text(conflict):
+    """Post-SPMD HLO of a sharded matmul chain. With a consumer
+    constraint that contradicts the producer sharding (`conflict`) the
+    partitioner inserts a 2 MB all-gather nobody asked for — the
+    HVD302 positive; the consistent twin compiles resharding-free.
+    Tensors stay under the 4 MiB HVD301 floor so the pair isolates
+    HVD302."""
+    mesh, sh = _mesh_2d()
+    s_x, s_w = sh("batch", None), sh(None, "model")
+
+    def f(x, w):
+        y = jnp.tanh(x @ w)        # sharded [batch, model]
+        if conflict:
+            # demand the model dim replicated: partitioner all-gathers
+            y = lax.with_sharding_constraint(y, sh("batch", None))
+        z = y * 2.0
+        return z
+
+    x = jnp.ones((512, 512), jnp.float32)   # 1 MB
+    w = jnp.ones((512, 1024), jnp.float32)  # 2 MB
+    out = sh("batch", None) if conflict else sh("batch", "model")
+    return jax.jit(f, in_shardings=(s_x, s_w),
+                   out_shardings=out).lower(
+        jax.device_put(x, s_x),
+        jax.device_put(w, s_w)).compile().as_text()
+
+
+def hvd302_allgather_inserted():
+    return _matmul_chain_text(conflict=True)
+
+
+def hvd302_reshard_free():
+    return _matmul_chain_text(conflict=False)
+
+
+def _donation_chain_text(donate):
+    """Post-SPMD (single-device) HLO of two chained 16 MB matmuls.
+    Undonated, the 16 MB input rides live next to both intermediates
+    (static peak ~64 MB); donating it lets the liveness model free it
+    after its single use (~48 MB) — the HVD303 pair, gated in tests
+    with HOROVOD_HLO_LINT_HBM_BUDGET between the two peaks."""
+    f = jax.jit(lambda x, w: (x @ w) @ w,
+                donate_argnums=(0,) if donate else ())
+    x = jnp.ones((2048, 2048), jnp.float32)
+    return f.lower(x, x).compile().as_text()
+
+
+def hvd303_overbudget():
+    return _donation_chain_text(donate=False)
+
+
+def hvd303_donated_underbudget():
+    return _donation_chain_text(donate=True)
+
+
+def _axis_usage_text(use_model_axis):
+    """2-D mesh whose model axis shards nothing >= 1 MiB (HVD304
+    positive) vs the twin whose weight and activation constraints use
+    both axes (clean). Everything stays under the 4 MiB HVD301 floor."""
+    mesh, sh = _mesh_2d()
+    s_x = sh("batch", None)
+    s_w = sh(None, "model") if use_model_axis else sh()
+
+    def f(x, w):
+        y = x @ w
+        y = lax.with_sharding_constraint(
+            y, sh("batch", "model") if use_model_axis
+            else sh("batch", None))
+        return jnp.tanh(y)
+
+    x = jnp.ones((512, 512), jnp.float32)   # 1 MB, batch-sharded
+    w = jnp.ones((512, 1024), jnp.float32)  # 2 MB
+    return jax.jit(f, in_shardings=(s_x, s_w)).lower(
+        jax.device_put(x, s_x), jax.device_put(w, s_w)).as_text()
+
+
+def hvd304_unused_axis():
+    return _axis_usage_text(use_model_axis=False)
+
+
+def hvd304_used_axes():
+    return _axis_usage_text(use_model_axis=True)
+
+
+def _reduce_keep_shard_text(scatter):
+    """shard_map gradient reduction where every rank keeps only its own
+    shard: `psum` + slice materializes the full 2 MB reduction on every
+    device first (HVD305 positive); `psum_scatter` is the clean twin."""
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("hvd",))
+    n = len(jax.devices())
+    R = 1024
+
+    def local(g):
+        if scatter:
+            return lax.psum_scatter(g, "hvd", scatter_dimension=0,
+                                    tiled=True)
+        s = lax.psum(g, "hvd")
+        i = lax.axis_index("hvd")
+        return lax.dynamic_slice_in_dim(s, i * (R // n), R // n, 0)
+
+    f = jax.shard_map(local, mesh=mesh, in_specs=P(),
+                      out_specs=P("hvd"), check_vma=False)
+    return jax.jit(f).lower(jnp.ones((R, 512), jnp.float32)).as_text()
+
+
+def hvd305_allreduce_slice():
+    return _reduce_keep_shard_text(scatter=False)
+
+
+def hvd305_psum_scatter():
+    return _reduce_keep_shard_text(scatter=True)
+
+
 FIXTURES = {
     "hvd201_giant_allreduce": hvd201_giant_allreduce,
     "hvd201_bucketed": hvd201_bucketed,
@@ -193,14 +348,33 @@ FIXTURES = {
     "hvd204_resnet_block_padded": hvd204_resnet_block_padded,
     "hvd205_upcast_matmul": hvd205_upcast_matmul,
     "hvd205_upcast_accum": hvd205_upcast_accum,
+    "hvd301_replicated_emb": hvd301_replicated_emb,
+    "hvd301_sharded_emb": hvd301_sharded_emb,
+    "hvd302_allgather_inserted": hvd302_allgather_inserted,
+    "hvd302_reshard_free": hvd302_reshard_free,
+    "hvd303_overbudget": hvd303_overbudget,
+    "hvd303_donated_underbudget": hvd303_donated_underbudget,
+    "hvd304_unused_axis": hvd304_unused_axis,
+    "hvd304_used_axes": hvd304_used_axes,
+    "hvd305_allreduce_slice": hvd305_allreduce_slice,
+    "hvd305_psum_scatter": hvd305_psum_scatter,
 }
 
 
 def main():
     os.makedirs(OUT, exist_ok=True)
     for name, fn in sorted(FIXTURES.items()):
-        path = os.path.join(OUT, f"{name}.mlir")
         text = fn()
+        # Post-SPMD fixtures (HVD302/303 consume the compiled module)
+        # are HLO text, not MLIR — name the file for what it holds,
+        # and drop the other-extension twin so a fixture that CHANGES
+        # form can't leave a stale file the tests keep pinning.
+        ext = "hlo" if text.startswith("HloModule") else "mlir"
+        other = os.path.join(OUT, f"{name}.{'mlir' if ext == 'hlo' else 'hlo'}")
+        if os.path.exists(other):
+            os.unlink(other)
+            print(f"removed stale {os.path.relpath(other, _REPO)}")
+        path = os.path.join(OUT, f"{name}.{ext}")
         with open(path, "w", encoding="utf-8") as fh:
             fh.write(text)
         print(f"wrote {os.path.relpath(path, _REPO)} "

@@ -264,11 +264,42 @@ def _check_conv_section(name: str, val: dict) -> list:
     return errs
 
 
+def _check_memory(name: str, val: dict) -> list:
+    """The per-section `memory` stamp (docs/perf.md): every section
+    whose XLA cost analysis ran (mfu_source == "xla" means the compile
+    the stamp rides on happened) must carry the static per-device
+    peak-HBM estimate, and an estimate over the chip budget fails the
+    gate — the compile-time OOM sentinel (HVD303's bench face)."""
+    errs = []
+    mem = val.get("memory")
+    prof = val.get("perfscope") or {}
+    if not isinstance(mem, dict) or not mem:
+        if prof.get("mfu_source") == "xla":
+            errs.append(
+                f"{name}: memory stamp missing despite a compiled "
+                "program (mfu_source=xla) — the static peak-HBM "
+                "estimate is gone (analysis/shard.py)")
+        return errs
+    static = mem.get("static_peak_device_bytes")
+    if not isinstance(static, (int, float)) or static <= 0:
+        errs.append(f"{name}: memory stamp carries no positive "
+                    "static_peak_device_bytes")
+        return errs
+    budget = mem.get("hbm_budget_bytes")
+    if budget and static > budget:
+        errs.append(
+            f"{name}: static per-device peak-HBM estimate "
+            f"{static / 2**20:.1f} MB exceeds the chip budget "
+            f"{budget / 2**20:.1f} MB — this section OOMs on the "
+            "target chip (shrink the batch, donate inputs, or shard)")
+    return errs
+
+
 def check_bench(doc: dict) -> list:
     """Structure-check every perfscope-stamped section of a bench.py
     JSON line (the StepProfile acceptance: phases cover >=90% of wall),
-    plus the conv sections' fast-path stamps. Self-contained — no
-    baseline involved."""
+    plus the conv sections' fast-path stamps and the per-section
+    memory stamps. Self-contained — no baseline involved."""
     extra = doc.get("extra") or {}
     errs = []
     found = 0
@@ -287,6 +318,7 @@ def check_bench(doc: dict) -> list:
             sec, prof,
             {"mfu_source": ["xla", "fallback", "none"]}, numeric=False))
         errs.extend(_check_watch(sec, val.get("hvdwatch")))
+        errs.extend(_check_memory(sec, val))
     if not found:
         errs.append("bench JSON carries no perfscope StepProfile "
                     "(HOROVOD_PERFSCOPE=0 on the bench run?)")

@@ -1,0 +1,19 @@
+module @jit_f attributes {mhlo.num_partitions = 8 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<8192x256xf32> {mhlo.sharding = "{replicated}"}, %arg1: tensor<16x64xi32> {mhlo.sharding = "{devices=[2,1,4]<=[8] last_tile_dim_replicate}"}) -> (tensor<f32> {jax.result_info = ""}) {
+    %c = stablehlo.constant dense<0> : tensor<i32>
+    %0 = stablehlo.broadcast_in_dim %c, dims = [] : (tensor<i32>) -> tensor<16x64xi32>
+    %1 = stablehlo.compare  LT, %arg1, %0,  SIGNED : (tensor<16x64xi32>, tensor<16x64xi32>) -> tensor<16x64xi1>
+    %c_0 = stablehlo.constant dense<8192> : tensor<i32>
+    %2 = stablehlo.broadcast_in_dim %c_0, dims = [] : (tensor<i32>) -> tensor<16x64xi32>
+    %3 = stablehlo.add %arg1, %2 : tensor<16x64xi32>
+    %4 = stablehlo.select %1, %3, %arg1 : tensor<16x64xi1>, tensor<16x64xi32>
+    %5 = stablehlo.broadcast_in_dim %4, dims = [0, 1] : (tensor<16x64xi32>) -> tensor<16x64x1xi32>
+    %6 = "stablehlo.gather"(%arg0, %5) <{dimension_numbers = #stablehlo.gather<offset_dims = [2], collapsed_slice_dims = [0], start_index_map = [0], index_vector_dim = 2>, indices_are_sorted = false, slice_sizes = array<i64: 1, 256>}> : (tensor<8192x256xf32>, tensor<16x64x1xi32>) -> tensor<16x64x256xf32>
+    %7 = stablehlo.transpose %arg0, dims = [1, 0] : (tensor<8192x256xf32>) -> tensor<256x8192xf32>
+    %8 = stablehlo.dot_general %6, %7, contracting_dims = [2] x [0], precision = [DEFAULT, DEFAULT] : (tensor<16x64x256xf32>, tensor<256x8192xf32>) -> tensor<16x64x8192xf32>
+    %9 = stablehlo.custom_call @Sharding(%8) {backend_config = "", mhlo.sharding = "{devices=[2,1,4]<=[8]}"} : (tensor<16x64x8192xf32>) -> tensor<16x64x8192xf32>
+    %cst = stablehlo.constant dense<0.000000e+00> : tensor<f32>
+    %10 = stablehlo.reduce(%9 init: %cst) applies stablehlo.add across dimensions = [0, 1, 2] : (tensor<16x64x8192xf32>, tensor<f32>) -> tensor<f32>
+    return %10 : tensor<f32>
+  }
+}

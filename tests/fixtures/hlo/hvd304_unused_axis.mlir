@@ -1,0 +1,8 @@
+module @jit_f attributes {mhlo.num_partitions = 8 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<512x512xf32> {mhlo.sharding = "{devices=[2,1,4]<=[8] last_tile_dim_replicate}"}, %arg1: tensor<512x1024xf32> {mhlo.sharding = "{replicated}"}) -> (tensor<512x1024xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<512x512xf32>, tensor<512x1024xf32>) -> tensor<512x1024xf32>
+    %1 = stablehlo.custom_call @Sharding(%0) {backend_config = "", mhlo.sharding = "{devices=[2,1,4]<=[8] last_tile_dim_replicate}"} : (tensor<512x1024xf32>) -> tensor<512x1024xf32>
+    %2 = stablehlo.tanh %1 : tensor<512x1024xf32>
+    return %2 : tensor<512x1024xf32>
+  }
+}

@@ -1,0 +1,36 @@
+module @jit_local attributes {mhlo.num_partitions = 8 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<1024x512xf32>) -> (tensor<1024x512xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.custom_call @Sharding(%arg0) {backend_config = "", mhlo.sharding = "{replicated}"} : (tensor<1024x512xf32>) -> tensor<1024x512xf32>
+    %1 = stablehlo.custom_call @SPMDFullToShardShape(%0) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<1024x512xf32>) -> tensor<1024x512xf32>
+    %2 = call @shmap_body(%1) : (tensor<1024x512xf32>) -> tensor<128x512xf32>
+    %3 = stablehlo.custom_call @Sharding(%2) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<128x512xf32>) -> tensor<128x512xf32>
+    %4 = stablehlo.custom_call @SPMDShardToFullShape(%3) {backend_config = "", mhlo.sharding = "{devices=[8,1]<=[8]}"} : (tensor<128x512xf32>) -> tensor<1024x512xf32>
+    return %4 : tensor<1024x512xf32>
+  }
+  func.func private @shmap_body(%arg0: tensor<1024x512xf32>) -> (tensor<128x512xf32> {jax.result_info = "[('hvd',), None]"}) {
+    %0 = "stablehlo.all_reduce"(%arg0) <{channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>, replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>, use_global_device_ids}> ({
+    ^bb0(%arg1: tensor<f32>, %arg2: tensor<f32>):
+      %12 = stablehlo.add %arg1, %arg2 : tensor<f32>
+      stablehlo.return %12 : tensor<f32>
+    }) : (tensor<1024x512xf32>) -> tensor<1024x512xf32>
+    %c = stablehlo.constant dense<1> : tensor<ui32>
+    %c_0 = stablehlo.constant dense<8> : tensor<ui32>
+    %1 = stablehlo.partition_id : tensor<ui32>
+    %2 = stablehlo.divide %1, %c : tensor<ui32>
+    %3 = stablehlo.remainder %2, %c_0 : tensor<ui32>
+    %4 = stablehlo.convert %3 : (tensor<ui32>) -> tensor<i32>
+    %c_1 = stablehlo.constant dense<128> : tensor<i32>
+    %5 = stablehlo.multiply %4, %c_1 : tensor<i32>
+    %c_2 = stablehlo.constant dense<0> : tensor<i32>
+    %6 = stablehlo.compare  LT, %5, %c_2,  SIGNED : (tensor<i32>, tensor<i32>) -> tensor<i1>
+    %c_3 = stablehlo.constant dense<1024> : tensor<i32>
+    %7 = stablehlo.add %5, %c_3 : tensor<i32>
+    %8 = stablehlo.select %6, %7, %5 : tensor<i1>, tensor<i32>
+    %c_4 = stablehlo.constant dense<512> : tensor<i32>
+    %9 = stablehlo.add %c_2, %c_4 : tensor<i32>
+    %c_5 = stablehlo.constant dense<false> : tensor<i1>
+    %10 = stablehlo.select %c_5, %9, %c_2 : tensor<i1>, tensor<i32>
+    %11 = stablehlo.dynamic_slice %0, %8, %10, sizes = [128, 512] : (tensor<1024x512xf32>, tensor<i32>, tensor<i32>) -> tensor<128x512xf32>
+    return %11 : tensor<128x512xf32>
+  }
+}

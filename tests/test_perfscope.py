@@ -543,7 +543,14 @@ def _conv_stamps(mode="nhwc_padded"):
     """The conv-fast-path stamps bench sections carry (docs/perf.md)."""
     return {"layout": {"mode": mode},
             "input_pipeline": {"mode": "device_double_buffered",
-                               "depth": 2}}
+                               "depth": 2},
+            **_memory_stamp()}
+
+
+def _memory_stamp(static=64 << 20):
+    """The per-section static peak-HBM stamp (ISSUE 13): required
+    whenever the section's XLA cost analysis ran (mfu_source=xla)."""
+    return {"memory": {"static_peak_device_bytes": static}}
 
 
 def test_perf_gate_bench_mode(fresh):
@@ -561,8 +568,11 @@ def test_perf_gate_conv_section_requires_stamps(fresh):
     errs = perf_gate.check_bench(doc)
     assert any("layout stamp missing" in e for e in errs)
     assert any("input_pipeline" in e for e in errs)
-    # non-conv sections carry no such obligation
-    doc = {"extra": {"transformer_lm": {"perfscope": _gate_profile()}}}
+    # ...and without a memory stamp (ISSUE 13): also structural
+    assert any("memory stamp missing" in e for e in errs)
+    # non-conv sections carry the memory obligation but no conv stamps
+    doc = {"extra": {"transformer_lm": {"perfscope": _gate_profile(),
+                                        **_memory_stamp()}}}
     assert perf_gate.check_bench(doc) == []
 
 
