@@ -55,6 +55,14 @@
 #                    the static gate in front of the GSPMD backend
 #                    (replicated tables, partitioner-inserted
 #                    resharding, compile-time OOM)
+#   make gspmd-smoke GSPMD hybrid-parallel backend (docs/parallelism.md):
+#                    hybrid-vs-DP loss-trajectory numerics on the
+#                    8-device mesh (tp=4 x dp=2, moe and pipeline axis
+#                    variants) incl. the slow-marked canonical-program
+#                    lowering tests, and a 2-process mesh/sharding-
+#                    decision agreement scenario under
+#                    HOROVOD_CHECK_COLLECTIVES=1 (the runtime
+#                    lm_runtime step is CLI-gated in `make shard-lint`)
 #   make race        hvdrace: the concurrency/hammer suites (timeline,
 #                    metrics, elastic driver, rendezvous KV, verifier)
 #                    run under the runtime lockset race detector
@@ -66,9 +74,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline metrics race doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate
 
-test: lint hlo-lint shard-lint test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate entry
+test: lint hlo-lint shard-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -190,6 +198,11 @@ shard-lint:
 	    HOROVOD_HLO_LINT_HBM_BUDGET=1G \
 	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_sharded \
 	    --baseline scripts/hvdshard_baseline.json
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    HOROVOD_HLO_LINT_HBM_BUDGET=1G \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_runtime \
+	    --baseline scripts/hvdshard_baseline.json
 
 shard-lint-baseline:
 	env JAX_PLATFORMS=cpu \
@@ -197,6 +210,18 @@ shard-lint-baseline:
 	    HOROVOD_HLO_LINT_HBM_BUDGET=1G \
 	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_sharded \
 	    --format json > scripts/hvdshard_baseline.json || true
+
+# GSPMD hybrid-parallel backend (docs/parallelism.md): the hybrid-vs-DP
+# numerics suite on the 8-device CPU mesh (tp=4 x dp=2 loss trajectory
+# matches the pure-DP run within documented tolerance; moe/pipeline
+# axis variants match their dense/unsplit references) INCLUDING the
+# slow-marked canonical-program lm_runtime lowering tests tier-1
+# skips, and the 2-process mesh/sharding-decision agreement scenario
+# under the fingerprint verifier. (The lm_runtime CLI gate itself
+# lives in `make shard-lint` — not duplicated here.)
+gspmd-smoke:
+	$(PYTEST) tests/test_gspmd.py --run-slow
+	$(PYTEST) tests/test_multiprocess.py -k mesh_shard_sync
 
 # The warm-compile-cache test is a wall-clock subprocess benchmark, not
 # a concurrency test — load-sensitive, and none of its work runs through

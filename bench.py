@@ -1214,6 +1214,192 @@ def _lm_overlap_section(cfg):
     return out
 
 
+# --------------------------------------------------------------------------
+# GSPMD hybrid-parallel backend: the 8-device scaling bench
+# (docs/parallelism.md; ROADMAP item 3). Pure-DP vs tp=4 x dp=2 on the
+# SAME global batch through the SAME DistributedOptimizer sharded-step
+# builder, reporting per-model throughput and scaling efficiency as
+# structured JSON plus the per-axis (dp vs tp) comms split, the shard
+# lint of the runtime program, and the static memory stamp. Runs on the
+# 8-device virtual CPU mesh in a subprocess (single attached TPU chips
+# cannot host a 2-D mesh; on the virtual mesh every rank runs the
+# identical shard_map/XLA path a pod runs). NOTE on the numbers: the 8
+# virtual devices share one host's cores, so absolute scaling
+# efficiency is pessimistic there — the section's contract is the
+# REPORTING pipeline (mesh/scaling/comms stamps, gated structurally by
+# scripts/perf_gate.py); a real 8-chip slice fills in the real ratio.
+# --------------------------------------------------------------------------
+
+def _gspmd_variant(label, mesh_spec_text, pspecs_fn, cfg, batch, seq,
+                   steps, want_analysis=False):
+    """Train the tied LM on one mesh config and time it. Returns the
+    per-variant result dict (+ lowered/compiled handles for stamps)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import tied_lm
+    from horovod_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    spec = MeshSpec.parse(mesh_spec_text, None)
+    mesh = build_mesh(spec, devices=jax.devices()[:spec.total])
+    dist = hvd.DistributedOptimizer(
+        optax.sgd(0.01), sharding_spec=pspecs_fn(cfg), mesh=mesh)
+    step = dist.sharded_step(
+        lambda p, b: tied_lm.local_loss(p, b[0], b[1], cfg),
+        donate=False)
+    params = dist.shard_params(tied_lm.init(0, cfg))
+    tok, tgt = tied_lm.sample_batch(1, cfg, batch=batch, seq=seq)
+    b = jax.device_put((tok, tgt), NamedSharding(mesh, P("dp")))
+    st = dist.init(params)
+
+    lowered = compiled = None
+    run_fn = step
+    if want_analysis:
+        # ONE AOT lower+compile feeds the comms/memory/lint stamps AND
+        # the timed loop (the _scan_timed recipe: analysis rides a
+        # compile the bench pays for anyway).
+        try:
+            lowered = step.lower(params, st, b)
+            compiled = lowered.compile()
+            run_fn = lambda p, s, bb: compiled(p, s, bb)  # noqa: E731
+        except Exception:
+            lowered = compiled = None
+
+    loss = None
+    for _ in range(2):
+        params, st, loss = run_fn(params, st, b)
+    jax.block_until_ready(loss)
+
+    def timed(ncalls):
+        nonlocal params, st, loss
+        t0 = time.perf_counter()
+        for _ in range(ncalls):
+            params, st, loss = run_fn(params, st, b)
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / ncalls
+
+    xs = sorted(timed(max(steps // 3, 2)) for _ in range(3))
+    sec = xs[1]
+    if want_analysis:
+        # Perfscope-sampled steps on the same executable, so the
+        # section carries a full StepProfile (incl. the trace-time
+        # comms_axes split the sharded reduction recorded).
+        ps = pscope.get()
+        for _ in range(3):
+            with ps.step():
+                params, st, loss = run_fn(params, st, b)
+                with ps.phase("device_compute"):
+                    jax.block_until_ready(loss)
+    toks = batch * seq
+    return {
+        "mesh": {"spec": spec.describe(), "devices": spec.total,
+                 "shape": {a: int(s) for a, s in
+                           zip(mesh.axis_names, mesh.devices.shape)
+                           if int(s) > 1}},
+        "steps_per_sec": round(1.0 / sec, 3),
+        "tokens_per_sec": round(toks / sec, 1),
+        "step_ms": round(sec * 1e3, 2),
+        "global_batch": batch, "seq": seq,
+        "final_loss": round(float(loss), 4),
+    }, spec, lowered, compiled
+
+
+def _gspmd_cpu_mesh_child():
+    """Child-process body (bench.py --gspmd-cpu-mesh): the hybrid
+    scaling section on the 8-device CPU mesh; prints one JSON line."""
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+        raise SystemExit(
+            "--gspmd-cpu-mesh needs JAX_PLATFORMS=cpu and "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(run through bench.py's bench_gspmd_hybrid wrapper)")
+    from horovod_tpu.models import tied_lm
+    from horovod_tpu.parallel.mesh import AXIS_ORDER
+    from horovod_tpu.analysis import shard as shard_mod
+
+    cfg = tied_lm.canonical_config()
+    B, S, steps = 64, 64, 9
+    ps = pscope.get()
+    ps.reset()
+
+    dp1, _, _, _ = _gspmd_variant(
+        "dp1", "dp=1", tied_lm.replicated_specs, cfg, B // 8, S, steps)
+    dp8, _, _, _ = _gspmd_variant(
+        "dp8", "dp=8", tied_lm.replicated_specs, cfg, B, S, steps)
+    ps.reset()  # hybrid's trace-time comms_axes must not mix with DP's
+    hybrid, spec, lowered, compiled = _gspmd_variant(
+        "hybrid", "dp=2,tp=4", tied_lm.param_specs, cfg, B, S, steps,
+        want_analysis=True)
+
+    result = {
+        "platform": f"{len(jax.devices())}-device virtual CPU mesh "
+                    "(subprocess; devices share host cores — scaling "
+                    "ratios are pessimistic here, the stamps are the "
+                    "contract)",
+        "model": f"tied_lm V{cfg.vocab} D{cfg.d_model} F{cfg.d_ff} "
+                 f"L{cfg.n_layers} f32",
+        "dp1": dp1, "dp8": dp8, "hybrid": hybrid,
+        "mesh": hybrid["mesh"],
+        "scaling": {
+            "dp_tokens_per_sec": dp8["tokens_per_sec"],
+            "hybrid_tokens_per_sec": hybrid["tokens_per_sec"],
+            "efficiency_vs_dp": round(
+                hybrid["tokens_per_sec"] / dp8["tokens_per_sec"], 3),
+            "dp1_tokens_per_sec": dp1["tokens_per_sec"],
+            "dp_scaling_efficiency": round(
+                dp8["tokens_per_sec"] / (8 * dp1["tokens_per_sec"]), 3),
+            "convention": "weak scaling (fixed per-dp-shard batch); "
+                          "efficiency_vs_dp = hybrid/dp throughput on "
+                          "the same global batch",
+        },
+    }
+    if compiled is not None:
+        text = compiled.as_text()
+        try:
+            result["comms_by_axis"] = shard_mod.comms_by_axis(
+                text, list(zip(AXIS_ORDER, spec.sizes())))
+        except Exception as e:
+            result["comms_by_axis_error"] = _err_str(e)
+        result["memory"] = _memory_stamp(compiled)
+        try:
+            result["shard_lint"] = {
+                "findings": len(shard_mod.lint_text(text,
+                                                    path="<gspmd>")),
+            }
+        except Exception:
+            pass
+        flops_info = {}
+        total = F.compiled_cost_flops(compiled)
+        if total:
+            flops_info["program_flops_per_step"] = total
+        s = ps.summary()
+        _perf_stamp(result, "gspmd_hybrid", flops_info,
+                    {"summary": s} if s else {}, None)
+    print(json.dumps(result), flush=True)
+
+
+def bench_gspmd_hybrid(timeout=1800):
+    """Parent wrapper: run the GSPMD hybrid scaling section in a
+    CPU-mesh subprocess (single attached chips cannot host the 2-D
+    mesh; see the block comment above)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--gspmd-cpu-mesh"],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"gspmd-cpu-mesh subprocess failed rc={out.returncode}: "
+            f"{out.stderr[-500:]}")
+    return json.loads(lines[-1])
+
+
 def bench_eager_cpu_mesh(timeout=1500):
     """Parent wrapper: run the eager fusion/autotune sections in a CPU-mesh
     subprocess (see block comment above; reference knob:
@@ -1465,6 +1651,10 @@ def main():
         fusion["workload"] = eager["workload"]
     if autotune is not None:
         autotune["platform"] = eager["platform"]
+    # GSPMD hybrid-parallel scaling section (docs/parallelism.md): DP
+    # vs tp=4 x dp=2 on the 8-device CPU-mesh subprocess — no window
+    # stamp, it never touches the TPU/tunnel.
+    gspmd = _section("gspmd_hybrid", bench_gspmd_hybrid)
     flash = None if on_cpu else stamp(
         _section("flash_attention", bench_flash_attention),
         "flash_attention")
@@ -1496,6 +1686,7 @@ def main():
             "transformer_lm": tr,
             "bert_base_finetune": bert,
             "fusion_sweep_grouped_allreduce": fusion,
+            "gspmd_hybrid": gspmd,
             "lm_overlap_train_step": lm_overlap,
             "autotune": autotune,
             "flash_attention_s8192": flash,
@@ -1509,6 +1700,9 @@ if __name__ == "__main__":
     import sys as _sys
     if "--eager-cpu-mesh" in _sys.argv:
         _eager_cpu_mesh_child()
+        raise SystemExit(0)
+    if "--gspmd-cpu-mesh" in _sys.argv:
+        _gspmd_cpu_mesh_child()
         raise SystemExit(0)
     try:
         main()

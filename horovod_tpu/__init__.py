@@ -24,14 +24,14 @@ from horovod_tpu.common.exceptions import (  # noqa: F401
 )
 from horovod_tpu.core.topology import (  # noqa: F401
     ccl_built, cross_rank, cross_size, cuda_built, ddl_built, gloo_built,
-    gloo_enabled, init, is_homogeneous, is_initialized, local_rank,
-    local_size, local_slot_ranks, mesh, mpi_built, mpi_enabled,
-    mpi_threads_supported, nccl_built, rank, rocm_built, shutdown, size,
-    tpu_built,
+    gloo_enabled, hybrid_mesh, init, is_homogeneous, is_initialized,
+    local_rank, local_size, local_slot_ranks, mesh, mesh_spec, mpi_built,
+    mpi_enabled, mpi_threads_supported, nccl_built, rank, rocm_built,
+    shutdown, size, tpu_built,
 )
 from horovod_tpu.core.process_sets import (  # noqa: F401
-    ProcessSet, add_process_set, get_process_set, global_process_set,
-    remove_process_set,
+    ProcessSet, add_process_set, axis_process_set, get_process_set,
+    global_process_set, remove_process_set,
 )
 from horovod_tpu.ops.collectives import (  # noqa: F401
     allgather, allgather_async, allreduce, allreduce_async, alltoall,

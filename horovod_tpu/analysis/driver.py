@@ -327,15 +327,18 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                              "combine with --hlo to run both families "
                              "over the same dumps")
     parser.add_argument("--hlo-step", default=None, metavar="PROGRAM",
-                        choices=("lm", "resnet_block", "lm_sharded"),
+                        choices=("lm", "resnet_block", "lm_sharded",
+                                 "lm_runtime"),
                         help="hvdhlo mode: lower the named canonical "
                              "step program under the current fusion/"
                              "layout config on the virtual CPU mesh "
                              "and lint it (the `make hlo-lint` / "
                              "`make conv-smoke` / `make shard-lint` "
                              "CI gates); lm_sharded lints the 2-D "
-                             "(batch x model) mesh program under BOTH "
-                             "rule families, pre- and post-SPMD")
+                             "(batch x model) mesh GSPMD program and "
+                             "lm_runtime the DistributedOptimizer-"
+                             "driven hybrid runtime step, both under "
+                             "BOTH rule families, pre- and post-SPMD")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule IDs to run (default all)")
     parser.add_argument("--ignore", default="",
@@ -399,19 +402,25 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                     args.paths, select=select, ignore=ignore))
             if args.hlo and args.shard:
                 findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
-            if args.hlo_step == "lm_sharded":
-                # The 2-D-mesh gate lints BOTH textual forms: the
+            if args.hlo_step in ("lm_sharded", "lm_runtime"):
+                # The 2-D-mesh gates lint BOTH textual forms: the
                 # HVD2xx program rules on the pre-partition MLIR
                 # (global shapes) and the HVD3xx sharding/memory rules
                 # on both it and the post-SPMD module (per-device
-                # shapes + schedule).
+                # shapes + schedule). lm_sharded is the GSPMD
+                # (annotation-driven) twin; lm_runtime lowers the
+                # DistributedOptimizer-driven hybrid step the backend
+                # actually executes.
+                lower_fn = (shard_mod.lower_sharded_step_texts
+                            if args.hlo_step == "lm_sharded"
+                            else shard_mod.lower_runtime_step_texts)
                 try:
-                    texts = shard_mod.lower_sharded_step_texts()
+                    texts = lower_fn()
                 except Exception as e:
                     print(f"hvdshard: cannot lower step program "
-                          f"'lm_sharded': {e}", file=sys.stderr)
+                          f"{args.hlo_step!r}: {e}", file=sys.stderr)
                     return 2
-                base = hlo_mod.step_path("lm_sharded")
+                base = hlo_mod.step_path(args.hlo_step)
                 findings.extend(hlo_mod.lint_text(
                     texts["stablehlo"], path=base,
                     select=select, ignore=ignore))
@@ -440,15 +449,16 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
             # the driver's error convention is one line + exit 2
             # (lowering failures, unreadable baselines), never a
             # traceback that exits 1 as if findings were found.
-            name = ("hvdshard" if args.shard
-                    or args.hlo_step == "lm_sharded" else "hvdhlo")
+            name = ("hvdshard" if args.shard or args.hlo_step
+                    in ("lm_sharded", "lm_runtime") else "hvdhlo")
             print(f"{name}: {e}", file=sys.stderr)
             return 2
     else:
         findings = lint_paths(args.paths, select=select, ignore=ignore,
                               root=root, env_rule=not args.no_env)
     matched = 0
-    shard_mode = args.shard or args.hlo_step == "lm_sharded"
+    shard_mode = args.shard or args.hlo_step in ("lm_sharded",
+                                                 "lm_runtime")
     name = ("hvdshard" if shard_mode
             else "hvdhlo" if hlo_mode else "hvdlint")
     if args.baseline is not None:

@@ -307,6 +307,154 @@ def traceable_to_user_collective(op: HloOp) -> bool:
     return last in USER_COLLECTIVE_MARKERS
 
 
+# ------------------------------------- per-axis comms attribution
+
+#: Collective opcodes whose wire traffic the per-axis attribution
+#: accounts (post-SPMD HLO names).
+_COMMS_OPCODES = frozenset({
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "collective_permute",
+})
+
+_REPLICA_GROUPS_LIST_RE = re.compile(
+    r"replica_groups=\{((?:\{[^{}]*\},?)*)\}")
+_REPLICA_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_SOURCE_TARGET_RE = re.compile(
+    r"source_target_pairs=\{((?:\{[^{}]*\},?)*)\}")
+
+
+def _parse_replica_groups(attrs: str,
+                          num_devices: int) -> Optional[List[List[int]]]:
+    """The device-id groups one collective communicates over, from
+    either textual form XLA prints: the explicit
+    ``replica_groups={{0,1},{2,3}}`` list, the V2 iota
+    ``replica_groups=[2,4]<=[8]`` form, or (collective-permute)
+    ``source_target_pairs`` — whose connected components are the
+    communicating sets. ``replica_groups={}`` / absent means one group
+    of every device."""
+    m = _REPLICA_GROUPS_IOTA_RE.search(attrs)
+    if m:
+        printed = [int(d) for d in m.group(1).split(",") if d]
+        reshape = [int(d) for d in m.group(2).split(",") if d]
+        perm = ([int(p) for p in m.group(3).split(",") if p]
+                if m.group(3) else list(range(len(reshape))))
+        if sorted(perm) != list(range(len(reshape))):
+            return None
+        flat = _iota_order(reshape, perm)
+        if len(printed) != 2 or printed[0] * printed[1] != len(flat):
+            return None
+        g = printed[1]
+        return [flat[i:i + g] for i in range(0, len(flat), g)]
+    m = _REPLICA_GROUPS_LIST_RE.search(attrs)
+    if m:
+        inner = m.group(1)
+        if not inner.strip():
+            return [list(range(num_devices))]
+        return [[int(x) for x in grp.strip("{}").split(",") if x.strip()]
+                for grp in re.findall(r"\{[^{}]*\}", inner)]
+    m = _SOURCE_TARGET_RE.search(attrs)
+    if m:
+        pairs = [tuple(int(x) for x in grp.strip("{}").split(","))
+                 for grp in re.findall(r"\{[^{}]*\}", m.group(1))]
+        # Union-find over the permute graph: the communicating sets.
+        parent = list(range(num_devices))
+
+        def find(a):
+            while parent[a] != a:
+                parent[a] = parent[parent[a]]
+                a = parent[a]
+            return a
+
+        for s, t in pairs:
+            if 0 <= s < num_devices and 0 <= t < num_devices:
+                parent[find(s)] = find(t)
+        comps: Dict[int, List[int]] = {}
+        touched = {d for p in pairs for d in p}
+        for d in sorted(touched):
+            comps.setdefault(find(d), []).append(d)
+        return list(comps.values()) or None
+    if "replica_groups" in attrs:
+        return None
+    return [list(range(num_devices))]
+
+
+def _axis_partitions(axis_sizes: Sequence[Tuple[str, int]]
+                     ) -> Dict[frozenset, str]:
+    """Canonical device-id partition -> axis label, for every non-empty
+    subset of the size>1 axes. Devices are flat C-order indices over
+    `axis_sizes` (outermost first) — exactly `build_mesh`'s device
+    order, so flat index == Horovod rank == SPMD partition id."""
+    sizes = [s for _, s in axis_sizes]
+    names = [a for a, _ in axis_sizes]
+    live = [i for i, s in enumerate(sizes) if s > 1]
+    strides = [1] * len(sizes)
+    for i in range(len(sizes) - 2, -1, -1):
+        strides[i] = strides[i + 1] * sizes[i + 1]
+    out: Dict[frozenset, str] = {}
+    for r in range(1, len(live) + 1):
+        for subset in itertools.combinations(live, r):
+            moving = list(subset)
+            fixed = [i for i in range(len(sizes)) if i not in subset]
+            groups = []
+            for fcoord in itertools.product(
+                    *(range(sizes[i]) for i in fixed)):
+                base = sum(c * strides[i]
+                           for c, i in zip(fcoord, fixed))
+                groups.append(frozenset(
+                    base + sum(c * strides[i]
+                               for c, i in zip(mcoord, moving))
+                    for mcoord in itertools.product(
+                        *(range(sizes[i]) for i in moving))))
+            label = "+".join(names[i] for i in moving)
+            out[frozenset(groups)] = label
+    return out
+
+
+def comms_by_axis(text: str, axis_sizes: Sequence[Tuple[str, int]],
+                  path: str = "<compiled>") -> Dict[str, Dict[str, object]]:
+    """Attribute every collective's payload bytes in a post-SPMD module
+    to the mesh axis (or axis combination) its replica groups span —
+    the static dp-vs-tp wire-traffic split of the hybrid backend
+    (docs/parallelism.md; the perfscope/bench ``comms_by_axis`` stamp).
+
+    `axis_sizes`: ordered (axis, size) pairs outermost-first — i.e.
+    ``zip(AXIS_ORDER, MeshSpec.sizes())``. Groups that match no single
+    axis partition land under the joined label ("dp+tp" = a collective
+    over the whole mesh); unclassifiable groups land under "other".
+    Returns ``{label: {"bytes_per_step", "ops", "by_op"}}``.
+    """
+    prog = parse(text, path)
+    ndev = 1
+    for _, s in axis_sizes:
+        ndev *= s
+    partitions = _axis_partitions(axis_sizes)
+    # Singleton groups (a one-device "collective") carry no traffic.
+    out: Dict[str, Dict[str, object]] = {}
+    from horovod_tpu.analysis import hlo_rules
+    for op in prog.ops:
+        if op.opcode not in _COMMS_OPCODES:
+            continue
+        groups = _parse_replica_groups(op.attrs, ndev)
+        if groups is None:
+            label = "other"
+        else:
+            norm = frozenset(frozenset(g) for g in groups if len(g) > 1)
+            if not norm:
+                continue  # degenerate single-device groups: no wire
+            label = partitions.get(norm, "other")
+        nb = hlo_rules._collective_payload(op)
+        if nb is None:
+            nb = _result_bytes(op)
+        ent = out.setdefault(label, {"bytes_per_step": 0, "ops": 0,
+                                     "by_op": {}})
+        ent["bytes_per_step"] += int(nb or 0)
+        ent["ops"] += 1
+        by = ent["by_op"]
+        by[op.opcode] = by.get(op.opcode, 0) + int(nb or 0)
+    return out
+
+
 # ------------------------------------------- per-device peak-HBM model
 
 #: Result-aliases-operand opcodes: no new buffer is materialized.
@@ -637,9 +785,9 @@ def lower_sharded_step_texts(replicated: Optional[bool] = None
     from horovod_tpu.analysis.hlo import _force_cpu_mesh
     jax = _force_cpu_mesh()
     import jax.numpy as jnp
-    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from horovod_tpu.models import tied_lm
     from horovod_tpu.parallel.mesh import MeshSpec, build_mesh
 
     ndev = len(jax.devices())
@@ -649,48 +797,85 @@ def lower_sharded_step_texts(replicated: Optional[bool] = None
     def sh(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    if replicated:
-        s_emb = s_wi = s_wo = sh()
-    else:
-        s_emb = sh("tp", None)       # vocab-sharded embedding table
-        s_wi = sh(None, "tp")        # column-parallel FFN in
-        s_wo = sh("tp", None)        # row-parallel FFN out
+    cfg = tied_lm.canonical_config()
+    # The runtime model (models/tied_lm.py) supplies params AND layout:
+    # the GSPMD twin and the DistributedOptimizer-driven runtime step
+    # (lower_runtime_step_texts) lint the same shapes by construction.
+    params = tied_lm.init(0, cfg)
+    pspecs = (tied_lm.replicated_specs(cfg) if replicated
+              else tied_lm.param_specs(cfg))
+    shardings = {k: NamedSharding(mesh, s) for k, s in pspecs.items()}
     s_tok = sh("dp", None)
     s_logits = sh("dp", None, "tp")
 
-    D, F, V, NL = 512, 2048, 8192, 2
-    B, S = 16, 64
-    rng = np.random.default_rng(0)
-    params = {"emb": jnp.asarray(
-        rng.standard_normal((V, D)) * 0.02, jnp.float32)}
-    shardings = {"emb": s_emb}
-    for i in range(NL):
-        params[f"wi{i}"] = jnp.asarray(
-            rng.standard_normal((D, F)) * 0.02, jnp.float32)
-        params[f"wo{i}"] = jnp.asarray(
-            rng.standard_normal((F, D)) * 0.02, jnp.float32)
-        shardings[f"wi{i}"] = s_wi
-        shardings[f"wo{i}"] = s_wo
-
     def loss(p, tok, tgt):
-        h = p["emb"][tok]
-        for i in range(NL):
-            h = h + jnp.tanh(h @ p[f"wi{i}"]) @ p[f"wo{i}"]
-        logits = h @ p["emb"].T    # tied embedding: vocab-parallel
-        logits = jax.lax.with_sharding_constraint(logits, s_logits)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], -1))
+        return tied_lm.global_loss(
+            p, tok, tgt, cfg,
+            constrain_logits=lambda lg:
+                jax.lax.with_sharding_constraint(lg, s_logits))
 
     def step(p, tok, tgt):
         g = jax.grad(loss)(p, tok, tgt)
         return jax.tree_util.tree_map(lambda a, b: a - 0.01 * b, p, g)
 
-    tok = jnp.asarray(rng.integers(0, V, (B, S)))
-    tgt = jnp.roll(tok, -1, axis=1)
+    tok, tgt = tied_lm.sample_batch(0, cfg, batch=16, seq=64)
+    tok, tgt = jnp.asarray(tok), jnp.asarray(tgt)
     jit = jax.jit(step, in_shardings=(shardings, s_tok, s_tok),
                   out_shardings=shardings, donate_argnums=0)
     lowered = jit.lower(
         jax.device_put(params, shardings),
         jax.device_put(tok, s_tok), jax.device_put(tgt, s_tok))
+    return {"stablehlo": lowered.as_text(),
+            "hlo": lowered.compile().as_text()}
+
+
+def lower_runtime_step_texts(replicated: Optional[bool] = None
+                             ) -> Dict[str, str]:
+    """Both textual forms of the RUNTIME hybrid train step — the
+    program the GSPMD backend actually executes, gated by
+    ``--hlo-step lm_runtime`` inside ``make shard-lint`` /
+    ``make gspmd-smoke``.
+
+    Where ``lower_sharded_step_texts`` is the GSPMD (annotation-driven)
+    twin, this lowers the `DistributedOptimizer.sharded_step` path
+    itself: `models/tied_lm.local_loss` under shard_map on the
+    ``MeshSpec.infer(8, tp=4)`` mesh, gradients bucketed per axis group
+    by `reduce_gradients_in_jit(axes=...)` and psum'd over ``dp`` only,
+    optax SGD applied under GSPMD. Default config must lint HVD2xx +
+    HVD3xx clean against the empty baseline; the replicated twin
+    (`replicated=True` / HOROVOD_SHARD_LINT_REPLICATED=1 — params
+    stored AND stepped fully replicated, the 'forgot the spec' runtime
+    failure) trips HVD301 on the 16 MB embedding, while the GSPMD
+    twin's forced-replication continues to pin HVD302's
+    partitioner-inserted all-gather.
+    """
+    if replicated is None:
+        replicated = replicated_twin_forced()
+    from horovod_tpu.analysis.hlo import _force_cpu_mesh
+    jax = _force_cpu_mesh()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import optax
+
+    from horovod_tpu.models import tied_lm
+    from horovod_tpu.optim.optimizer import build_sharded_train_step
+    from horovod_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    ndev = len(jax.devices())
+    tp = 4 if ndev % 4 == 0 else 2
+    mesh = build_mesh(MeshSpec.infer(ndev, tp=tp))
+    cfg = tied_lm.canonical_config()
+    pspecs = (tied_lm.replicated_specs(cfg) if replicated
+              else tied_lm.param_specs(cfg))
+    params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tied_lm.init(0, cfg), pspecs)
+    opt = optax.sgd(0.01)
+    step = build_sharded_train_step(
+        lambda p, b: tied_lm.local_loss(p, b[0], b[1], cfg),
+        opt, mesh=mesh, param_specs=pspecs)
+    batch = jax.device_put(tied_lm.sample_batch(0, cfg, batch=16, seq=64),
+                           NamedSharding(mesh, P("dp")))
+    lowered = step.lower(params, opt.init(params), batch)
     return {"stablehlo": lowered.as_text(),
             "hlo": lowered.compile().as_text()}

@@ -137,6 +137,10 @@ HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
 HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
 
 # TPU-native knobs (new).
+# GSPMD hybrid-parallel mesh authority (docs/parallelism.md): named-axis
+# sizes over the canonical dp/pp/ep/sp/tp order, e.g. "dp=2,tp=4";
+# parallel/mesh.MeshSpec.parse owns the grammar. Unset = pure DP.
+HOROVOD_MESH = "HOROVOD_MESH"
 HOROVOD_TPU_MESH_SHAPE = "HOROVOD_TPU_MESH_SHAPE"          # e.g. "dcn:4,ici:8"
 HOROVOD_TPU_EMULATE_RANKS = "HOROVOD_TPU_EMULATE_RANKS"    # force N virtual ranks
 HOROVOD_TPU_DONATE_BUFFERS = "HOROVOD_TPU_DONATE_BUFFERS"  # in-place eager collectives
@@ -245,6 +249,8 @@ class Config:
 
     # TPU
     mesh_shape: str = ""
+    # HOROVOD_MESH hybrid-parallel spec ("dp=2,tp=4"); empty = pure DP.
+    mesh_spec: str = ""
     emulate_ranks: int = 0
     compile_cache_dir: str = ""
 
@@ -342,6 +348,7 @@ class Config:
             rendezvous_addr=os.environ.get(HOROVOD_RENDEZVOUS_ADDR, ""),
             rendezvous_port=_env_int(HOROVOD_RENDEZVOUS_PORT, 0),
             mesh_shape=os.environ.get(HOROVOD_TPU_MESH_SHAPE, ""),
+            mesh_spec=os.environ.get(HOROVOD_MESH, "").strip(),
             emulate_ranks=_env_int(HOROVOD_TPU_EMULATE_RANKS, 0),
             compile_cache_dir=os.environ.get(HOROVOD_TPU_COMPILE_CACHE, ""),
         )

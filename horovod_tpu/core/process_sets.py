@@ -40,6 +40,10 @@ class ProcessSet:
         self.process_set_id: Optional[int] = None
         self.mesh: Optional[Mesh] = None
         self._axis = "hvd"
+        #: Named mesh axis this set is the sub-communicator of (set by
+        #: axis_process_set; None for hand-built rank lists). Collective
+        #: instrumentation labels per-axis traffic with it.
+        self.mesh_axis: Optional[str] = None
 
     def included(self) -> bool:
         """Is the current process a member? (reference: ProcessSet.included)"""
@@ -190,3 +194,46 @@ def remove_process_set(ps: ProcessSet) -> None:
 
 def get_process_set(process_set_id: int) -> ProcessSet:
     return _ps_table().get(process_set_id)
+
+
+def axis_process_set(axis: str, rank: Optional[int] = None) -> ProcessSet:
+    """The process set for `rank`'s sub-communicator along a named axis
+    of the HOROVOD_MESH hybrid mesh (docs/parallelism.md).
+
+    With HOROVOD_MESH="dp=2,tp=4", rank 5 sits at mesh coordinate
+    (dp=1, tp=1); its `dp` set is ranks [1, 5] (the column sharing its
+    tp index) and its `tp` set is ranks [4..7] (its row). This is the
+    axis↔process-set mapping the reference expresses as NCCL
+    sub-communicators per process set (process_set.cc): gradient
+    allreduce rides the `dp` set while `tp` traffic stays inside the
+    model sub-mesh.
+
+    Registration bypasses HOROVOD_DYNAMIC_PROCESS_SETS deliberately:
+    the sets are a deterministic function of the static mesh spec every
+    process agrees on at init — there is nothing dynamic to coordinate
+    (the table dedupes identical rank lists, so repeated lookups share
+    one registered id and compiled sub-mesh).
+
+    Returns a HANDLE tagged with `axis` rather than the table's shared
+    object: two size-1 axes (or a hand-built set with the same ranks)
+    dedupe to one registered id, and tagging the shared object would
+    let the later lookup relabel the earlier handle's metrics — each
+    handle keeps its own `mesh_axis` while sharing id, mesh, and
+    cache_token (the executable cache keys on ranks, not identity).
+    """
+    from horovod_tpu.core import topology
+    spec = topology.mesh_spec()
+    if spec is None:
+        raise HorovodTpuError(
+            "axis_process_set requires a hybrid mesh: set HOROVOD_MESH "
+            "(e.g. \"dp=2,tp=4\") before hvd.init()")
+    if rank is None:
+        rank = topology.rank()
+    group = spec.group_of(axis, rank)
+    reg = ProcessSet(group)
+    _ps_table().register(reg)  # fills id + sub-mesh (dedupe-aware)
+    handle = ProcessSet(group)
+    handle.process_set_id = reg.process_set_id
+    handle.mesh = reg.mesh
+    handle.mesh_axis = axis
+    return handle

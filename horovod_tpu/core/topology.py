@@ -61,6 +61,12 @@ class _GlobalState:
         # 2-axis ("dcn","ici") view of the same devices for hierarchical
         # collectives (HOROVOD_TPU_MESH_SHAPE); None = flat world.
         self.hier_mesh: Optional[Mesh] = None
+        # GSPMD hybrid-parallel backend (docs/parallelism.md): the
+        # HOROVOD_MESH-derived named-axis MeshSpec + the 5-axis Mesh
+        # over the same devices in the same canonical order. None =
+        # pure data-parallel world (the flat 'hvd' mesh above).
+        self.mesh_spec = None       # parallel.mesh.MeshSpec | None
+        self.hybrid_mesh: Optional[Mesh] = None
         # Set lazily by sibling modules to avoid import cycles.
         self.process_set_table = None
         self.timeline = None
@@ -373,6 +379,17 @@ def init(process_sets: Optional[Sequence] = None,
         _state.mesh = Mesh(np.asarray(devs), (_AXIS,))
         if cfg.mesh_shape:
             _state.hier_mesh = _build_hier_mesh(cfg.mesh_shape, devs)
+        if cfg.mesh_spec:
+            # HOROVOD_MESH: MeshSpec is the runtime's mesh authority —
+            # the hybrid mesh shares the flat mesh's devices and
+            # canonical order, so rank r IS mesh coordinate
+            # unravel(r, spec.sizes()) and process sets map onto named
+            # sub-axes (core/process_sets.axis_process_set).
+            from horovod_tpu.parallel import mesh as mesh_mod
+            _state.mesh_spec = mesh_mod.MeshSpec.parse(
+                cfg.mesh_spec, len(devs))
+            _state.hybrid_mesh = mesh_mod.build_mesh(
+                _state.mesh_spec, devs)
 
         pidx = jax.process_index()
         pcount = jax.process_count()
@@ -690,6 +707,19 @@ def mesh() -> Mesh:
     m = _require_init().mesh
     assert m is not None
     return m
+
+
+def hybrid_mesh() -> Optional[Mesh]:
+    """The HOROVOD_MESH-derived 5-axis (dp/pp/ep/sp/tp) mesh over the
+    same devices as mesh(), or None when the job is pure data-parallel
+    (docs/parallelism.md). Same device order as the flat mesh — rank r
+    sits at coordinate unravel(r, mesh_spec().sizes())."""
+    return _require_init().hybrid_mesh
+
+
+def mesh_spec():
+    """The parsed HOROVOD_MESH MeshSpec (parallel/mesh.py), or None."""
+    return _require_init().mesh_spec
 
 
 def axis_name() -> str:

@@ -9,5 +9,5 @@ pure `apply` functions — idiomatic for pjit/shard_map, no framework layer.
 """
 
 from horovod_tpu.models import (  # noqa: F401
-    inception, mlp, resnet, transformer, vgg,
+    inception, mlp, resnet, tied_lm, transformer, vgg,
 )

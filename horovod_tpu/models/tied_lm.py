@@ -1,0 +1,161 @@
+"""Tied-embedding LM — the GSPMD hybrid-parallel runtime model.
+
+The shape the hvdshard gate has linted since PR 12 (``--hlo-step
+lm_sharded``: a tied 16 MB embedding + residual tanh-FFN blocks),
+promoted from an analysis fixture to a real trainable model
+(ROADMAP item 3 / ISSUE 14): ``examples/hybrid_lm.py`` trains it,
+``bench.py``'s gspmd_hybrid section measures it pure-DP vs tp x dp,
+and ``analysis/shard.py`` lowers BOTH its GSPMD twin and the
+``DistributedOptimizer``-driven runtime step from this one module, so
+the linted program and the trained program can never drift apart.
+
+Two formulations of the same math:
+
+* ``global_loss`` — the dense single-device reference (also what the
+  GSPMD ``lm_sharded`` analysis twin jits under ``in_shardings``): the
+  partitioner decides the collectives.
+* ``local_loss`` — the shard-local (Megatron-LM, Shoeybi et al.,
+  arXiv:1909.08053) formulation for ``shard_map``: vocab-parallel
+  embedding lookup (mask + local gather + psum over ``tp``),
+  column/row-parallel FFN (``wi`` sharded on the F dim, ``wo`` psum'd),
+  and the vocab-parallel cross entropy (pmax/psum logsumexp + masked
+  target gather) — every ``tp`` member ends with the SAME loss value,
+  computed cooperatively, never materializing a full logits tensor per
+  device. All axis ops collapse to identities when the axis has size 1,
+  so the identical code is the pure-DP step on a ``dp=N`` mesh.
+
+Gradient semantics under per-shard AD (why the optimizer divides by
+``tp`` and psums replicated leaves over it) are documented at
+``optim.optimizer.grad_axes_from_specs`` — the same calculus
+``models/transformer.py`` pins against a single-device oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TiedLMConfig:
+    vocab: int = 8192
+    d_model: int = 512
+    d_ff: int = 2048
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+
+
+def canonical_config() -> TiedLMConfig:
+    """The shapes the shard-lint gate has pinned since PR 12 (16 MB f32
+    embedding — the HVD301/302 canary)."""
+    return TiedLMConfig(vocab=8192, d_model=512, d_ff=2048, n_layers=2)
+
+
+def init(seed: int, cfg: TiedLMConfig) -> Dict[str, jax.Array]:
+    """Global (unsharded) parameter pytree, deterministic per seed."""
+    rng = np.random.default_rng(seed)
+    dt = cfg.dtype
+    params: Dict[str, jax.Array] = {"emb": jnp.asarray(
+        rng.standard_normal((cfg.vocab, cfg.d_model)) * 0.02, dt)}
+    for i in range(cfg.n_layers):
+        params[f"wi{i}"] = jnp.asarray(
+            rng.standard_normal((cfg.d_model, cfg.d_ff)) * 0.02, dt)
+        params[f"wo{i}"] = jnp.asarray(
+            rng.standard_normal((cfg.d_ff, cfg.d_model)) * 0.02, dt)
+    return params
+
+
+def param_specs(cfg: TiedLMConfig) -> Dict[str, P]:
+    """The canonical hybrid layout: vocab-sharded embedding,
+    column-parallel ``wi``, row-parallel ``wo`` — every parameter
+    sharded over ``tp``, replicated over ``dp``."""
+    specs: Dict[str, P] = {"emb": P("tp", None)}
+    for i in range(cfg.n_layers):
+        specs[f"wi{i}"] = P(None, "tp")
+        specs[f"wo{i}"] = P("tp", None)
+    return specs
+
+
+def replicated_specs(cfg: TiedLMConfig) -> Dict[str, P]:
+    """The 'forgot to annotate the params' twin: everything replicated
+    (what HVD301/302 exist to catch)."""
+    return {k: P() for k in param_specs(cfg)}
+
+
+def sample_batch(seed: int, cfg: TiedLMConfig, batch: int = 16,
+                 seq: int = 64):
+    """Deterministic synthetic (tokens, targets) — targets are the
+    next-token roll, the lm_overlap/lm_sharded convention."""
+    rng = np.random.default_rng(seed)
+    tok = jnp.asarray(rng.integers(0, cfg.vocab, (batch, seq)),
+                      jnp.int32)
+    return tok, jnp.roll(tok, -1, axis=1)
+
+
+def global_loss(params: Dict[str, jax.Array], tokens: jax.Array,
+                targets: jax.Array, cfg: TiedLMConfig,
+                constrain_logits: Optional[Callable] = None) -> jax.Array:
+    """Dense reference: mean next-token NLL on one device (or under
+    GSPMD jit — `constrain_logits` lets the lm_sharded analysis twin
+    pin the batch x model logits layout with a sharding constraint)."""
+    h = params["emb"][tokens]
+    for i in range(cfg.n_layers):
+        h = h + jnp.tanh(h @ params[f"wi{i}"]) @ params[f"wo{i}"]
+    logits = h @ params["emb"].T          # tied unembedding
+    if constrain_logits is not None:
+        logits = constrain_logits(logits)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], -1))
+
+
+def local_loss(params: Dict[str, jax.Array], tokens: jax.Array,
+               targets: jax.Array, cfg: TiedLMConfig,
+               tp_axis: str = "tp") -> jax.Array:
+    """Shard-local loss for shard_map: `params` per param_specs shards,
+    `tokens`/`targets` the local batch shard. Returns the LOCAL batch
+    shard's mean NLL — identical on every `tp_axis` member (computed
+    cooperatively through psums), NOT reduced over the batch axes
+    (the optimizer's gradient reduction owns that; psum'ing the loss
+    before grad would scale cotangents by the axis size —
+    models/transformer.py NOTE)."""
+    emb = params["emb"]
+    v_loc = emb.shape[0]
+    lo = lax.axis_index(tp_axis) * v_loc
+
+    def vocab_parallel_rows(ids):
+        """Embedding rows for global token ids from the local vocab
+        shard: out-of-shard ids contribute zeros, psum assembles."""
+        local = ids - lo
+        ok = (local >= 0) & (local < v_loc)
+        safe = jnp.clip(local, 0, v_loc - 1)
+        rows = jnp.where(ok[..., None], emb[safe], 0).astype(cfg.dtype)
+        return lax.psum(rows, tp_axis)
+
+    h = vocab_parallel_rows(tokens)
+    for i in range(cfg.n_layers):
+        u = jnp.tanh(h @ params[f"wi{i}"])          # column-parallel
+        h = h + lax.psum(u @ params[f"wo{i}"], tp_axis)  # row-parallel
+    logits = h @ emb.T                     # (B_loc, S, V_loc) shard
+    lf = logits.astype(jnp.float32)
+    # Vocab-parallel log-softmax: global max, then the psum'd exp-sum.
+    # The shift is numerical stabilization only — it cancels exactly in
+    # lse - tgt_logit's derivative — so it rides stop_gradient (pmax
+    # also has no transpose rule).
+    # stop_gradient INSIDE pmax: with the tangent symbolically zeroed
+    # before the collective, AD never needs pmax's (missing) JVP rule.
+    m = lax.pmax(lax.stop_gradient(jnp.max(lf, axis=-1)), tp_axis)
+    se = lax.psum(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1), tp_axis)
+    lse = m + jnp.log(se)
+    tgt_local = targets - lo
+    ok = (tgt_local >= 0) & (tgt_local < v_loc)
+    safe = jnp.clip(tgt_local, 0, v_loc - 1)
+    tgt_logit = lax.psum(
+        jnp.where(ok, jnp.take_along_axis(
+            lf, safe[..., None], axis=-1)[..., 0], 0.0), tp_axis)
+    return jnp.mean(lse - tgt_logit)

@@ -678,6 +678,7 @@ def allreduce(tensor: Any,
                      f"op={int(rop)},ps={ps.process_set_id})", ps,
                      name=name or "allreduce")
         with _instrument(name or "allreduce", "ALLREDUCE",
+                         axis=getattr(ps, "mesh_axis", None),
                          nbytes_fn=lambda: (
                              (math.prod(shape) * k *
                               _dtype_info(dtype)[0]),
@@ -696,7 +697,8 @@ def allreduce(tensor: Any,
             ps.mesh, k, rop, prescale_factor, postscale_factor, 1, donate))
     _consistency(f"allreduce(shape={g.shape},dtype={g.dtype},op={int(rop)},"
                  f"ps={ps.process_set_id})", ps, name=name or "allreduce")
-    with _instrument(name or "allreduce", "ALLREDUCE", arrays=(g,)):
+    with _instrument(name or "allreduce", "ALLREDUCE", arrays=(g,),
+                     axis=getattr(ps, "mesh_axis", None)):
         return _from_global(_execute(fn, g), stacked)
 
 
@@ -749,6 +751,7 @@ def grouped_allreduce(tensors: Sequence[Any],
                      name=name or "grouped_allreduce")
         with _instrument(name or "grouped_allreduce", "ALLREDUCE",
                          ntensors=len(tensors),
+                         axis=getattr(ps, "mesh_axis", None),
                          nbytes_fn=lambda: (
                              sum(math.prod(s) * k * _dtype_info(d)[0]
                                  for s, d in zip(shapes, dtypes)),
@@ -795,7 +798,8 @@ def grouped_allreduce(tensors: Sequence[Any],
                  f"ps={ps.process_set_id})", ps,
                  name=name or "grouped_allreduce")
     with _instrument(name or "grouped_allreduce", "ALLREDUCE",
-                     arrays=tuple(gs), ntensors=len(gs)):
+                     arrays=tuple(gs), ntensors=len(gs),
+                     axis=getattr(ps, "mesh_axis", None)):
         outs = _execute(fn, *gs)
     return [_from_global(o, s) for o, s in zip(outs, stackeds)]
 
@@ -920,7 +924,7 @@ def bucketed_allreduce(tensors: Sequence[Any],
     records = []  # (bucket, members, layout, outs)
     launches: List[float] = []
     with _instrument(base, "ALLREDUCE", arrays=tuple(gs),
-                     ntensors=len(gs)):
+                     ntensors=len(gs), axis=getattr(ps, "mesh_axis", None)):
         for bi, bucket in enumerate(plan):
             members: List[int] = []
             pos_of: Dict[int, int] = {}
@@ -1752,6 +1756,11 @@ def _mx():
                 "Estimated fraction of bucket in-flight time shared with "
                 "another bucket (1 - wall_window / sum_of_bucket_spans; "
                 "profiled calls only)"),
+            "axis_bytes": reg.counter(
+                "horovod_axis_comms_bytes_total",
+                "Eager collective payload bytes attributed to a named "
+                "mesh axis (process sets built by axis_process_set; "
+                "docs/parallelism.md)", labelnames=("axis", "op")),
             "stall_warn": reg.counter(
                 "horovod_stall_warnings_total",
                 "Stall warnings", labelnames=("source",)),
@@ -1763,7 +1772,7 @@ def _mx():
 
 
 def _record(activity: str, arrays, nbytes_fn, ntensors, seconds,
-            tl) -> None:
+            tl, axis=None) -> None:
     """Post-call accounting (metrics enabled only): counters, the wall-
     time histogram, and a per-op cumulative-bytes counter track in the
     live timeline so the trace shows byte throughput next to the spans."""
@@ -1788,6 +1797,12 @@ def _record(activity: str, arrays, nbytes_fn, ntensors, seconds,
     mx["calls"].labels(op=op, dtype=dtype).inc()
     if nbytes:
         mx["bytes"].labels(op=op, dtype=dtype).inc(nbytes)
+        if axis:
+            # Per-axis comms attribution (docs/parallelism.md): eager
+            # traffic over an axis_process_set sub-communicator lands in
+            # its axis's series — the dp/tp split the hybrid backend's
+            # scaling analysis reads.
+            mx["axis_bytes"].labels(axis=axis, op=op).inc(nbytes)
     mx["seconds"].labels(op=op).observe(seconds)
     if ntensors is not None:
         mx["group"].labels(op=op).observe(ntensors)
@@ -1813,16 +1828,19 @@ class _instrument:
     hooks — a compile on a cache miss — already re-attributed)."""
 
     __slots__ = ("name", "activity", "arrays", "nbytes_fn", "ntensors",
-                 "tl", "enabled", "ps", "timed", "t0", "attr_mark")
+                 "tl", "enabled", "ps", "timed", "t0", "attr_mark",
+                 "axis")
 
     def __init__(self, name: str, activity: str, arrays: Sequence = (),
                  nbytes_fn: Optional[Callable] = None,
-                 ntensors: Optional[int] = None) -> None:
+                 ntensors: Optional[int] = None,
+                 axis: Optional[str] = None) -> None:
         self.name = name
         self.activity = activity
         self.arrays = arrays
         self.nbytes_fn = nbytes_fn
         self.ntensors = ntensors
+        self.axis = axis
 
     def __enter__(self) -> "_instrument":
         from horovod_tpu.observability import metrics as m
@@ -1851,5 +1869,5 @@ class _instrument:
             self.ps.attribute("comms", dt - nested)
         if self.enabled:
             _record(self.activity, self.arrays, self.nbytes_fn,
-                    self.ntensors, dt, self.tl)
+                    self.ntensors, dt, self.tl, axis=self.axis)
         return False

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from functools import partial
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -46,6 +47,19 @@ from horovod_tpu.profiler import perfscope as _pscope
 
 _AXIS = "hvd"
 
+#: Mesh axes over which the shard-local loss formulations compute the
+#: loss REDUNDANTLY (every member ends holding the same scalar, each
+#: copy differentiated per rank): per-shard reverse AD then scales
+#: every gradient by the axis size, and the sharded-step builder
+#: divides it back out (models/transformer.py grad_reduce_axes has the
+#: full derivation; models/tied_lm.py follows the same contract).
+REDUNDANT_LOSS_AXES: Tuple[str, ...] = ("tp",)
+
+#: Mesh axes a training batch shards over (gradient MEAN axes); the
+#: remaining axes carry model shards, whose gradient psums are plain
+#: sums of partial contributions.
+BATCH_AXES: Tuple[str, ...] = ("dp", "ep", "sp")
+
 
 def _scale_factors(op: T.ReduceOp, k: int, gradient_predivide_factor: float
                    ) -> Tuple[float, float, T.ReduceOp]:
@@ -61,6 +75,138 @@ def _scale_factors(op: T.ReduceOp, k: int, gradient_predivide_factor: float
     return 1.0, 1.0, op
 
 
+def _spec_axis_names(spec) -> set:
+    """Mesh axis names a PartitionSpec mentions (entries may be names,
+    tuples of names, or None)."""
+    names: set = set()
+    if spec is None:
+        return names
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            names.update(e for e in entry if e)
+        else:
+            names.add(entry)
+    return names
+
+
+def grad_axes_from_specs(param_specs: Any, mesh) -> Any:
+    """Per-leaf gradient psum axes derived from a sharding spec.
+
+    The rule (the multi-axis generalisation of "allreduce everything
+    over the world"): a leaf's gradient must be psum'd over every mesh
+    axis of size > 1 **absent from its PartitionSpec** — batch axes
+    (the parameter is replicated across data shards) and any model axis
+    the leaf is replicated over (each member's backward holds a partial
+    sum). An axis the leaf IS sharded over contributes no psum: the
+    shard's gradient lives only on its owners. This is exactly
+    ``models/transformer.py grad_reduce_axes`` computed from the spec
+    pytree instead of written by hand — the piece that lets
+    ``DistributedOptimizer`` accept a user sharding spec and emit
+    batch-axis-only traffic for model-sharded parameters.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    live = tuple(a for a in mesh.axis_names if sizes[a] > 1)
+
+    def leaf(spec):
+        mentioned = _spec_axis_names(spec)
+        return tuple(a for a in live if a not in mentioned)
+
+    return jax.tree_util.tree_map(
+        leaf, param_specs, is_leaf=lambda x: isinstance(x, P) or x is None)
+
+
+def _record_axis_comms(bytes_by_label: dict) -> None:
+    """Static per-axis comms attribution (docs/parallelism.md): planned
+    per-device gradient-reduction bytes per mesh-axis group, recorded at
+    trace time (the plan is a static property of the compiled step).
+    Feeds the perfscope summary (`comms_axes`) and the
+    `horovod_axis_comms_bytes` gauge family; best-effort — attribution
+    must never break a trace."""
+    try:
+        _pscope.get().set_comms_axes(bytes_by_label)
+    except Exception:
+        pass
+    try:
+        from horovod_tpu.observability import metrics as m
+        g = m.registry().gauge(
+            "horovod_axis_comms_bytes",
+            "Planned per-device gradient-reduction payload bytes per "
+            "step, by mesh axis group (trace-time static attribution)",
+            labelnames=("axis",))
+        for label, nbytes in bytes_by_label.items():
+            g.labels(axis=label).set(float(nbytes))
+    except Exception:
+        pass
+
+
+def _reduce_gradients_by_axes(grads: Any, op: T.ReduceOp, axes: Any,
+                              mean_axes: Tuple[str, ...],
+                              compression, thresh: int, reverse: bool,
+                              gradient_predivide_factor: float) -> Any:
+    """Per-leaf multi-axis reduction: leaves are grouped by their psum
+    axis tuple and bucketed per group (ops/fusion.py), so a tp-sharded
+    parameter's gradient generates batch-axis traffic only and every
+    group's buckets still chunk/overlap like the 1-D path. `mean_axes`
+    are the batch axes an AVERAGE divides by (model-axis psums are
+    plain partial-sum additions)."""
+    if op not in (T.ReduceOp.SUM, T.ReduceOp.AVERAGE):
+        raise HorovodTpuError(
+            f"sharding-spec gradient reduction supports Sum/Average, "
+            f"got {op}")
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    is_axes_leaf = lambda x: (isinstance(x, (tuple, list)) and  # noqa: E731
+                              all(isinstance(e, str) for e in x))
+    ax_leaves = [tuple(a) for a in jax.tree_util.tree_leaves(
+        axes, is_leaf=is_axes_leaf)]
+    if len(ax_leaves) != len(leaves):
+        raise HorovodTpuError(
+            f"gradient axes pytree has {len(ax_leaves)} leaves, "
+            f"gradients have {len(leaves)} (build it with "
+            "grad_axes_from_specs over the same structure)")
+    out: list = [None] * len(leaves)
+    groups: dict = {}
+    for i, ax in enumerate(ax_leaves):
+        groups.setdefault(ax, []).append(i)
+    bytes_by_label: dict = {}
+    for ax, idxs in groups.items():
+        if not ax:  # unreduced leaf (sharded over every live axis)
+            for i in idxs:
+                out[i] = leaves[i]
+            continue
+        k = 1
+        for a in ax:
+            if a in mean_axes:
+                k *= lax.axis_size(a)
+        pre, post, rop = _scale_factors(op, k, gradient_predivide_factor)
+        comp = [compression.compress(leaves[i]) for i in idxs]
+        blocks = [c[0][None] for c in comp]
+
+        def reduce_block(b: jax.Array, _ax=ax, _pre=pre, _post=post,
+                         _rop=rop, _k=k) -> jax.Array:
+            x = b
+            if _pre != 1.0:
+                x = x * jnp.asarray(_pre, x.dtype)
+            y = lax.psum(x, _ax)
+            if _rop == T.ReduceOp.AVERAGE and _k != 1:
+                y = y / jnp.asarray(_k, y.dtype)
+            if _post != 1.0:
+                y = y * jnp.asarray(_post, y.dtype)
+            return y
+
+        reduced = fusion.fused_reduce_blocks(blocks, reduce_block,
+                                             thresh, reverse=reverse)
+        for i, r, c in zip(idxs, reduced, comp):
+            out[i] = compression.decompress(r[0], c[1])
+        label = "+".join(ax)
+        bytes_by_label[label] = bytes_by_label.get(label, 0) + sum(
+            int(np.prod(np.shape(b))) * np.dtype(b.dtype).itemsize
+            for b in blocks)
+    _record_axis_comms(bytes_by_label)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def reduce_gradients_in_jit(grads: Any,
                             op: T.ReduceOp = T.ReduceOp.AVERAGE,
                             axis: str = _AXIS,
@@ -68,7 +214,9 @@ def reduce_gradients_in_jit(grads: Any,
                             fusion_threshold_bytes: Optional[int] = None,
                             num_ranks: Optional[int] = None,
                             gradient_predivide_factor: float = 1.0,
-                            reverse_bucket_order: Optional[bool] = None
+                            reverse_bucket_order: Optional[bool] = None,
+                            axes: Any = None,
+                            mean_axes: Optional[Tuple[str, ...]] = None
                             ) -> Any:
     """Cross-replica gradient reduction for use inside shard_map'd code.
 
@@ -102,6 +250,15 @@ def reduce_gradients_in_jit(grads: Any,
     if reverse is None:
         reverse = (topology.state().config.bucket_reverse
                    if topology.is_initialized() else True)
+    if axes is not None:
+        # Hybrid-mesh mode (docs/parallelism.md): `axes` is a per-leaf
+        # pytree of psum axis tuples (grad_axes_from_specs) — leaves
+        # group per axis tuple and bucket per group, so model-sharded
+        # parameters generate batch-axis traffic only.
+        return _reduce_gradients_by_axes(
+            grads, op, axes,
+            tuple(mean_axes) if mean_axes is not None else BATCH_AXES,
+            compression, thresh, reverse, gradient_predivide_factor)
     k = num_ranks if num_ranks is not None else lax.axis_size(axis)
     pre, post, rop = _scale_factors(op, k, gradient_predivide_factor)
 
@@ -186,7 +343,9 @@ class DistributedOptimizer:
                  backward_passes_per_step: int = 1,
                  op: Any = T.ReduceOp.AVERAGE,
                  gradient_predivide_factor: float = 1.0,
-                 process_set: Optional[ProcessSet] = None):
+                 process_set: Optional[ProcessSet] = None,
+                 sharding_spec: Any = None,
+                 mesh: Any = None):
         del named_parameters  # tensor naming handled by pytree paths
         self.inner = optimizer
         self.compression = compression
@@ -194,11 +353,86 @@ class DistributedOptimizer:
         self.op = T.normalize_reduce_op(op)
         self.gradient_predivide_factor = float(gradient_predivide_factor)
         self.process_set = process_set or global_process_set
+        # GSPMD hybrid-parallel backend (docs/parallelism.md): a
+        # PartitionSpec pytree matching the params. With a spec set,
+        # `sharded_step(loss_fn)` compiles the model-sharded train step
+        # over `mesh` (default: the HOROVOD_MESH hybrid mesh) — grads
+        # psum only over the batch axes while tp/pp/ep shards stay put.
+        self.sharding_spec = sharding_spec
+        self.mesh = mesh
         self._accum = None
         self._accum_count = 0
 
     def init(self, params: Any) -> Any:
         return self.inner.init(params)
+
+    # -- GSPMD hybrid-parallel path ---------------------------------------
+    def _spec_tree(self):
+        """The sharding spec as a PartitionSpec pytree. NamedSharding
+        leaves are accepted too (the ISSUE 14 API contract) — their
+        specs are extracted and their mesh doubles as the default."""
+        from jax.sharding import NamedSharding
+
+        def leaf(s):
+            return s.spec if isinstance(s, NamedSharding) else s
+
+        return jax.tree_util.tree_map(
+            leaf, self.sharding_spec,
+            is_leaf=lambda x: isinstance(x, (P, NamedSharding))
+            or x is None)
+
+    def _resolve_mesh(self):
+        m = self.mesh
+        if m is None:
+            from jax.sharding import NamedSharding
+            for s in jax.tree_util.tree_leaves(
+                    self.sharding_spec,
+                    is_leaf=lambda x: isinstance(x, (P, NamedSharding))
+                    or x is None):
+                if isinstance(s, NamedSharding):
+                    m = s.mesh
+                    break
+        if m is None and topology.is_initialized():
+            m = topology.hybrid_mesh()
+        if m is None:
+            raise HorovodTpuError(
+                "sharded_step needs a hybrid mesh: set HOROVOD_MESH "
+                "(e.g. \"dp=2,tp=4\") before hvd.init(), or pass "
+                "mesh= to DistributedOptimizer")
+        return m
+
+    def sharded_step(self, loss_fn: Callable,
+                     batch_spec: Any = None,
+                     donate: bool = True,
+                     fusion_threshold_bytes: Optional[int] = None
+                     ) -> Callable:
+        """Compile the hybrid-parallel train step for this optimizer's
+        sharding spec: ``step(params, opt_state, batch) -> (params,
+        opt_state, loss)``. `loss_fn(params, batch)` is the SHARD-LOCAL
+        loss (models/tied_lm.local_loss is the canonical example); see
+        `build_sharded_train_step` for the full contract."""
+        if self.sharding_spec is None:
+            raise HorovodTpuError(
+                "sharded_step requires DistributedOptimizer("
+                "sharding_spec=<PartitionSpec pytree>)")
+        return build_sharded_train_step(
+            loss_fn, self.inner, mesh=self._resolve_mesh(),
+            param_specs=self._spec_tree(), batch_spec=batch_spec,
+            op=self.op, compression=self.compression,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            donate=donate,
+            fusion_threshold_bytes=fusion_threshold_bytes)
+
+    def shard_params(self, params: Any):
+        """Place a global param pytree onto the hybrid mesh per this
+        optimizer's sharding spec (jax.device_put with NamedSharding)."""
+        if self.sharding_spec is None:
+            raise HorovodTpuError("shard_params requires sharding_spec")
+        from jax.sharding import NamedSharding
+        m = self._resolve_mesh()
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(m, s)),
+            params, self._spec_tree())
 
     # -- gradient reduction ------------------------------------------------
     def _allreduce_grads(self, grads: Any) -> Any:
@@ -428,3 +662,99 @@ def build_train_step(loss_fn: Callable,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(sharded, donate_argnums=donate_argnums)
+
+
+def build_sharded_train_step(loss_fn: Callable,
+                             optimizer: optax.GradientTransformation,
+                             mesh=None,
+                             param_specs: Any = None,
+                             batch_spec: Any = None,
+                             op: T.ReduceOp = T.ReduceOp.AVERAGE,
+                             compression=Compression.none,
+                             gradient_predivide_factor: float = 1.0,
+                             donate: bool = True,
+                             fusion_threshold_bytes: Optional[int] = None
+                             ) -> Callable:
+    """Compile the GSPMD hybrid-parallel train step (docs/parallelism.md).
+
+    The model-sharded sibling of `build_train_step`: parameters follow a
+    user PartitionSpec pytree over the 5-axis hybrid mesh
+    (parallel/mesh.py; HOROVOD_MESH), the batch shards over the batch
+    axes, and the gradient reduction — bucketed and overlap-packed
+    exactly like the DP path — psums each leaf only over the axes it is
+    replicated across (grad_axes_from_specs): tp/pp/ep-sharded weights
+    generate batch-axis traffic only.
+
+    Contract for `loss_fn(params, batch) -> scalar`:
+
+    * it runs UNDER shard_map — `params`/`batch` are the local shards
+      and every mesh axis name is in scope (lax.psum etc.);
+    * it returns the LOCAL batch shard's loss, not psum'd over the
+      batch axes (the psum transpose would scale cotangents by the
+      axis size — models/transformer.py NOTE);
+    * over the model axes the loss value is computed REDUNDANTLY (every
+      tp member holds the same scalar — models/tied_lm.local_loss's
+      cooperative psums, or transformer.py's replicated activations);
+      per-shard AD then scales gradients by the axis size, which this
+      builder divides back out (REDUNDANT_LOSS_AXES).
+
+    forward/backward and the gradient collectives run inside one
+    shard_map; the optax update runs under GSPMD, which propagates the
+    parameter shardings through the elementwise update (opt-state
+    moments land sharded like their parameters — the ZeRO-style free
+    lunch of spec-driven updates). Returns
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+    """
+    if mesh is None:
+        m = topology.hybrid_mesh() if topology.is_initialized() else None
+        if m is None:
+            raise HorovodTpuError(
+                "build_sharded_train_step needs a hybrid mesh "
+                "(HOROVOD_MESH before hvd.init(), or mesh=)")
+        mesh = m
+    if param_specs is None:
+        raise HorovodTpuError(
+            "build_sharded_train_step requires param_specs "
+            "(a PartitionSpec pytree matching the params)")
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if batch_spec is None:
+        batch_spec = P("dp")
+    axes = grad_axes_from_specs(param_specs, mesh)
+    batch_axes = tuple(a for a in _spec_axis_names(batch_spec)
+                       if sizes.get(a, 1) > 1)
+    redundant = 1
+    for a in REDUNDANT_LOSS_AXES:
+        redundant *= sizes.get(a, 1)
+
+    def local_step(params, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if redundant != 1:
+            # Per-shard AD of the redundantly-computed loss scaled every
+            # gradient by the model-axis size; see the contract above.
+            grads = jax.tree_util.tree_map(
+                lambda g: g / jnp.asarray(redundant, g.dtype), grads)
+        grads = reduce_gradients_in_jit(
+            grads, op=op, compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            gradient_predivide_factor=gradient_predivide_factor,
+            axes=axes, mean_axes=batch_axes)
+        if batch_axes:
+            loss = lax.pmean(loss, batch_axes)
+        return loss, grads
+
+    sharded_lg = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(param_specs, batch_spec),
+        out_specs=(P(), param_specs),
+        check_vma=False)
+
+    donate_argnums = (0, 1) if donate else ()
+
+    @partial(jax.jit, donate_argnums=donate_argnums)
+    def step(params, opt_state, batch):
+        loss, grads = sharded_lg(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -8,7 +8,7 @@ communicators (reference: horovod/common/process_set.h).
 """
 
 from horovod_tpu.parallel.mesh import (  # noqa: F401
-    MeshSpec, build_mesh, mesh_axis_sizes,
+    AXIS_ORDER, MeshSpec, build_mesh, mesh_axis_sizes, spec_from_env,
 )
 from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention, blockwise_attention_reference,

@@ -216,8 +216,21 @@ class PerfScope:
         # so absent ones can be zeroed each step (the gauge promises
         # "the LAST step's" split).  guarded-by: _lock
         self._gauge_phases: set = set()
+        # Static per-axis comms attribution of the compiled step
+        # (docs/parallelism.md): {"dp": bytes, "dp+tp": bytes, ...},
+        # recorded at trace time by the sharded gradient reduction.
+        self._comms_axes: Dict[str, float] = {}  # guarded-by: _lock
         self._kv = None
         self._kv_dead = False
+
+    def set_comms_axes(self, bytes_by_axis: Dict[str, float]) -> None:
+        """Record the hybrid step's planned per-device gradient-
+        reduction bytes per mesh-axis group (optim.optimizer
+        _record_axis_comms calls this at trace time). Shows up in
+        summary()['comms_axes'] — the dp-vs-tp traffic split."""
+        with self._lock:
+            self._comms_axes = {str(k): float(v)
+                                for k, v in bytes_by_axis.items()}
 
     # ------------------------------------------------------------ steps
     def step(self, weight: float = 1.0) -> Any:
@@ -375,6 +388,7 @@ class PerfScope:
             self._totals = {}
             self._model_flops = None
             self._flops_source = "none"
+            self._comms_axes = {}
 
     def step_count(self) -> int:
         """Total steps recorded (cheap — one locked int read)."""
@@ -405,6 +419,7 @@ class PerfScope:
             steps = self._steps
             flops = self._model_flops
             source = self._flops_source
+            comms_axes = dict(self._comms_axes)
         if not recent:
             return {}
         walls = sorted(w for w, _ in recent)
@@ -445,6 +460,8 @@ class PerfScope:
             "model_flops_per_step": flops,
             "mfu_source": source,
         }
+        if comms_axes:
+            out["comms_axes"] = comms_axes
         from horovod_tpu.profiler import flops as F
         peak = F.peak_flops_per_chip()
         if peak:
@@ -560,6 +577,9 @@ class _NoopScope:
         pass
 
     def set_model_flops(self, flops_per_step, source="fallback") -> None:
+        pass
+
+    def set_comms_axes(self, bytes_by_axis) -> None:
         pass
 
     def reset(self) -> None:

@@ -65,6 +65,14 @@ PADDED_SECTIONS = ("resnet50", "resnet101")
 #: must stay under 5% of the step wall.
 MAX_INPUT_WAIT_FRACTION = 0.05
 
+#: GSPMD hybrid-parallel structural contract (docs/parallelism.md):
+#: every sharded bench section must stamp the mesh it ran on, the
+#: scaling comparison against its DP baseline, and the per-axis comms
+#: split — the hybrid analog of the conv sections' layout/
+#: input_pipeline stamps, so a regression that silently drops the
+#: hybrid path (or its attribution) fails the gate on any host.
+SHARDED_SECTIONS = ("gspmd_hybrid",)
+
 
 # ----------------------------------------------------------------- emit
 
@@ -295,6 +303,46 @@ def _check_memory(name: str, val: dict) -> list:
     return errs
 
 
+def _check_sharded_section(name: str, val: dict) -> list:
+    """The mesh/scaling/comms stamps a GSPMD hybrid section must carry
+    (docs/parallelism.md): mesh spec+shape (which 2-D config ran),
+    scaling efficiency vs the DP baseline with both throughputs, and
+    the per-axis comms-bytes split of the compiled program."""
+    errs = []
+    mesh = val.get("mesh")
+    if not isinstance(mesh, dict) or not mesh.get("spec") \
+            or not isinstance(mesh.get("shape"), dict):
+        errs.append(f"{name}: mesh stamp missing/incomplete — the "
+                    "sharded section no longer reports which mesh "
+                    "config it measured (need spec + shape)")
+    elif not mesh.get("devices"):
+        errs.append(f"{name}: mesh stamp carries no device count")
+    sc = val.get("scaling")
+    if not isinstance(sc, dict):
+        errs.append(f"{name}: scaling stamp missing — scaling "
+                    "efficiency has nowhere to land")
+    else:
+        for k in ("efficiency_vs_dp", "dp_tokens_per_sec",
+                  "hybrid_tokens_per_sec"):
+            v = sc.get(k)
+            if not isinstance(v, (int, float)) or v <= 0:
+                errs.append(f"{name}: scaling.{k} missing or "
+                            "non-positive")
+    comms = val.get("comms_by_axis")
+    if not isinstance(comms, dict) or not comms:
+        errs.append(f"{name}: comms_by_axis stamp missing/empty — the "
+                    "per-axis (dp/tp) wire-traffic split is gone "
+                    "(analysis/shard.comms_by_axis)")
+    else:
+        for label, ent in comms.items():
+            if not isinstance(ent, dict) or \
+                    not isinstance(ent.get("bytes_per_step"),
+                                   (int, float)):
+                errs.append(f"{name}: comms_by_axis[{label!r}] carries "
+                            "no bytes_per_step")
+    return errs
+
+
 def check_bench(doc: dict) -> list:
     """Structure-check every perfscope-stamped section of a bench.py
     JSON line (the StepProfile acceptance: phases cover >=90% of wall),
@@ -308,6 +356,8 @@ def check_bench(doc: dict) -> list:
             continue
         if sec in CONV_SECTIONS:
             errs.extend(_check_conv_section(sec, val))
+        if sec in SHARDED_SECTIONS:
+            errs.extend(_check_sharded_section(sec, val))
         if "perfscope" not in val:
             continue
         prof = val["perfscope"]
@@ -322,6 +372,16 @@ def check_bench(doc: dict) -> list:
     if not found:
         errs.append("bench JSON carries no perfscope StepProfile "
                     "(HOROVOD_PERFSCOPE=0 on the bench run?)")
+    # Presence is part of the sharded structural contract: a crashed /
+    # deleted gspmd section would otherwise skip every stamp check and
+    # silently drop the hybrid path from the record.
+    for sec in SHARDED_SECTIONS:
+        if not isinstance(extra.get(sec), dict):
+            errs.append(
+                f"{sec}: sharded bench section missing — the hybrid "
+                "path did not run (or was dropped); its mesh/scaling/"
+                "comms_by_axis stamps are structurally required "
+                "(docs/parallelism.md)")
     return errs
 
 

@@ -32,6 +32,10 @@ def pytest_addoption(parser):
     parser.addoption(
         "--run-perf", action="store_true", default=False,
         help="run wall-clock perf smoke tests (make fusion-smoke)")
+    parser.addoption(
+        "--run-slow", action="store_true", default=False,
+        help="run minutes-scale canonical-program compile tests "
+             "(make gspmd-smoke)")
 
 
 def pytest_configure(config):
@@ -45,6 +49,12 @@ def pytest_configure(config):
         "perf: wall-clock perf smoke tests (fusion-cliff monotonicity on "
         "the virtual mesh); load-sensitive, so excluded from tier-1 — run "
         "via `make fusion-smoke` or --run-perf")
+    config.addinivalue_line(
+        "markers",
+        "slow: minutes-scale tests (canonical-size program lowering/"
+        "compilation); auto-skipped unless --run-slow (and excluded "
+        "from tier-1 by its `-m 'not slow'` filter) — run via the "
+        "owning make target (e.g. `make gspmd-smoke`)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -57,6 +67,10 @@ def pytest_collection_modifyitems(config, items):
         skips.append(("perf", pytest.mark.skip(
             reason="perf smoke: run with `make fusion-smoke` "
                    "(pytest --run-perf)")))
+    if not config.getoption("--run-slow"):
+        skips.append(("slow", pytest.mark.skip(
+            reason="canonical-program compile test: run with `make "
+                   "gspmd-smoke` (pytest --run-slow)")))
     for item in items:
         for marker, skip in skips:
             if marker in item.keywords:

@@ -511,7 +511,52 @@ def scenario_check_collectives_skip(hvd, rank, size):
     check(False, f"rank {rank}: expected CollectiveDivergenceError")
 
 
+def scenario_mesh_shard_sync(hvd, rank, size):
+    """GSPMD backend agreement e2e (docs/parallelism.md): every rank
+    derives the mesh + sharding decision from HOROVOD_MESH, rank 0
+    broadcasts its decision, and all ranks must agree bit-for-bit —
+    then named collectives run over the model-axis process set with the
+    fingerprint verifier live (HOROVOD_CHECK_COLLECTIVES=1), so a rank
+    whose mesh/spec derivation diverged would be NAMED by the verifier
+    instead of deadlocking inside a mismatched sub-communicator."""
+    from horovod_tpu.analysis import verifier as vf
+    from horovod_tpu.core.process_sets import axis_process_set
+    from horovod_tpu.models import tied_lm
+    from horovod_tpu.optim.functions import broadcast_object
+    from horovod_tpu.optim.optimizer import grad_axes_from_specs
+
+    check(vf.get() is not None, "fingerprint verifier not active")
+    spec = hvd.mesh_spec()
+    check(spec is not None, "HOROVOD_MESH not set for this scenario")
+    check(spec.total == size, f"mesh covers {spec.total} != {size}")
+    mesh = hvd.hybrid_mesh()
+    cfg = tied_lm.TiedLMConfig(vocab=64, d_model=16, d_ff=32,
+                               n_layers=1)
+    axes = grad_axes_from_specs(tied_lm.param_specs(cfg), mesh)
+    decision = {
+        "mesh": spec.describe(),
+        "groups": {a: spec.axis_groups(a) for a in ("dp", "tp")},
+        "grad_axes": {k: list(v) for k, v in sorted(axes.items())},
+    }
+    got = broadcast_object(decision if rank == 0 else None, root_rank=0)
+    check(got == decision,
+          f"rank {rank} disagrees with rank 0's broadcast mesh/"
+          f"sharding decision: {got} vs {decision}")
+
+    ps = axis_process_set("tp")
+    check(ps.mesh_axis == "tp", f"axis set untagged: {ps.mesh_axis}")
+    check(ps.ranks == list(range(size)), f"tp set {ps.ranks}")
+    x = np.ones((4,), np.float32) * (rank + 1)
+    out = None
+    for i in range(6):
+        out = hvd.allreduce(x, op="sum", process_set=ps,
+                            name=f"mesh_grad_{i}")
+    np.testing.assert_allclose(
+        np.asarray(out), np.full((4,), float(sum(range(1, size + 1)))))
+
+
 SCENARIOS = {
+    "mesh_shard_sync": scenario_mesh_shard_sync,
     "check_collectives_skip": scenario_check_collectives_skip,
     "consistency_mismatch": scenario_consistency_mismatch,
     "consistency_missing": scenario_consistency_missing,

@@ -87,6 +87,28 @@ def test_tf_keras_fit_example():
     assert "final accuracy" in out, out
 
 
+def test_hybrid_lm_example():
+    """The GSPMD hybrid-parallel entry point (docs/parallelism.md):
+    tied-LM training tp=4 x dp=2 over HOROVOD_MESH through
+    DistributedOptimizer(sharding_spec=...), and its pure-DP twin with
+    the knob unset — same script, same builder."""
+    env_extra = {"HOROVOD_MESH": "dp=2,tp=4"}
+    import os as _os
+    saved = _os.environ.get("HOROVOD_MESH")
+    try:
+        _os.environ["HOROVOD_MESH"] = env_extra["HOROVOD_MESH"]
+        out = _run_example("hybrid_lm.py", "--steps", "4")
+    finally:
+        if saved is None:
+            _os.environ.pop("HOROVOD_MESH", None)
+        else:
+            _os.environ["HOROVOD_MESH"] = saved
+    assert "mesh dp=2,tp=4 on 8 devices" in out, out
+    assert "tokens/s" in out, out
+    out = _run_example("hybrid_lm.py", "--steps", "2")
+    assert "mesh dp=8 on 8 devices" in out, out
+
+
 def test_scaling_report():
     """--scaling-report 1 vs 8 on the virtual CPU mesh: the full harness
     behind the reference's north-star metric (90% efficiency 1→N,

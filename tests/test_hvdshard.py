@@ -644,6 +644,35 @@ def test_hlo_step_lm_sharded_replicated_twin_trips(monkeypatch):
                for f in findings)
 
 
+def test_hlo_step_lm_runtime_clean_via_cli(monkeypatch, capsys):
+    """ISSUE 14 satellite: the RUNTIME hybrid step — the actual
+    DistributedOptimizer.sharded_step program, not just its GSPMD
+    analysis twin — goes through the same CLI gate and lints clean
+    against the same empty baseline (`make shard-lint` /
+    `make gspmd-smoke`)."""
+    _clear_shard_env(monkeypatch)
+    monkeypatch.setenv("HOROVOD_HLO_LINT_HBM_BUDGET", "1G")
+    baseline = os.path.join(os.path.dirname(HERE), "scripts",
+                            "hvdshard_baseline.json")
+    rc = run_cli(["--hlo-step", "lm_runtime", "--baseline", baseline])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_hlo_step_lm_runtime_replicated_twin_trips_via_cli(monkeypatch,
+                                                           capsys):
+    """HOROVOD_SHARD_LINT_REPLICATED=1 applies to the runtime gate too:
+    the stored-and-stepped-replicated twin exits 1 with HVD301 on the
+    16 MB embedding (the GSPMD twin keeps pinning HVD302's
+    partitioner-inserted all-gather above)."""
+    _clear_shard_env(monkeypatch)
+    monkeypatch.setenv("HOROVOD_SHARD_LINT_REPLICATED", "1")
+    rc = run_cli(["--hlo-step", "lm_runtime"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD301" in out and "lm_runtime" in out
+
+
 def test_lm_sharded_static_peak_within_budget_band(monkeypatch):
     """The canonical program's static per-device peak is ~25 MB: small
     enough that the 1 GiB CI budget gives a 40x regression margin,

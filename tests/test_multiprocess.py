@@ -228,6 +228,27 @@ def test_check_collectives_subset_process_set_clean(tmp_path):
         assert f"MP_WORKER_OK consistency_subset rank={rank}" in text, text
 
 
+def test_mesh_shard_sync_multiprocess(tmp_path):
+    """GSPMD backend agreement e2e (ISSUE 14, `make gspmd-smoke`): both
+    ranks derive the HOROVOD_MESH mesh + sharding decision, rank 0's
+    broadcast matches every rank's own derivation, and named
+    collectives over the tp-axis process set run clean under the
+    fingerprint verifier (a divergent rank would be NAMED, not hung)."""
+    env = dict(WORKER_ENV)
+    env["HOROVOD_MESH"] = "tp=2"
+    env["HOROVOD_CHECK_COLLECTIVES"] = "1"
+    env["HOROVOD_CHECK_COLLECTIVES_INTERVAL"] = "2"
+    out_path = tmp_path / "out.txt"
+    with open(out_path, "w") as f:
+        rc = launch_static(2, "localhost:2",
+                           [sys.executable, WORKER, "mesh_shard_sync"],
+                           env, stdout=f)
+    text = out_path.read_text()
+    assert rc == 0, text
+    for rank in range(2):
+        assert f"MP_WORKER_OK mesh_shard_sync rank={rank}" in text, text
+
+
 def test_torch_frontend_multiprocess(tmp_path):
     """Torch frontend over REAL processes (the frontend's analog of
     running test/parallel/test_torch.py under mpirun)."""

@@ -553,10 +553,25 @@ def _memory_stamp(static=64 << 20):
     return {"memory": {"static_peak_device_bytes": static}}
 
 
+def _gspmd_section():
+    """A minimal valid sharded section (ISSUE 14): check_bench requires
+    its PRESENCE with the mesh/scaling/comms stamps, so the synthetic
+    docs below carry one to isolate what each test actually checks."""
+    return {"gspmd_hybrid": {
+        "mesh": {"spec": "dp=2,tp=4", "devices": 8,
+                 "shape": {"dp": 2, "tp": 4}},
+        "scaling": {"efficiency_vs_dp": 1.0,
+                    "dp_tokens_per_sec": 1.0,
+                    "hybrid_tokens_per_sec": 1.0},
+        "comms_by_axis": {"dp": {"bytes_per_step": 1}},
+    }}
+
+
 def test_perf_gate_bench_mode(fresh):
     doc = {"extra": {"resnet50": {"perfscope": _gate_profile(),
                                   **_conv_stamps()},
-                     "vgg16": None, "autotune": {"frozen": True}}}
+                     "vgg16": None, "autotune": {"frozen": True},
+                     **_gspmd_section()}}
     assert perf_gate.check_bench(doc) == []
     assert perf_gate.check_bench({"extra": {}})  # nothing stamped
 
@@ -572,7 +587,8 @@ def test_perf_gate_conv_section_requires_stamps(fresh):
     assert any("memory stamp missing" in e for e in errs)
     # non-conv sections carry the memory obligation but no conv stamps
     doc = {"extra": {"transformer_lm": {"perfscope": _gate_profile(),
-                                        **_memory_stamp()}}}
+                                        **_memory_stamp()},
+                     **_gspmd_section()}}
     assert perf_gate.check_bench(doc) == []
 
 
@@ -585,7 +601,8 @@ def test_perf_gate_conv_section_unpadded_resnet_fails(fresh):
     errs = perf_gate.check_bench(doc)
     assert any("nhwc_padded" in e for e in errs)
     doc = {"extra": {"inception_v3": {"perfscope": _gate_profile(),
-                                      **_conv_stamps("as_declared")}}}
+                                      **_conv_stamps("as_declared")},
+                     **_gspmd_section()}}
     assert perf_gate.check_bench(doc) == []
 
 
@@ -594,7 +611,8 @@ def test_perf_gate_conv_section_input_wait_bar(fresh):
     device-resident pipeline acceptance (docs/perf.md)."""
     prof = _gate_profile()
     prof["phase_fractions"] = {"input_wait": 0.2}
-    doc = {"extra": {"resnet50": {"perfscope": prof, **_conv_stamps()}}}
+    doc = {"extra": {"resnet50": {"perfscope": prof, **_conv_stamps()},
+                     **_gspmd_section()}}
     errs = perf_gate.check_bench(doc)
     assert any("starving" in e for e in errs)
     prof["phase_fractions"] = {"input_wait": 0.01}
@@ -607,7 +625,8 @@ def test_perf_gate_conv_section_mfu_presence(fresh):
     (CPU hosts) its absence is fine."""
     prof = _gate_profile()
     prof["peak_flops_per_chip"] = 197e12
-    doc = {"extra": {"vgg16": {"perfscope": prof, **_conv_stamps()}}}
+    doc = {"extra": {"vgg16": {"perfscope": prof, **_conv_stamps()},
+                     **_gspmd_section()}}
     errs = perf_gate.check_bench(doc)
     assert any("mfu missing" in e for e in errs)
     prof["mfu"] = 0.41
