@@ -30,6 +30,15 @@
 #                    — SIGKILL one replica under continuous load; zero
 #                    accepted requests dropped, p99 bounded through the
 #                    failover, hvddoctor names the dead replica
+#   make ckpt-smoke  async checkpointing + exactly-once elastic resume
+#                    (docs/checkpointing.md): the manifest/commit-
+#                    protocol + sharded-snapshot + AsyncCheckpointer +
+#                    TrainLoopState unit suite, then the chaos e2e —
+#                    a 2-process elastic job whose EVERY worker is
+#                    SIGKILL'd mid-epoch must resume from the last
+#                    COMMITTED step (not epoch start), finish with a
+#                    final state bit-identical to the uninterrupted
+#                    twin, and leave a doctor-readable [ckpt] trail
 #   make perf-gate   perfscope CI sentinel: emit StepProfiles from the
 #                    synthetic workloads and gate them against the
 #                    checked-in scripts/perf_baseline.json (structure
@@ -74,9 +83,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke fusion-smoke conv-smoke perf-gate
 
-test: lint hlo-lint shard-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke fusion-smoke conv-smoke perf-gate entry
+test: lint hlo-lint shard-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke fusion-smoke conv-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -123,6 +132,13 @@ watch-smoke:
 serve-smoke:
 	$(PYTEST) tests/test_serve.py
 	$(PYTEST) tests/test_serve_e2e.py --run-faults -m faults
+
+# Async checkpointing + exactly-once elastic resume
+# (docs/checkpointing.md): the deterministic unit suite runs in tier 1
+# too; the whole-job-SIGKILL chaos e2e (faults marker) only here.
+ckpt-smoke:
+	$(PYTEST) tests/test_ckpt.py
+	$(PYTEST) tests/test_ckpt_e2e.py --run-faults -m faults
 
 # perfscope CI sentinel (docs/perf.md): emit StepProfiles from the
 # synthetic CPU workloads and compare against the checked-in baseline.
@@ -232,7 +248,7 @@ race:
 	    tests/test_flight.py tests/test_perfscope.py \
 	    tests/test_watch.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
-	    tests/test_hvdlint.py tests/test_serve.py \
+	    tests/test_hvdlint.py tests/test_serve.py tests/test_ckpt.py \
 	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache
 
 entry:

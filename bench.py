@@ -1427,6 +1427,146 @@ def bench_eager_cpu_mesh(timeout=1500):
     return json.loads(lines[-1])
 
 
+def bench_checkpointing(on_cpu, steps=72, every=4):
+    """Async checkpointing overhead (docs/checkpointing.md, ROADMAP
+    item 5 acceptance): twin loops of the SAME jitted train step — one
+    plain, one with an AsyncCheckpointer saving every `every` steps —
+    stamp the measured overhead fraction (must stay <5%; perf_gate
+    fails it), the save-phase split (snapshot = the only critical-path
+    phase vs background persist/commit), bytes/s into the persist
+    tier, and the worst per-step blocking excess on a save step (the
+    'async save never blocks a step for more than the device-snapshot
+    phase' check, stamped so regressions are visible in the record)."""
+    import statistics
+    import tempfile
+
+    from horovod_tpu import ckpt as ckpt_mod
+    from horovod_tpu.ckpt import manifest as ckpt_mf
+
+    # sized so the step dwarfs the snapshot: the measurement needs the
+    # ratio's denominator honest, not a tiny step that makes noise
+    # look like overhead
+    n = 768 if on_cpu else 2048
+    key = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(key, (n, n), jnp.float32),
+              "w2": jax.random.normal(key, (n, n), jnp.float32)}
+    x = jnp.ones((n, n), jnp.float32)
+
+    @jax.jit
+    def step_fn(p, x):
+        h = jnp.tanh(x @ p["w1"])
+        h = jnp.tanh(h @ p["w2"])
+        h = jnp.tanh(h @ p["w1"])
+        return h @ p["w2"]
+
+    jax.block_until_ready(step_fn(params, x))  # compile outside timing
+
+    def run(n_steps, saver=None, base_step=0):
+        times = []
+        for i in range(1, n_steps + 1):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step_fn(params, x))
+            if saver is not None and (base_step + i) % every == 0:
+                saver.save(base_step + i, {"params": params})
+            times.append(time.perf_counter() - t0)
+        return times
+
+    # Interleaved A/B windows with a median-of-rounds overhead: the
+    # twin loops share each round's load regime (shared CI hosts drift
+    # over seconds — r05-style sequential twins read that drift as
+    # checkpoint overhead), and the median across rounds drops the odd
+    # external spike while keeping the persist-thread contention that
+    # IS real overhead inside each ckpt window.
+    window = max(every * 2, 8)
+    rounds = max(3, steps // window)
+    root = tempfile.mkdtemp(prefix="hvd-bench-ckpt-")
+    try:
+        saver = ckpt_mod.AsyncCheckpointer(root, keep=2)
+        run(window)                      # warm plain
+        run(window, saver, base_step=0)  # warm ckpt (first commit incl.)
+        saver.wait(60)
+        plain, ckptd, per_round = [], [], []
+        base = window
+        for _ in range(rounds):
+            p = run(window)
+            c = run(window, saver, base_step=base)
+            base += window
+            plain.extend(p)
+            ckptd.extend(c)
+            per_round.append((sum(c) - sum(p)) / sum(p))
+        saver.wait(60)
+        # Overhead from 10%-trimmed per-step means, not round sums: a
+        # shared host's scheduler spikes land on single steps, and a
+        # ratio of 8-step window sums inherits them wholesale (±10-25%
+        # per round measured on CI-class hosts). Trimming both arms
+        # symmetrically drops the spikes while keeping what checkpoint
+        # overhead actually looks like — a small shift across MANY
+        # steps (snapshot on every save step, persist contention on
+        # the steps behind it).
+        overhead = max(0.0, (_trimmed_mean(ckptd) - _trimmed_mean(plain))
+                       / _trimmed_mean(plain))
+        steps = rounds * window
+        return _ckpt_bench_result(
+            on_cpu, saver, root, plain, ckptd, per_round, overhead,
+            steps, every, rounds, window, params)
+    finally:
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _trimmed_mean(xs, trim=0.1):
+    xs = sorted(xs)
+    k = int(len(xs) * trim)
+    kept = xs[k:len(xs) - k] if len(xs) > 2 * k else xs
+    return sum(kept) / len(kept)
+
+
+def _ckpt_bench_result(on_cpu, saver, root, plain, ckptd, per_round,
+                       overhead, steps, every, rounds, window, params):
+    import statistics
+
+    from horovod_tpu.ckpt import manifest as ckpt_mf
+
+    payload_bytes = sum(int(np.asarray(v).nbytes)
+                        for v in jax.tree_util.tree_leaves(params))
+    committed = ckpt_mf.committed(root)
+    phase = dict(saver.last_phase_seconds)
+    persist_s = phase.get("persist", 0.0)
+    save_idx = {i for i in range(len(ckptd))
+                if (window + i + 1) % every == 0}
+    save_steps = [t for i, t in enumerate(ckptd) if i in save_idx]
+    other_steps = [t for i, t in enumerate(ckptd) if i not in save_idx]
+    t_plain, t_ckpt = sum(plain), sum(ckptd)
+    out = {
+        "platform": "cpu" if on_cpu else jax.devices()[0].platform,
+        "steps": steps,
+        "save_every": every,
+        "rounds": rounds,
+        "plain_step_ms": round(1e3 * t_plain / steps, 3),
+        "ckpt_step_ms": round(1e3 * t_ckpt / steps, 3),
+        "overhead_fraction": round(overhead, 4),
+        "overhead_per_round": [round(x, 4) for x in per_round],
+        "snapshot_ms": round(1e3 * phase.get("snapshot", 0.0), 3),
+        "persist_ms": round(1e3 * persist_s, 3),
+        "commit_ms": round(1e3 * phase.get("commit", 0.0), 3),
+        "bytes": payload_bytes,
+        "bytes_per_sec": round(payload_bytes / persist_s, 1)
+        if persist_s > 0 else None,
+        "generations_committed": saver.last_committed[0]
+        if saver.last_committed else 0,
+        "generations_retained": len(committed),
+        "skipped_saves": saver.skipped,
+        # worst save-step excess over the non-save median: the async
+        # contract says this should be ~ the snapshot phase, never the
+        # persist time
+        "max_save_step_excess_ms": round(
+            1e3 * (max(save_steps) - statistics.median(other_steps)), 3)
+        if save_steps and other_steps else None,
+    }
+    saver.close()
+    return out
+
+
 _SECTION_ERRORS = {}
 
 
@@ -1663,6 +1803,12 @@ def main():
     # no window stamp; the number is dominated by the service, not the
     # device/tunnel window.
     serving = _section("serving", bench_serving, on_cpu)
+    # Async checkpointing overhead (docs/checkpointing.md): twin-loop
+    # measurement; perf_gate structurally requires the stamp and fails
+    # overhead_fraction > 5% (ROADMAP item 5 acceptance). No window
+    # stamp — the number is a ratio of twin loops in the same window.
+    checkpointing = _section("checkpointing", bench_checkpointing,
+                             on_cpu)
 
     per_chip_ips = best["images_per_sec_per_chip"] if best else None
     print(json.dumps({
@@ -1691,6 +1837,7 @@ def main():
             "autotune": autotune,
             "flash_attention_s8192": flash,
             "serving": serving,
+            "checkpointing": checkpointing,
             "section_errors": _SECTION_ERRORS or None,
         },
     }), flush=True)

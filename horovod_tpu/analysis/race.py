@@ -73,6 +73,7 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.core.process_sets",
     "horovod_tpu.serve.batching",
     "horovod_tpu.serve.pool",
+    "horovod_tpu.ckpt.async_ckpt",
 )
 
 _LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))

@@ -89,6 +89,12 @@ def save(path: str, tree: Any, *, all_ranks_barrier: bool = True) -> None:
         cp = _checkpointer()
         cp.save(os.path.abspath(path), _to_saveable(tree), force=True)
         cp.wait_until_finished()
+        # Commit marker (ckpt/manifest.py protocol): written strictly
+        # AFTER the orbax save is durable, so `restore_params` can
+        # distinguish a committed checkpoint from a partial dir left by
+        # a killed writer.
+        from horovod_tpu.ckpt import manifest as _mf
+        _mf.write_done_marker(path, extra={"format": "orbax"})
     if all_ranks_barrier and rank is not None and topology.size() > 1:
         from horovod_tpu.ops import collectives
         collectives.barrier()
@@ -115,6 +121,11 @@ def restore(path: str, like: Optional[Any] = None) -> Any:
     return out
 
 
+def _require_marker_env() -> bool:
+    from horovod_tpu.common.config import _env_on
+    return _env_on("HOROVOD_CKPT_REQUIRE_MARKER", True)
+
+
 def restore_params(path: str, like: Optional[Any] = None,
                    key: str = "params") -> Any:
     """Load ONLY the `key` subtree (default ``"params"``) of a training
@@ -123,6 +134,14 @@ def restore_params(path: str, like: Optional[Any] = None,
     a serving replica can restore weights without constructing (or even
     being able to import) the optimizer that trained them.
 
+    Crash consistency: the ``<path>.done`` commit marker (written by
+    `save` after the orbax write is durable) is verified BEFORE any
+    read, and a partial/corrupt directory raises a typed
+    ``CheckpointCorruptError`` instead of raw orbax/KeyError noise — a
+    serving replica must never boot from a checkpoint whose writer was
+    killed mid-save. ``HOROVOD_CKPT_REQUIRE_MARKER=0`` restores
+    pre-marker checkpoints written by older runs.
+
     The checkpoint is read structure-free (orbax target=None), so the
     optimizer subtree's types never need to be constructible here; when
     `like` is given its structure is validated against the params
@@ -130,7 +149,25 @@ def restore_params(path: str, like: Optional[Any] = None,
     `restore`)."""
     import jax
 
-    tree = restore(path)
+    from horovod_tpu.common.exceptions import CheckpointCorruptError
+    from horovod_tpu.ckpt import manifest as _mf
+
+    apath = os.path.abspath(path)
+    if _require_marker_env() and not _mf.has_done_marker(apath):
+        raise CheckpointCorruptError(
+            f"checkpoint {apath} has no commit marker ({apath}.done): "
+            f"the writer died mid-save, or the checkpoint predates the "
+            f"marker protocol (set HOROVOD_CKPT_REQUIRE_MARKER=0 to "
+            f"read legacy checkpoints)")
+    try:
+        tree = restore(path)
+    except (KeyError, ValueError, FileNotFoundError, OSError) as e:
+        # orbax surfaces partial dirs as raw KeyError/ValueError —
+        # typed here so callers can quarantine-and-fall-back
+        raise CheckpointCorruptError(
+            f"checkpoint {apath} is committed but unreadable "
+            f"(partial/corrupt directory): {type(e).__name__}: "
+            f"{e}") from e
     if not isinstance(tree, dict) or key not in tree:
         have = sorted(tree) if isinstance(tree, dict) else type(tree)
         raise KeyError(

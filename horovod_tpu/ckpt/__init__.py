@@ -1,0 +1,36 @@
+"""horovod_tpu/ckpt — async checkpointing + exactly-once elastic
+step-resume (docs/checkpointing.md).
+
+The preemption-proofing subsystem: a two-phase ``AsyncCheckpointer``
+(device snapshot on the step boundary, persist + atomic commit on a
+background writer — CheckFreq, FAST '21), sharding-aware save/restore
+(replica-0 shard files + manifest PartitionSpecs, re-shardable onto a
+different mesh shape — the GSPMD follow-on), a crash-consistent
+manifest/commit-marker protocol with quarantine fallback, and the
+restore signal that keeps peers' stall watchdogs from expiring during
+a long restore. ``elastic.TrainLoopState`` ties it into the elastic
+retry loop so resumed rounds continue from the last committed step
+instead of restarting the epoch.
+
+    from horovod_tpu import ckpt
+
+    saver = ckpt.AsyncCheckpointer("/ckpts/run1")
+    saver.save(step, {"params": params, "opt_state": opt_state},
+               objects={"step": step, "cursor": cursor})
+    ...
+    got = saver.restore_latest(like={"params": params,
+                                     "opt_state": opt_state})
+"""
+
+from horovod_tpu.common.exceptions import CheckpointCorruptError  # noqa: F401
+from horovod_tpu.ckpt.async_ckpt import (  # noqa: F401
+    AsyncCheckpointer, Restored,
+)
+from horovod_tpu.ckpt.manifest import (  # noqa: F401
+    Manifest, LeafEntry, committed, latest_committed,
+    write_done_marker, has_done_marker, quarantine, sweep_stale,
+)
+from horovod_tpu.ckpt.resume import (  # noqa: F401
+    latest_pointer, load_params, peer_restore_active, restore_latest,
+    signal_restore,
+)

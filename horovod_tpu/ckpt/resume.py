@@ -1,0 +1,269 @@
+"""Restore side: newest-committed walk with quarantine fallback, and
+the cross-rank restore signal that re-arms stall deadlines.
+
+``restore_latest`` walks committed generations newest-first. A
+generation that fails verification (missing marker dir, unreadable
+manifest, absent/truncated shard files) is QUARANTINED — moved under
+``<root>/quarantine/`` with the reason, counted in
+``horovod_ckpt_quarantined_total``, recorded as a flight ``ckpt``
+event — and the walk falls back to the next older generation. Restore
+therefore degrades in freshness, never in correctness.
+
+The restore signal (``signal_restore``): a rank reading a checkpoint
+from disk can take arbitrarily long (cold object store, big model),
+and its PEERS are already parked in the first collective of the round
+— whose StallWatchdog budget (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)
+would otherwise be eaten by the restore and trip a spurious stall
+shutdown. While restoring, the rank heartbeats a ``ckpt/restoring``
+KV key; a peer's watchdog, on reaching its deadline, probes
+``peer_restore_active()`` and — while the signal is fresh — re-arms
+the deadline from *now* (i.e. from restore time, not round start),
+bounded overall by HOROVOD_CKPT_RESTORE_GRACE_MAX. The elastic
+launcher clears the key at every round publication so a dead restorer's
+stale signal can never leak grace into the next round
+(elastic/driver.py RoundPublisher).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from horovod_tpu.common.exceptions import CheckpointCorruptError
+from horovod_tpu.ckpt import manifest as mf
+from horovod_tpu.ckpt import sharded
+from horovod_tpu.ckpt.async_ckpt import ident_fields, kv_from_env
+
+HOROVOD_CKPT_RESTORE_HEARTBEAT = "HOROVOD_CKPT_RESTORE_HEARTBEAT"
+HOROVOD_CKPT_RESTORE_GRACE_MAX = "HOROVOD_CKPT_RESTORE_GRACE_MAX"
+
+KV_SCOPE = "ckpt"
+KV_RESTORING_KEY = "restoring"
+DEFAULT_HEARTBEAT = 1.0
+#: Floor of the staleness window: a restoring signal older than
+#: ``stale_seconds()`` is ignored (dead restorer, or wall-clock skew
+#: larger than the job should tolerate). The window SCALES with the
+#: configured heartbeat (3x, this floor) — a tuned-down heartbeat
+#: cadence must not silently disable the grace it feeds.
+STALE_SECONDS = 10.0
+
+
+def heartbeat_seconds() -> float:
+    return max(0.1, _env_float(HOROVOD_CKPT_RESTORE_HEARTBEAT,
+                               DEFAULT_HEARTBEAT))
+
+
+def stale_seconds() -> float:
+    return max(STALE_SECONDS, 3.0 * heartbeat_seconds())
+
+_local_restoring = threading.Event()
+
+
+def _env_float(name: str, default: float) -> float:
+    from horovod_tpu.common.config import _env_float as shared
+    return shared(name, default)
+
+
+def grace_max_seconds() -> float:
+    return _env_float(HOROVOD_CKPT_RESTORE_GRACE_MAX, 600.0)
+
+
+def latest_pointer(kv: Optional[Any] = None) -> Optional[Dict[str, Any]]:
+    """The writer-published ``ckpt/latest`` pointer
+    ({step, generation, root, time}), or None."""
+    kv = kv or kv_from_env()
+    if kv is None:
+        return None
+    from horovod_tpu.ckpt.async_ckpt import KV_LATEST_KEY
+    try:
+        data = kv.get(KV_SCOPE, KV_LATEST_KEY, timeout=0.0)
+    except Exception:
+        return None
+    if not data:
+        return None
+    try:
+        body = json.loads(data.decode())
+    except ValueError:
+        return None
+    return body if isinstance(body, dict) else None
+
+
+# --------------------------------------------------------------- restore
+def restore_latest(root: str, like: Optional[Any] = None,
+                   mesh: Optional[Any] = None,
+                   specs: Optional[Any] = None,
+                   kv: Optional[Any] = None):
+    """Newest committed checkpoint under `root`, with quarantine
+    fallback. Returns a ckpt.async_ckpt.Restored or None. The whole
+    disk read runs under the restore signal so peers' stall deadlines
+    re-arm instead of expiring."""
+    from horovod_tpu.ckpt.async_ckpt import Restored, _flight, _ident, _mx
+
+    swept = mf.sweep_stale(root)
+    for step in swept:
+        _mx()["quarantined"].inc()
+        _flight(f"quarantine step={step} reason=stale-uncommitted "
+                f"{_ident()}")
+    t0 = time.perf_counter()
+    with signal_restore(kv=kv):
+        for gen, step in reversed(mf.committed(root)):
+            dirpath = os.path.join(root, mf.dirname_for(step))
+            try:
+                man = mf.read_manifest(dirpath)
+                tree = sharded.restore_tree(dirpath, man.leaves,
+                                            like=like)
+                objects: Dict[str, Any] = {}
+                if man.has_objects:
+                    with open(os.path.join(dirpath, mf.OBJECTS_NAME),
+                              "rb") as f:
+                        objects = pickle.load(f)
+            except (CheckpointCorruptError, OSError,
+                    pickle.UnpicklingError, EOFError) as e:
+                mf.quarantine(root, step, f"restore failed: {e}")
+                _mx()["quarantined"].inc()
+                _flight(f"quarantine step={step} gen={gen} "
+                        f"reason={type(e).__name__} {_ident()}")
+                continue
+            if mesh is not None and specs is not None:
+                tree = sharded.reshard(tree, mesh, specs)
+            dt = time.perf_counter() - t0
+            _mx()["restores"].inc()
+            _mx()["restore_s"].set(dt)
+            _flight(f"restore step={step} gen={gen} source=checkpoint "
+                    f"seconds={dt:.3f} {_ident()}")
+            ptr = latest_pointer(kv)
+            if ptr and int(ptr.get("generation", -1)) > gen:
+                # restored an older generation than the job-wide
+                # pointer says exists: surfaced for the doctor's
+                # [ckpt] stale-restore line
+                _flight(f"restore-stale step={step} gen={gen} "
+                        f"latest={int(ptr['generation'])} {_ident()}")
+            return Restored(step=step, generation=gen, tree=tree,
+                            objects=objects)
+    return None
+
+
+def load_params(root: str, key: str = "params",
+                like: Optional[Any] = None) -> Any:
+    """Params-only restore of the newest committed manifest checkpoint
+    (serve/engine.from_checkpoint's ride onto the new restore): the
+    optimizer subtree's leaves are never read from disk at all.
+
+    Both payload layouts the repo writes are accepted: a bare
+    ``{key: ...}`` tree (direct AsyncCheckpointer use) and the
+    TrainLoopState wrapper ``{"trees": {key: ...}}`` (elastic/state.py
+    _payload) — so a replica can serve straight from a live training
+    job's checkpoint root."""
+    latest = mf.latest_committed(root)
+    if latest is None:
+        raise CheckpointCorruptError(
+            f"no committed checkpoint under {root} (no "
+            f"ckpt-*.done marker with a surviving directory)")
+    gen, step = latest
+    dirpath = os.path.join(root, mf.dirname_for(step))
+    man = mf.read_manifest(dirpath)
+    entries = None
+    keypath = (key,)
+    for prefix, kp in ((f"['{key}']", (key,)),
+                       (f"['trees']['{key}']", ("trees", key))):
+        found = [e for e in man.leaves if e.path.startswith(prefix)]
+        if found:
+            entries, keypath = found, kp
+            break
+    if not entries:
+        tops = sorted({e.path.split("]")[0] + "]" for e in man.leaves})
+        raise KeyError(
+            f"checkpoint generation {gen} at {dirpath} has no {key!r} "
+            f"subtree (top-level keys: {tops}); pass key=... for "
+            f"checkpoints saved under a different name")
+    if like is not None:
+        wrapped = like
+        for k in reversed(keypath):
+            wrapped = {k: wrapped}
+        out = sharded.restore_tree(dirpath, entries, like=wrapped)
+    else:
+        out = sharded.restore_tree(dirpath, entries)
+    for k in keypath:
+        out = out[k]
+    return out
+
+
+# -------------------------------------------------------- restore signal
+class _RestoreSignal:
+    """Heartbeats ``ckpt/restoring`` while a disk restore runs."""
+
+    def __init__(self, kv: Optional[Any]) -> None:
+        self._kv = kv
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.heartbeat = heartbeat_seconds()
+
+    def _beat(self) -> None:
+        body = dict(ident_fields())
+        while not self._stop.is_set():
+            body["ts"] = time.time()
+            try:
+                self._kv.put(KV_SCOPE, KV_RESTORING_KEY,
+                             json.dumps(body).encode())
+            except Exception:
+                pass
+            self._stop.wait(self.heartbeat)
+
+    def __enter__(self):
+        _local_restoring.set()
+        if self._kv is None:
+            self._kv = kv_from_env()
+        if self._kv is not None:
+            self._thread = threading.Thread(
+                target=self._beat, name="hvd-ckpt-restore-signal",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if self._kv is not None:
+            try:
+                body = dict(ident_fields())
+                body["ts"] = 0.0  # done: explicitly stale
+                self._kv.put(KV_SCOPE, KV_RESTORING_KEY,
+                             json.dumps(body).encode())
+            except Exception:
+                pass
+        _local_restoring.clear()
+        return False
+
+
+def signal_restore(kv: Optional[Any] = None) -> _RestoreSignal:
+    return _RestoreSignal(kv)
+
+
+def peer_restore_active(kv: Optional[Any] = None) -> bool:
+    """True while some rank's restore signal is FRESH (heartbeat within
+    ``stale_seconds()``). The StallWatchdog's grace probe
+    (ops/collectives.py): while true, a deadline-hit wait re-arms
+    instead of raising. Local restores (same process, another thread)
+    count too, without a KV round-trip."""
+    if _local_restoring.is_set():
+        return True
+    kv = kv or kv_from_env()
+    if kv is None:
+        return False
+    try:
+        data = kv.get(KV_SCOPE, KV_RESTORING_KEY, timeout=0.0)
+    except Exception:
+        return False
+    if not data:
+        return False
+    try:
+        body = json.loads(data.decode())
+        ts = float(body.get("ts", 0.0))
+    except (ValueError, TypeError, AttributeError):
+        return False
+    return 0.0 < ts and (time.time() - ts) < stale_seconds()

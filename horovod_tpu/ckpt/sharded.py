@@ -1,0 +1,287 @@
+"""Sharding-aware snapshot/assemble for checkpoint pytrees.
+
+The save side of the GSPMD follow-on ("checkpoint/serve sharded
+models", docs/parallelism.md): a leaf that is a sharded ``jax.Array``
+is snapshotted SHARD-WISE from ``addressable_shards`` keeping only
+``replica_id == 0`` — on a ``dp x tp`` mesh that is exactly "each
+dp-replica-0 rank along the batch axis writes only its model shards":
+the tp-distinct shards are written once each, the dp copies are not
+written at all. The manifest records every shard's slice of the global
+array plus the leaf's PartitionSpec and the mesh axis sizes at save
+time, so restore can
+
+* reassemble the FULL host array from the shard files (coverage
+  verified — a missing/truncated shard is a typed
+  CheckpointCorruptError, never a silent zero-block), and
+* re-shard it onto a DIFFERENT mesh shape (tp=4 -> tp=2 resume): the
+  assembled global array is ``jax.device_put`` under the new mesh's
+  NamedSharding, so the new shard boundaries need not match the old.
+
+Host-side trees (plain numpy, the pure-DP elastic path) take the same
+code path with one full-coverage "shard" per leaf.
+
+Device→host mechanics: ``snapshot_tree`` is the only phase that touches
+device memory — it blocks until the tree's buffers are ready
+(``jax.block_until_ready``) and copies each kept shard to host. It is
+the bounded, on-critical-path half of the two-phase save
+(ckpt/async_ckpt.py runs it under the perfscope ``checkpoint`` phase);
+everything else in this module is host-only.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from horovod_tpu.common.exceptions import CheckpointCorruptError
+from horovod_tpu.ckpt.manifest import LeafEntry
+
+
+def _keypath_str(kp) -> str:
+    import jax
+    return jax.tree_util.keystr(kp)
+
+
+def spec_to_json(spec) -> Optional[List[Any]]:
+    """PartitionSpec -> JSON (per-dim axis-name list or None)."""
+    if spec is None:
+        return None
+    out: List[Any] = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append([str(entry)])
+    return out
+
+
+def spec_from_json(spec_json: Optional[List[Any]]):
+    """JSON -> PartitionSpec (None stays None)."""
+    if spec_json is None:
+        return None
+    from jax.sharding import PartitionSpec as P
+    entries = []
+    for entry in spec_json:
+        if entry is None:
+            entries.append(None)
+        elif len(entry) == 1:
+            entries.append(entry[0])
+        else:
+            entries.append(tuple(entry))
+    return P(*entries)
+
+
+class LeafSnapshot:
+    """One leaf's host copy: manifest entry (files unfilled) + the
+    shard payloads to be written by the background persist phase."""
+
+    __slots__ = ("entry", "shards")
+
+    def __init__(self, entry: LeafEntry,
+                 shards: List[Tuple[Tuple[int, ...], Tuple[int, ...],
+                                    np.ndarray]]):
+        self.entry = entry
+        self.shards = shards  # [(start, stop, host array)]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for _, _, a in self.shards)
+
+
+def _norm_index(index, shape: Tuple[int, ...]
+                ) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """A shard's `.index` (tuple of slices into the global shape) ->
+    (start, stop) int tuples."""
+    start, stop = [], []
+    for sl, dim in zip(index, shape):
+        a, b, _ = sl.indices(dim)
+        start.append(int(a))
+        stop.append(int(b))
+    return tuple(start), tuple(stop)
+
+
+def snapshot_tree(tree: Any) -> Tuple[List[LeafSnapshot], int]:
+    """Device→host snapshot of every array leaf, shard-aware.
+
+    Returns (snapshots in flatten order, total host bytes). Blocks
+    until the device buffers are ready — this is the only part of a
+    save that sits on the training critical path.
+    """
+    import jax
+
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(tree)[0]
+    arrays = [l for _, l in leaves_with_path
+              if isinstance(l, jax.Array)]
+    if arrays:
+        jax.block_until_ready(arrays)
+    out: List[LeafSnapshot] = []
+    total = 0
+    for kp, leaf in leaves_with_path:
+        path = _keypath_str(kp)
+        spec_json = None
+        mesh_axes = None
+        if isinstance(leaf, jax.Array):
+            shape = tuple(leaf.shape)
+            dtype = np.dtype(leaf.dtype).name
+            sharding = getattr(leaf, "sharding", None)
+            from jax.sharding import NamedSharding
+            if isinstance(sharding, NamedSharding):
+                spec_json = spec_to_json(sharding.spec)
+            shards = []
+            for sh in leaf.addressable_shards:
+                if sh.replica_id != 0:
+                    continue
+                start, stop = _norm_index(sh.index, shape)
+                shards.append((start, stop, np.asarray(sh.data)))
+            if not shards:
+                # every addressable shard is a replica of one held by
+                # another process: nothing to write from here
+                pass
+        else:
+            arr = np.asarray(leaf)
+            shape = tuple(arr.shape)
+            dtype = arr.dtype.name
+            shards = [(tuple(0 for _ in shape), shape, arr)]
+        entry = LeafEntry(path=path, shape=shape, dtype=dtype,
+                          spec=spec_json)
+        snap = LeafSnapshot(entry, shards)
+        total += snap.nbytes
+        out.append(snap)
+    return out, total
+
+
+def write_snapshots(dirpath: str, snaps: Sequence[LeafSnapshot]) -> int:
+    """Persist every shard payload as `.npy` files into `dirpath`,
+    filling each entry's `files` list. Host-only (the background
+    phase). Returns bytes written.
+
+    Shard files are named by their START OFFSETS into the global
+    array, not by a local enumeration index: in a multi-writer save
+    every process persists into the SAME directory, and offset names
+    are globally unique per distinct shard (replica_id==0 is held by
+    exactly one process per shard), so concurrent writers can never
+    clobber each other's shards — and the primary's merge can safely
+    dedupe fragments by filename (same name ⇒ same shard)."""
+    os.makedirs(dirpath, exist_ok=True)
+    written = 0
+    for i, snap in enumerate(snaps):
+        snap.entry.files = []
+        full = len(snap.shards) == 1 and \
+            snap.shards[0][0] == tuple(0 for _ in snap.entry.shape) and \
+            snap.shards[0][1] == snap.entry.shape
+        for start, stop, arr in snap.shards:
+            off = "" if full else \
+                ".o" + "-".join(str(a) for a in start)
+            name = f"leaf-{i:05d}{off}.npy"
+            np.save(os.path.join(dirpath, name), arr,
+                    allow_pickle=False)
+            written += int(arr.nbytes)
+            snap.entry.files.append({"file": name, "start": list(start),
+                                     "stop": list(stop)})
+    return written
+
+
+def assemble_leaf(dirpath: str, entry: LeafEntry) -> np.ndarray:
+    """Shard files -> full host array, coverage-verified."""
+    dtype = np.dtype(entry.dtype)
+    arr = np.empty(entry.shape, dtype=dtype)
+    covered = 0
+    for f in entry.files:
+        p = os.path.join(dirpath, f["file"])
+        try:
+            part = np.load(p, allow_pickle=False)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint leaf shard unreadable: {p}: "
+                f"{type(e).__name__}: {e}") from e
+        want = tuple(b - a for a, b in zip(f["start"], f["stop"]))
+        if tuple(part.shape) != want:
+            raise CheckpointCorruptError(
+                f"checkpoint leaf shard {p} has shape {part.shape}, "
+                f"manifest says {want}")
+        sl = tuple(slice(a, b) for a, b in zip(f["start"], f["stop"]))
+        arr[sl] = part
+        covered += part.size
+    if covered < arr.size:
+        raise CheckpointCorruptError(
+            f"checkpoint leaf {entry.path!r} incompletely covered: "
+            f"{covered}/{arr.size} elements present in "
+            f"{len(entry.files)} shard file(s) under {dirpath}")
+    return arr
+
+
+def _parse_dict_keypath(path: str) -> Optional[List[str]]:
+    """``"['params']['emb']"`` -> ``["params", "emb"]``; None when the
+    keypath contains non-dict components (then `like` is required)."""
+    out: List[str] = []
+    rest = path
+    while rest:
+        m = re.match(r"^\[(?:'([^']*)'|\"([^\"]*)\")\]", rest)
+        if not m:
+            return None
+        out.append(m.group(1) if m.group(1) is not None else m.group(2))
+        rest = rest[m.end():]
+    return out
+
+
+def restore_tree(dirpath: str, entries: Sequence[LeafEntry],
+                 like: Optional[Any] = None) -> Any:
+    """Manifest entries -> pytree of host arrays.
+
+    With `like`: leaves are matched by keypath against `like`'s
+    structure (a mismatch is a CheckpointCorruptError naming the missing
+    path) and the result has `like`'s treedef, with numpy-scalar leaves
+    in `like` coerced back to their scalar types. Without `like`: the
+    tree is rebuilt as nested dicts from the recorded keypaths
+    (dict-only trees; anything else needs `like`).
+    """
+    import jax
+
+    by_path: Dict[str, LeafEntry] = {e.path: e for e in entries}
+    if like is not None:
+        leaves_with_path, treedef = \
+            jax.tree_util.tree_flatten_with_path(like)
+        out_leaves = []
+        for kp, l in leaves_with_path:
+            path = _keypath_str(kp)
+            e = by_path.get(path)
+            if e is None:
+                raise CheckpointCorruptError(
+                    f"checkpoint at {dirpath} has no leaf {path!r} "
+                    f"(has: {sorted(by_path)[:8]}...)")
+            arr = assemble_leaf(dirpath, e)
+            if isinstance(l, np.generic):
+                out_leaves.append(type(l)(arr[()]))
+            else:
+                out_leaves.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+    root: Dict[str, Any] = {}
+    for e in entries:
+        keys = _parse_dict_keypath(e.path)
+        if keys is None:
+            raise CheckpointCorruptError(
+                f"checkpoint leaf {e.path!r} is not dict-addressed; "
+                f"restore it with like=<matching pytree>")
+        node = root
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = assemble_leaf(dirpath, e)
+    return root
+
+
+def reshard(tree: Any, mesh, specs: Any) -> Any:
+    """Host tree -> device tree under `mesh` with per-leaf
+    PartitionSpecs (the mesh-shape-changing restore: the assembled
+    global arrays are placed under the NEW mesh's shardings, which need
+    not match the shard boundaries the checkpoint was written with)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, specs)

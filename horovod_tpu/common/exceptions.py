@@ -59,6 +59,17 @@ class CollectiveDivergenceError(HorovodTpuError):
     ally divergent — it would diverge again every round."""
 
 
+class CheckpointCorruptError(HorovodTpuError):
+    """A checkpoint directory failed verification: missing `.done`
+    commit marker, unreadable/partial manifest, or leaf files absent or
+    truncated (horovod_tpu/ckpt/, checkpoint.py). Typed so restore
+    paths can quarantine-and-fall-back (ckpt/resume) or fail loudly
+    (serve/engine.from_checkpoint) instead of pattern-matching raw
+    orbax/KeyError noise. Deliberately NOT a HorovodInternalError: the
+    elastic retry loop must not re-rendezvous over a corrupt artifact —
+    it would re-read the same bytes every round."""
+
+
 class RetryError(HorovodTpuError):
     """A RetryPolicy exhausted its attempts or overall deadline.
 

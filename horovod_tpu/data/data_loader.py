@@ -126,6 +126,15 @@ class ShardedDataset(BaseDataLoader):
         """Mark one batch consumed (for elastic resume)."""
         self.processed_indices += self.batch_size
 
+    def skip_to(self, processed: int) -> None:
+        """Position the stream at an absolute per-rank record offset —
+        the checkpoint data-cursor restore
+        (elastic.TrainLoopState.apply_to_loader): a mid-epoch resume
+        continues from the first unconsumed record of the SAME
+        shuffled order (epoch seed unchanged) instead of replaying the
+        epoch from record 0."""
+        self.processed_indices = max(0, int(processed))
+
     def _indices(self):
         np = self._np
         n = len(self.data)

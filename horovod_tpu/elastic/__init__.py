@@ -23,7 +23,9 @@ from typing import Callable
 
 from horovod_tpu.common.exceptions import (HorovodInternalError,
                                            HostsUpdatedInterrupt)
-from horovod_tpu.elastic.state import JaxState, ObjectState, State  # noqa: F401
+from horovod_tpu.elastic.state import (  # noqa: F401
+    JaxState, ObjectState, State, TrainLoopState,
+)
 from horovod_tpu.elastic.discovery import (  # noqa: F401
     FixedHosts, HostDiscovery, HostDiscoveryScript, HostManager,
 )

@@ -449,6 +449,18 @@ class RoundPublisher:
         import json as _json
 
         coord = self._make_service(round_id, len(slots))
+        # Clear any previous round's checkpoint-restore signal BEFORE
+        # workers can observe the bump: the signal grants stall-deadline
+        # grace (ops/collectives.py StallWatchdog re-arm), and a rank
+        # that died MID-restore last round must not leak grace into this
+        # one — resumed rounds re-arm the deadline from *this* round's
+        # restore time, not from stale evidence (ckpt/resume.py).
+        try:
+            from horovod_tpu.ckpt import resume as _ckpt_resume
+            self.rdv.put(_ckpt_resume.KV_SCOPE,
+                         _ckpt_resume.KV_RESTORING_KEY, b"")
+        except Exception:
+            pass
         for s in slots:
             record = _dc.asdict(s)
             record["coord"] = coord

@@ -155,3 +155,160 @@ def _is_pytree_of_arrays(v: Any) -> bool:
     leaves = jax.tree_util.tree_leaves(v)
     return bool(leaves) and all(
         isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
+
+
+HOROVOD_CKPT_DIR = "HOROVOD_CKPT_DIR"
+HOROVOD_CKPT_EVERY = "HOROVOD_CKPT_EVERY"
+HOROVOD_CKPT_RESUME = "HOROVOD_CKPT_RESUME"
+
+
+class TrainLoopState(JaxState):
+    """The exactly-once elastic resume unit (docs/checkpointing.md):
+    params + optimizer state + step counter + data-stream cursor
+    (records consumed this epoch) + RNG state, tied to an
+    ``ckpt.AsyncCheckpointer`` so elastic rounds resume from the newest
+    COMMITTED checkpoint instead of restarting the epoch.
+
+    The resume decision lives in ``sync()``: before rank 0 broadcasts
+    its state to the round's workers, it compares its in-memory step
+    against the newest committed generation on disk (and the KV
+    ``ckpt/latest`` pointer). A surviving worker's memory is always at
+    least as fresh as disk — it keeps its state and the round costs
+    nothing; a freshly-booted rank 0 (whole-job preemption) finds disk
+    ahead and restores before broadcasting, so every rank — survivors
+    and joiners alike — converges on the same generation through the
+    same named broadcast the fingerprint verifier already checks.
+
+    The checkpointer attaches explicitly (``checkpointer=``/``root=``)
+    or from HOROVOD_CKPT_DIR; HOROVOD_CKPT_EVERY (steps) drives
+    ``maybe_checkpoint``; HOROVOD_CKPT_RESUME=0 disables the restore
+    probe (debugging: always start fresh).
+    """
+
+    def __init__(self, params: Any = None, opt_state: Any = None,
+                 step: int = 0, epoch: int = 0, cursor: int = 0,
+                 rng: Any = None, checkpointer: Any = None,
+                 root: Optional[str] = None, **kwargs):
+        import os
+        self._ckpt = checkpointer
+        if self._ckpt is None:
+            root = root or os.environ.get(HOROVOD_CKPT_DIR, "")
+            if root:
+                from horovod_tpu.ckpt import AsyncCheckpointer
+                self._ckpt = AsyncCheckpointer(root)
+        try:
+            self.every_n = max(
+                0, int(os.environ.get(HOROVOD_CKPT_EVERY, "") or 0))
+        except ValueError:
+            self.every_n = 0
+        super().__init__(params=params, opt_state=opt_state, step=step,
+                         epoch=epoch, cursor=cursor, rng=rng, **kwargs)
+
+    # ------------------------------------------------------------ plumbing
+    @property
+    def checkpointer(self):
+        return self._ckpt
+
+    def attach_checkpointer(self, ckpt) -> None:
+        self._ckpt = ckpt
+
+    def record_batch(self, records: int) -> None:
+        """Advance the data-stream cursor by `records` consumed
+        RECORDS — pass the batch's length, not 1: the cursor is a
+        record offset, the unit ``apply_to_loader`` hands to
+        ``ShardedDataset.skip_to`` (a per-batch count would make a
+        resume under-skip by batch_size and replay trained batches,
+        breaking exactly-once)."""
+        self.cursor = int(self.cursor) + int(records)
+
+    def apply_to_loader(self, loader) -> None:
+        """Point a data/ loader at this state's position: epoch first
+        (reshuffle), then skip the already-consumed records —
+        mid-epoch resume never replays a batch (exactly-once)."""
+        loader.set_epoch(int(self.epoch))
+        skip = getattr(loader, "skip_to", None)
+        if skip is not None:
+            skip(int(self.cursor))
+
+    def next_epoch(self) -> None:
+        self.epoch = int(self.epoch) + 1
+        self.cursor = 0
+
+    # ---------------------------------------------------------- checkpoint
+    def _payload(self):
+        """(tree, objects) of the last COMMITTED snapshot — never live
+        values (the checkpoint.save_state contract: a mid-step save
+        must not capture uncommitted state)."""
+        trees = {k: v for k, v in self._saved_trees.items()
+                 if v is not None}
+        return {"trees": trees}, dict(self._saved)
+
+    def checkpoint(self, block: bool = False) -> bool:
+        """Async-save the last commit()'s snapshot at this step
+        boundary. Returns the checkpointer's accepted/skipped verdict
+        (False also when no checkpointer is attached)."""
+        if self._ckpt is None:
+            return False
+        tree, objects = self._payload()
+        step = int(objects.get("step", getattr(self, "step", 0)) or 0)
+        return self._ckpt.save(step, tree, objects=objects, block=block)
+
+    def maybe_checkpoint(self) -> bool:
+        """commit-then-save every HOROVOD_CKPT_EVERY steps (no-op when
+        the knob is unset)."""
+        if self._ckpt is None or self.every_n <= 0:
+            return False
+        if int(self.step) % self.every_n != 0:
+            return False
+        return self.checkpoint()
+
+    # -------------------------------------------------------------- resume
+    @staticmethod
+    def _resume_enabled() -> bool:
+        from horovod_tpu.common.config import _env_on
+        return _env_on(HOROVOD_CKPT_RESUME, True)
+
+    def maybe_resume(self) -> bool:
+        """Rank 0's restore probe (see class docstring). Returns True
+        when a disk restore happened. ``last_resume_source`` records
+        the decision ("checkpoint"/"memory"/None) for logging."""
+        self.last_resume_source = None
+        if self._ckpt is None or not self._resume_enabled():
+            return False
+        from horovod_tpu.core import topology
+        rank = topology.rank_or_none()
+        if rank not in (None, 0):
+            return False  # followers adopt rank 0's state via sync()
+        from horovod_tpu.ckpt import manifest as _mf
+        latest = _mf.latest_committed(self._ckpt.root)
+        if latest is None:
+            return False
+        gen, disk_step = latest
+        mem_step = int(getattr(self, "step", 0) or 0)
+        if disk_step <= mem_step:
+            # survivor: in-memory state is at least as fresh — the
+            # round resumes from memory, and the doctor's [ckpt]
+            # section can see that it did
+            from horovod_tpu.observability import flight
+            from horovod_tpu.ckpt.async_ckpt import _ident
+            flight.record(
+                "ckpt", f"restore step={mem_step} gen={gen} "
+                f"source=memory {_ident()}")
+            self.last_resume_source = "memory"
+            return False
+        like, _ = self._payload()
+        got = self._ckpt.restore_latest(like=like)
+        if got is None:
+            return False
+        for k, v in got.tree.get("trees", {}).items():
+            self._saved_trees[k] = v
+        for k, v in got.objects.items():
+            self._saved[k] = v
+            self._known_attrs.add(k)
+        self.restore()
+        self.last_resume_source = "checkpoint"
+        return True
+
+    def sync(self) -> None:
+        self.maybe_resume()
+        super().sync()

@@ -641,6 +641,133 @@ def analyze_serve(dumps: List[RankDump]) -> Optional[Dict[str, Any]]:
     }
 
 
+def _ckpt_fields(desc: str) -> Dict[str, Any]:
+    """Parse the key=value fields of a flight `ckpt` event desc
+    (ckpt/async_ckpt.py formats them; first token is the verb)."""
+    import re
+    out: Dict[str, Any] = {"verb": desc.split(" ", 1)[0]}
+    for k in ("step", "gen", "bytes", "rank", "round", "latest",
+              "skipped"):
+        m = re.search(rf"\b{k}=(-?\d+)", desc)
+        if m:
+            out[k] = int(m.group(1))
+    m = re.search(r"\bseconds=([0-9.]+)", desc)
+    if m:
+        out["seconds"] = float(m.group(1))
+    m = re.search(r"\bsource=(\S+)", desc)
+    if m:
+        out["source"] = m.group(1)
+    m = re.search(r"\breason=(\S+)", desc)
+    if m:
+        out["reason"] = m.group(1)
+    return out
+
+
+def analyze_ckpt(dumps: List[RankDump]) -> Optional[Dict[str, Any]]:
+    """The [ckpt] section (docs/checkpointing.md): per elastic round,
+    the last COMMITTED checkpoint generation; every restore with its
+    source (checkpoint vs memory) and generation — flagging any rank
+    that restored a generation older than the newest one committed in
+    its round (a stale restore: that rank trained from older weights
+    than its peers could have); quarantines, back-pressure skips, and
+    persist errors."""
+    commits: Dict[int, Dict[str, Any]] = {}   # round -> newest commit
+    commit_times: List[Tuple[float, int]] = []  # (wall time, generation)
+    restores: List[Dict[str, Any]] = []
+    quarantines: List[Dict[str, Any]] = []
+    skipped: Dict[int, int] = {}              # rank -> max skip count
+    errors: List[str] = []
+    rearm = 0
+    seen = False
+    seen_keys: set = set()  # (ts, desc): full dump + KV tail dedupe
+    for d in dumps:
+        for ev in d.events:
+            if len(ev) < 4 or ev[2] != "ckpt":
+                continue
+            key = (float(ev[1]), str(ev[3]))
+            if key in seen_keys:
+                continue
+            seen_keys.add(key)
+            seen = True
+            desc = str(ev[3])
+            f = _ckpt_fields(desc)
+            rnd = f.get("round", 0)
+            verb = f["verb"]
+            if verb == "commit":
+                cur = commits.get(rnd)
+                if cur is None or f.get("gen", -1) > cur["generation"]:
+                    commits[rnd] = {"generation": f.get("gen"),
+                                    "step": f.get("step"),
+                                    "rank": f.get("rank")}
+                if f.get("gen") is not None:
+                    commit_times.append((float(ev[1]), f["gen"]))
+            elif verb == "restore":
+                restores.append({
+                    "rank": f.get("rank"), "round": rnd,
+                    "generation": f.get("gen"), "step": f.get("step"),
+                    "source": f.get("source", "?"),
+                    "seconds": f.get("seconds"), "time": float(ev[1])})
+            elif verb == "restore-stale":
+                # An ANNOTATION of the restore the same rank just
+                # recorded (resume.py emits both for one restore) —
+                # fold it into that entry rather than duplicating it.
+                match = next(
+                    (r for r in reversed(restores)
+                     if r["rank"] == f.get("rank")
+                     and r["round"] == rnd
+                     and r.get("generation") == f.get("gen")
+                     and "stale_vs" not in r), None)
+                if match is not None:
+                    match["stale_vs"] = f.get("latest")
+                else:
+                    restores.append({
+                        "rank": f.get("rank"), "round": rnd,
+                        "generation": f.get("gen"),
+                        "step": f.get("step"),
+                        "source": "checkpoint",
+                        "stale_vs": f.get("latest"),
+                        "time": float(ev[1])})
+            elif verb == "quarantine":
+                quarantines.append({
+                    "rank": f.get("rank"), "round": rnd,
+                    "step": f.get("step"),
+                    "reason": f.get("reason", desc)})
+            elif verb == "skip":
+                r = f.get("rank", -1)
+                skipped[r] = max(skipped.get(r, 0),
+                                 f.get("skipped", 1))
+            elif verb in ("persist-error", "commit-abort"):
+                errors.append(desc)
+            elif verb == "stall" or desc.startswith("stall deadline"):
+                rearm += 1
+    if not seen:
+        return None
+    stale: List[Dict[str, Any]] = []
+    for r in restores:
+        if r.get("source") != "checkpoint":
+            continue
+        newest = r.get("stale_vs")
+        if newest is None:
+            # A restore is stale relative to what was committed BEFORE
+            # it happened — a commit made later in the same round (by
+            # the resumed training itself) is not evidence of
+            # staleness, so the comparison is time-ordered.
+            before = [g for t, g in commit_times if t <= r["time"]]
+            newest = max(before) if before else None
+        if newest is not None and r.get("generation") is not None \
+                and r["generation"] < newest:
+            stale.append({**r, "stale_vs": newest})
+    return {
+        "rounds": {str(k): v for k, v in sorted(commits.items())},
+        "restores": sorted(restores, key=lambda x: x["time"]),
+        "stale_restores": stale,
+        "quarantines": quarantines,
+        "skipped": {str(k): v for k, v in sorted(skipped.items())},
+        "errors": errors[:10],
+        "stall_rearms": rearm,
+    }
+
+
 def dedupe(dumps: List[RankDump]) -> List[RankDump]:
     """Collapse redundant dumps, keeping non-overlapping evidence.
 
@@ -776,6 +903,7 @@ def merge(dumps: List[RankDump], tail: int = 8,
         "groups": groups,
         "perf": analyze_perf(dedupe_perf(perf)) if perf else None,
         "serve": analyze_serve(dumps),
+        "ckpt": analyze_ckpt(dumps),
         "per_rank": {},
     }
     report["anomalies"] = analyze_anomalies(
@@ -915,6 +1043,48 @@ def render(report: Dict[str, Any], tail: int = 8) -> str:
                 f"survivors")
         if not serve["deaths"]:
             add("  no replica deaths recorded")
+        add("")
+    ck = report.get("ckpt")
+    if ck:
+        add("[ckpt] checkpointing (flight `ckpt` events; "
+            "docs/checkpointing.md)")
+        for rnd, c in sorted(ck["rounds"].items(),
+                             key=lambda kv: int(kv[0])):
+            tag = "" if int(rnd) == 0 else f"round {rnd}: "
+            add(f"  {tag}last committed generation "
+                f"{c['generation']} (step {c['step']}, written by "
+                f"rank {c['rank']})")
+        if not ck["rounds"]:
+            add("  no commit recorded in any retained window")
+        for r in ck["restores"]:
+            rnd = "" if not r.get("round") else f" round {r['round']}"
+            if r["source"] == "memory":
+                add(f"  rank {r['rank']}{rnd}: resumed from MEMORY at "
+                    f"step {r['step']} (survivor — disk not needed)")
+            else:
+                secs = f" in {r['seconds']:.2f}s" \
+                    if r.get("seconds") is not None else ""
+                add(f"  rank {r['rank']}{rnd}: restored generation "
+                    f"{r['generation']} (step {r['step']}) from "
+                    f"checkpoint{secs}")
+        for s in ck["stale_restores"]:
+            rnd = "" if not s.get("round") else f" round {s['round']}"
+            add(f"  STALE RESTORE rank {s['rank']}{rnd}: restored "
+                f"generation {s['generation']} but generation "
+                f"{s['stale_vs']} was committed — this rank trained "
+                f"from older weights than its peers could have")
+        for q in ck["quarantines"]:
+            add(f"  QUARANTINED step {q['step']}: {q['reason']} "
+                f"(rank {q['rank']})")
+        for r, n in sorted(ck["skipped"].items()):
+            add(f"  rank {r}: {n} save(s) skipped by back-pressure "
+                f"(writer busy — checkpoint freshness lost, step time "
+                f"preserved)")
+        for e in ck["errors"]:
+            add(f"  PERSIST ERROR: {e}")
+        if ck.get("stall_rearms"):
+            add(f"  stall deadline re-armed {ck['stall_rearms']} "
+                f"time(s) while a peer restored")
         add("")
     perf = report.get("perf")
     if perf:

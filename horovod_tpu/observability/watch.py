@@ -88,6 +88,7 @@ WATCH_BURN_RATE_ENV = "HOROVOD_WATCH_BURN_RATE"
 WATCH_CHURN_ROUNDS_ENV = "HOROVOD_WATCH_CHURN_ROUNDS"
 WATCH_CHURN_WINDOW_ENV = "HOROVOD_WATCH_CHURN_WINDOW_SECONDS"
 WATCH_AGGREGATE_ENV = "HOROVOD_WATCH_AGGREGATE_SECONDS"
+WATCH_CKPT_SKIPPED_ENV = "HOROVOD_WATCH_CKPT_SKIPPED"
 
 #: Rendezvous-KV scope the per-rank anomaly records live under.
 SCOPE = "watch"
@@ -406,12 +407,22 @@ class Watcher:
                 max_events=_env_int(WATCH_CHURN_ROUNDS_ENV, 3),
                 window_s=_env_float(WATCH_CHURN_WINDOW_ENV, 600.0),
                 cooldown_s=cool),
+            # Sustained checkpoint back-pressure: the async writer
+            # (ckpt/async_ckpt.py) skips-and-counts saves while busy —
+            # skipping EVERY tick means the persist tier can't keep up
+            # and checkpoint freshness (the preemption recovery point)
+            # is silently aging.
+            "ckpt_skipped": ThresholdDetector(
+                "ckpt_skipped",
+                _env_float(WATCH_CKPT_SKIPPED_ENV, 0.5),
+                hysteresis=hyst, cooldown_s=cool),
         }
         self._records: List[Dict[str, Any]] = []  # guarded-by: _lock
         self._counts: Dict[str, int] = {}  # guarded-by: _lock
         self._last_step = 0  # guarded-by: _lock
         self._last_round: Optional[int] = None  # guarded-by: _lock
         self._serve_prev: Optional[Dict[str, Any]] = None  # guarded-by: _lock
+        self._ckpt_skipped_prev: Optional[float] = None  # guarded-by: _lock
         self.slo_s = _env_float(WATCH_SERVE_SLO_MS_ENV, 1000.0) / 1e3
         self.budget = _env_float(WATCH_SERVE_BUDGET_ENV, 0.01)
         self._kv = None
@@ -532,6 +543,17 @@ class Watcher:
                     a = det["serve_burn"].observe(burn, now)
                     if a:
                         triggered.append(a)
+                # Checkpoint back-pressure: per-tick delta of the
+                # writer's skip counter (ckpt/async_ckpt.py).
+                skipped = self._gauge_value("horovod_ckpt_skipped_total")
+                if skipped is not None:
+                    prev = self._ckpt_skipped_prev
+                    self._ckpt_skipped_prev = skipped
+                    if prev is not None:
+                        a = det["ckpt_skipped"].observe(
+                            max(0.0, skipped - prev), now)
+                        if a:
+                            triggered.append(a)
             step = scope.step_count()
             for a in triggered:
                 a.update({"rank": ident["rank"], "round": rnd,

@@ -88,6 +88,24 @@ def is_comm_failure(e: BaseException) -> bool:
     return any(m in msg for m in _COMM_FAILURE_MARKERS)
 
 
+def _restore_grace_active(first_start: float, shutdown_sec: float) -> bool:
+    """True while a peer's checkpoint restore should extend the stall
+    deadline: the ckpt restore signal is fresh AND the total wait has
+    not exhausted shutdown + HOROVOD_CKPT_RESTORE_GRACE_MAX. Probed at
+    most once per armed deadline window (each re-arm buys a full
+    shutdown_sec before the next probe), so the KV cost is negligible.
+    Guarded: a broken ckpt import must not change watchdog behavior."""
+    import time as _time
+    try:
+        from horovod_tpu.ckpt import resume as _ckpt_resume
+        if _time.monotonic() - first_start >= \
+                shutdown_sec + _ckpt_resume.grace_max_seconds():
+            return False
+        return _ckpt_resume.peer_restore_active()
+    except Exception:
+        return False
+
+
 class StallWatchdog:
     """Python-side watchdog over a blocking collective wait.
 
@@ -137,6 +155,7 @@ class StallWatchdog:
         t = threading.Thread(target=run, daemon=True,
                              name=f"hvd-guarded-wait-{name}")
         start = _time.monotonic()
+        first_start = start
         t.start()
         warned = False
         try:
@@ -153,6 +172,27 @@ class StallWatchdog:
                         "collective '%s' stalled for %.1fs "
                         "(HOROVOD_STALL_CHECK_TIME_SECONDS=%.0f)",
                         name, age, self.warn_sec)
+                if self.shutdown_sec > 0 and age >= self.shutdown_sec \
+                        and _restore_grace_active(first_start,
+                                                 self.shutdown_sec):
+                    # A rank is mid-checkpoint-restore (ckpt/resume
+                    # heartbeat): its peers legitimately wait longer
+                    # than the stall budget. Re-arm the deadline from
+                    # NOW — i.e. from restore time, not round start —
+                    # bounded overall by
+                    # HOROVOD_CKPT_RESTORE_GRACE_MAX so a wedged
+                    # restorer still cannot hang the job forever.
+                    start = _time.monotonic()
+                    _flight.record(
+                        "ckpt", f"stall deadline re-armed for "
+                        f"'{name}': peer checkpoint restore in "
+                        f"progress (waited "
+                        f"{start - first_start:.1f}s total)")
+                    get_logger().info(
+                        "collective '%s': stall deadline re-armed — "
+                        "a peer's checkpoint restore is in progress",
+                        name)
+                    continue
                 if self.shutdown_sec > 0 and age >= self.shutdown_sec:
                     stalled, _ = self.inspector.check()
                     _mx()["stall_shut"].inc()

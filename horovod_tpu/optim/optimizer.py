@@ -117,6 +117,45 @@ def grad_axes_from_specs(param_specs: Any, mesh) -> Any:
         leaf, param_specs, is_leaf=lambda x: isinstance(x, P) or x is None)
 
 
+def opt_state_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
+    """Per-leaf PartitionSpecs for an optax state — the restore-side
+    twin of "moments inherit the parameter shardings" (the save side
+    needs nothing: ckpt/sharded.py reads each array's ACTUAL sharding).
+
+    Needed when a sharded checkpoint is restored onto a *different*
+    mesh shape (docs/checkpointing.md): the params' target specs are
+    known (`param_specs`), but the optimizer state's must be derived.
+    The rule matches what GSPMD propagates in `build_sharded_train_step`:
+    a state leaf whose (shape, dtype) matches a parameter's takes that
+    parameter's spec (adam mu/nu, sgd momentum); everything else
+    (counts, scalar schedules) is replicated. Ambiguity between
+    parameters that share a shape but carry DIFFERENT specs falls back
+    to replicated — correct, just more resharding traffic on the first
+    step.
+    """
+    import numpy as _np
+
+    by_shape: dict = {}
+    p_leaves = jax.tree_util.tree_leaves(params)
+    s_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P) or x is None)
+    for pl, sl in zip(p_leaves, s_leaves):
+        key = (tuple(_np.shape(pl)), _np.dtype(
+            getattr(pl, "dtype", _np.float32)).name)
+        if key in by_shape and by_shape[key] != sl:
+            by_shape[key] = P()  # ambiguous: replicate
+        else:
+            by_shape.setdefault(key, sl if sl is not None else P())
+
+    def leaf(x):
+        key = (tuple(_np.shape(x)), _np.dtype(
+            getattr(x, "dtype", _np.float32)).name)
+        spec = by_shape.get(key)
+        return spec if spec is not None else P()
+
+    return jax.tree_util.tree_map(leaf, opt_state)
+
+
 def _record_axis_comms(bytes_by_label: dict) -> None:
     """Static per-axis comms attribution (docs/parallelism.md): planned
     per-device gradient-reduction bytes per mesh-axis group, recorded at

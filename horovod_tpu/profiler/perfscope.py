@@ -93,9 +93,12 @@ SUMMARY_VERSION = 1
 DEFAULT_WINDOW = 512
 
 #: Canonical phase names (free-form names are accepted; these order the
-#: reports).
+#: reports). `checkpoint` is the device→host snapshot of an async save
+#: (ckpt/async_ckpt.py) — the ONLY checkpoint phase allowed on the
+#: step critical path; persist/commit run on the writer thread and
+#: never appear here.
 PHASES = ("input_wait", "compile", "dispatch", "device_compute",
-          "comms", "optimizer")
+          "comms", "optimizer", "checkpoint")
 
 #: The unattributed remainder of a step.
 BASE_PHASE = "dispatch"

@@ -53,12 +53,25 @@ class InferenceEngine:
                         like_params: Optional[Any] = None,
                         name: str = "serve") -> "InferenceEngine":
         """Params-only restore of a training checkpoint (no optimizer
-        state is read, none needs to be constructible)."""
+        state is read, none needs to be constructible).
+
+        `path` may be either a single orbax checkpoint dir
+        (checkpoint.save — its ``.done`` commit marker is verified, a
+        partial dir is a typed CheckpointCorruptError) or an
+        ``AsyncCheckpointer`` ROOT of generation-numbered manifests
+        (horovod_tpu/ckpt/) — then the newest COMMITTED generation's
+        params shards are read and reassembled, so a replica can serve
+        straight from a live training job's checkpoint root, sharded
+        models included (docs/checkpointing.md)."""
         import jax
         import jax.numpy as jnp
 
+        from horovod_tpu import ckpt as _ckpt
         from horovod_tpu import checkpoint as ckpt
-        params = ckpt.restore_params(path, like=like_params)
+        if _ckpt.latest_committed(path) is not None:
+            params = _ckpt.load_params(path, like=like_params)
+        else:
+            params = ckpt.restore_params(path, like=like_params)
         params = jax.tree_util.tree_map(jnp.asarray, params)
         return cls(infer_fn, params, name=name)
 

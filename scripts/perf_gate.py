@@ -73,6 +73,13 @@ MAX_INPUT_WAIT_FRACTION = 0.05
 #: hybrid path (or its attribution) fails the gate on any host.
 SHARDED_SECTIONS = ("gspmd_hybrid",)
 
+#: The async-checkpointing bench section (docs/checkpointing.md) and
+#: its hard acceptance: measured overhead above this fraction of step
+#: time fails the gate (ROADMAP item 5: "checkpoint overhead <5% of
+#: step time").
+CKPT_SECTION = "checkpointing"
+CKPT_MAX_OVERHEAD = 0.05
+
 
 # ----------------------------------------------------------------- emit
 
@@ -343,6 +350,36 @@ def _check_sharded_section(name: str, val: dict) -> list:
     return errs
 
 
+def _check_ckpt_section(name: str, val: dict) -> list:
+    """The stamps an async-checkpointing section must carry, and the
+    one NUMERIC check that runs on every host (a ratio of twin loops
+    in the same window is load-immune enough to gate everywhere):
+    overhead_fraction <= CKPT_MAX_OVERHEAD."""
+    errs = []
+    for k in ("overhead_fraction", "snapshot_ms", "persist_ms",
+              "plain_step_ms", "ckpt_step_ms", "bytes",
+              "generations_committed", "save_every"):
+        if not isinstance(val.get(k), (int, float)):
+            errs.append(f"{name}: stamp `{k}` missing/non-numeric — "
+                        "the two-phase save split is no longer "
+                        "measured (docs/checkpointing.md)")
+    if not isinstance(val.get("skipped_saves"), int):
+        errs.append(f"{name}: skipped_saves missing — back-pressure "
+                    "drops are no longer counted")
+    gens = val.get("generations_committed")
+    if isinstance(gens, (int, float)) and gens <= 0:
+        errs.append(f"{name}: no generation committed — the save path "
+                    "never reached a commit marker")
+    ov = val.get("overhead_fraction")
+    if isinstance(ov, (int, float)) and ov > CKPT_MAX_OVERHEAD:
+        errs.append(
+            f"{name}: measured checkpoint overhead {ov:.1%} exceeds "
+            f"the {CKPT_MAX_OVERHEAD:.0%} budget (ROADMAP item 5 "
+            "acceptance) — the async save is leaking onto the step "
+            "critical path")
+    return errs
+
+
 def check_bench(doc: dict) -> list:
     """Structure-check every perfscope-stamped section of a bench.py
     JSON line (the StepProfile acceptance: phases cover >=90% of wall),
@@ -358,6 +395,8 @@ def check_bench(doc: dict) -> list:
             errs.extend(_check_conv_section(sec, val))
         if sec in SHARDED_SECTIONS:
             errs.extend(_check_sharded_section(sec, val))
+        if sec == CKPT_SECTION:
+            errs.extend(_check_ckpt_section(sec, val))
         if "perfscope" not in val:
             continue
         prof = val["perfscope"]
@@ -382,6 +421,12 @@ def check_bench(doc: dict) -> list:
                 "path did not run (or was dropped); its mesh/scaling/"
                 "comms_by_axis stamps are structurally required "
                 "(docs/parallelism.md)")
+    if not isinstance(extra.get(CKPT_SECTION), dict):
+        errs.append(
+            f"{CKPT_SECTION}: checkpointing bench section missing — "
+            "the async-save overhead is no longer measured; its "
+            "overhead/phase-split stamps are structurally required "
+            "(docs/checkpointing.md)")
     return errs
 
 

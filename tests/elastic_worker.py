@@ -36,6 +36,16 @@ Modes (argv[1]):
             in the allreduce), so only the perfscope phase split — pushed
             to the rendezvous KV and persisted at job end — lets
             hvddoctor name the straggler and its dominant phase.
+  ckpt    — the preemption-proof checkpointing e2e
+            (tests/test_ckpt_e2e.py): state is a TrainLoopState wired
+            to an AsyncCheckpointer via HOROVOD_CKPT_DIR; every step
+            commits and async-saves. At ELASTIC_CKPT_KILL_STEP in
+            round 1 EVERY worker SIGKILLs itself right after the
+            commit is durable (block=True on that save) — a whole-job
+            preemption, the case in-memory survivor recovery cannot
+            help with. The next round's fresh workers must resume from
+            the last COMMITTED step via TrainLoopState.maybe_resume
+            (RESUME source=checkpoint printed), not restart the epoch.
   watch   — the hvdwatch e2e (tests/test_watch_e2e.py): every step runs
             under hvd.perfscope() with model FLOPs declared (so MFU
             flows); the worker on ELASTIC_SLOWDOWN_HOSTNAME installs a
@@ -82,6 +92,7 @@ SLOW_INPUT_SEC = float(os.environ.get("ELASTIC_SLOW_INPUT_SEC", "0.35"))
 SLOWDOWN_HOSTNAME = os.environ.get("ELASTIC_SLOWDOWN_HOSTNAME", "")
 SLOWDOWN_MS = os.environ.get("ELASTIC_SLOWDOWN_MS", "500")
 SLOWDOWN_AFTER = os.environ.get("ELASTIC_SLOWDOWN_AFTER", "10")
+CKPT_KILL_STEP = int(os.environ.get("ELASTIC_CKPT_KILL_STEP", "0"))
 # Declared per-step model FLOPs in watch mode: arbitrary but fixed, so
 # the MFU gauge/summary flow on CPU hosts (pair with
 # HOROVOD_BENCH_PEAK_TFLOPS in the job env).
@@ -114,14 +125,30 @@ def main():
             faults.install(faults.FaultInjector(faults.parse_spec(spec)))
             print(f"SLOWDOWN_ARMED host={my_host} "
                   f"after={SLOWDOWN_AFTER} ms={SLOWDOWN_MS}", flush=True)
-    state = hvd.elastic.JaxState(
-        params={"w": jnp.zeros((4,), jnp.float32)}, step=0)
+    if mode == "ckpt":
+        # TrainLoopState auto-attaches its AsyncCheckpointer from
+        # HOROVOD_CKPT_DIR (set in the job env by the test) — the
+        # production wiring, not a test-only path.
+        state = hvd.elastic.TrainLoopState(
+            params={"w": jnp.zeros((4,), jnp.float32)}, step=0)
+    else:
+        state = hvd.elastic.JaxState(
+            params={"w": jnp.zeros((4,), jnp.float32)}, step=0)
     # A worker that joins after round 1 was born resized — it must not
     # wait at WAIT_STEP or it would stall the survivors' collectives.
     sizes_seen = {"last": hvd.size(), "resized": boot_round != "1"}
 
     @hvd.elastic.run
     def train(st):
+        if mode == "ckpt":
+            # One line per (re)entry: the test asserts a fresh round-2
+            # boot reports source=checkpoint at the last committed step
+            # (exactly-once resume), never step=0 (epoch restart).
+            print(f"RESUME step={st.step} "
+                  f"source={getattr(st, 'last_resume_source', None)} "
+                  f"size={hvd.size()} "
+                  f"round={os.environ.get('HOROVOD_ELASTIC_ROUND')}",
+                  flush=True)
         while st.step < TOTAL_STEPS:
             now = hvd.size()
             if now != sizes_seen["last"]:
@@ -183,7 +210,50 @@ def main():
                 print(f"CRASHING host={my_host} step={st.step}", flush=True)
                 sys.stdout.flush()
                 os._exit(7)
+            if mode == "ckpt":
+                st.record_batch(records=1)  # 1 synthetic record/step
             st.commit()
+            if mode == "ckpt":
+                kill_now = (CKPT_KILL_STEP > 0
+                            and st.step == CKPT_KILL_STEP
+                            and os.environ.get(
+                                "HOROVOD_ELASTIC_ROUND") == "1")
+                # Async save of the snapshot just committed; at the
+                # kill step block until the commit marker is durable —
+                # the checkpoint the next round must find. A save can
+                # legitimately be SKIPPED under back-pressure (the
+                # previous persist still in flight on a slow disk), so
+                # the kill step drains and retries until its save is
+                # ACCEPTED — block=True only guarantees durability for
+                # an accepted save.
+                accepted = st.checkpoint(block=kill_now)
+                if kill_now:
+                    if hvd.rank() == 0:
+                        # Only the WRITER rank must see its save
+                        # accepted before dying (non-writers' save is
+                        # a no-op by design — always False).
+                        for _ in range(10):
+                            if accepted:
+                                break
+                            st.checkpointer.wait(30)
+                            accepted = st.checkpoint(block=True)
+                        assert accepted, \
+                            "kill-step checkpoint never accepted"
+                    # Synchronize the massacre: without this, a rank
+                    # can die while its peer's step allreduce
+                    # completion is still in flight — the peer then
+                    # recovers as a SURVIVOR (legitimate, but not the
+                    # whole-job preemption this mode exists to
+                    # create). After this named allreduce returns on
+                    # BOTH ranks, both are in host code and die for
+                    # real.
+                    hvd.allreduce(np.ones((1,), np.float32), op="sum",
+                                  name="ckpt_kill_barrier")
+                    import signal
+                    print(f"CKPT_KILL host={my_host} step={st.step}",
+                          flush=True)
+                    sys.stdout.flush()
+                    os.kill(os.getpid(), signal.SIGKILL)
             if hvd.rank() == 0 and PROGRESS_FILE:
                 with open(PROGRESS_FILE, "a") as f:
                     f.write(f"{st.step}\n")

@@ -1,0 +1,190 @@
+"""Chaos e2e for horovod_tpu/ckpt: preemption-proof training
+(`make ckpt-smoke`, docs/checkpointing.md).
+
+The ROADMAP item 5 acceptance: a 2-process elastic job is SIGKILL'd
+mid-epoch — EVERY worker at once, a whole-job preemption, the case
+in-memory survivor recovery cannot help with — and the job must
+
+1. resume from the last COMMITTED step (``RESUME source=checkpoint``
+   printed by the fresh round's workers; the step counter is asserted,
+   never step 0 / epoch start),
+2. never regress the progress stream (steps after the kill strictly
+   continue past the committed step — exactly-once, no replays of
+   committed work),
+3. finish with a final state BIT-IDENTICAL to an uninterrupted twin
+   run (same mesh shape across the kill), and
+4. leave flight `ckpt` evidence a postmortem can read: hvddoctor's
+   [ckpt] section names the restore and its source.
+
+Workers are tests/elastic_worker.py mode `ckpt` (TrainLoopState wired
+to an AsyncCheckpointer via HOROVOD_CKPT_DIR — the production path).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+WORKER = os.path.join(HERE, "elastic_worker.py")
+
+pytestmark = pytest.mark.faults
+
+TOTAL_STEPS = 10
+KILL_STEP = 4
+
+
+def write_hosts(path, spec: str) -> None:
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(spec.split(",")) + "\n")
+    os.replace(tmp, path)
+
+
+def start_job(tmp_path, extra_env=None, kill_step=KILL_STEP):
+    hosts_file = tmp_path / "hosts.txt"
+    progress = tmp_path / "progress.txt"
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(0o755)
+    env = dict(os.environ)
+    env.update({
+        "XLA_FLAGS": "",
+        "HOROVOD_TPU_EMULATE_RANKS": "",
+        "ELASTIC_PROGRESS_FILE": str(progress),
+        "ELASTIC_TOTAL_STEPS": str(TOTAL_STEPS),
+        "ELASTIC_CKPT_KILL_STEP": str(kill_step),
+        "HOROVOD_CKPT_DIR": str(tmp_path / "ckpts"),
+        "HOROVOD_FLIGHT_DIR": str(tmp_path / "flight"),
+        # Production config: any collective wedged by host contention
+        # (shared CI runners starve the 2-proc gloo ring) converts to
+        # HorovodInternalError within the window and the elastic retry
+        # loop recovers — the job self-heals instead of hanging the
+        # test. Also exercises the restore-grace interplay: the
+        # deadline must NOT fire while a rank's restore signal is
+        # fresh (ops/collectives.py re-arm).
+        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "45",
+        "HOROVOD_STALL_CHECK_TIME_SECONDS": "20",
+    })
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "--host-discovery-script", str(script),
+           "--slots-per-host", "1",
+           "--min-num-proc", "1",
+           "--elastic-timeout", "120",
+           # SHORT cooldown: after the whole-job SIGKILL both hosts are
+           # blacklisted — they must re-admit quickly so the resume
+           # round starts (the thing under test), not time out.
+           "--blacklist-cooldown-range", "2", "4",
+           sys.executable, WORKER, "ckpt"]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    return proc, hosts_file, progress
+
+
+def finish(proc, timeout: float = 360.0) -> str:
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        pytest.fail(f"elastic ckpt job hung; output:\n{out}")
+    assert proc.returncode == 0, \
+        f"job failed rc={proc.returncode}:\n{out}"
+    return out
+
+
+def _done_w(out: str):
+    """Every ELASTIC_DONE line's w= field (bit-exact strings)."""
+    return [l.split("w=")[1].strip() for l in out.splitlines()
+            if "ELASTIC_DONE" in l]
+
+
+def test_ckpt_sigkill_resumes_from_last_committed_step(tmp_path):
+    """The headline chaos e2e (ISSUE 15 acceptance)."""
+    proc, hosts_file, progress = start_job(tmp_path)
+    write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    out = finish(proc)
+
+    # Both workers killed themselves at the kill step in round 1.
+    kills = [l for l in out.splitlines() if "CKPT_KILL" in l]
+    assert len(kills) == 2, out
+    assert all(f"step={KILL_STEP}" in l for l in kills), kills
+
+    # The resume round booted FRESH processes (2 original + 2
+    # respawned; more only if a contention-stall recovery round fired)
+    # and restored from the CHECKPOINT — at exactly the last committed
+    # step, not step 0 / epoch start.
+    assert out.count("WORKER_BOOT") >= 4, out
+    resumes = [l for l in out.splitlines()
+               if "RESUME step=" in l and "source=checkpoint" in l]
+    assert resumes, f"no checkpoint resume line:\n{out}"
+    assert any(f"RESUME step={KILL_STEP} " in l
+               for l in resumes), resumes
+    # No worker ever re-entered training at step 0 after round 1.
+    late_resumes = [l for l in out.splitlines()
+                    if "RESUME step=" in l and "round=1" not in l]
+    assert late_resumes and all("RESUME step=0 " not in l
+                                for l in late_resumes), late_resumes
+
+    # Exactly-once: committed progress never regresses. Steps before
+    # the kill stop short of KILL_STEP's write (the kill preempts it);
+    # every step recorded after resumes STRICTLY past the committed
+    # step.
+    steps = [int(x) for x in progress.read_text().split()]
+    post_kill = [s for s in steps if s > KILL_STEP]
+    assert post_kill and min(post_kill) == KILL_STEP + 1, steps
+    assert sorted(set(steps)) == sorted(steps), \
+        f"a committed step was re-executed: {steps}"
+    assert max(steps) == TOTAL_STEPS, steps
+
+    # Final state: every finishing worker reports the full-trajectory
+    # value (the worker itself asserts |w - TOTAL| < 1e-3; here we pin
+    # the printed value bit-exactly against the uninterrupted twin's
+    # known "10.000").
+    done = _done_w(out)
+    assert len(done) == 2 and all(w == f"{float(TOTAL_STEPS):.3f}"
+                                  for w in done), out
+
+    # Postmortem: hvddoctor's [ckpt] section names the restore.
+    flight_dir = str(tmp_path / "flight")
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.observability.doctor",
+         "--dir", flight_dir, "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    ck = report.get("ckpt")
+    assert ck, "doctor report has no [ckpt] section"
+    assert any(x.get("source") == "checkpoint"
+               and x.get("step") == KILL_STEP
+               for x in ck["restores"]), ck
+    # and the text rendering names it too
+    r2 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.observability.doctor",
+         "--dir", flight_dir],
+        capture_output=True, text=True, timeout=120)
+    assert "[ckpt]" in r2.stdout and "from checkpoint" in r2.stdout, \
+        r2.stdout
+
+
+def test_ckpt_uninterrupted_twin_matches(tmp_path):
+    """The twin run without the kill: same final state string, no
+    restore-from-checkpoint, no respawns — pins that the chaos run
+    above converged to the uninterrupted trajectory and that the
+    always-on checkpointing itself does not disturb training."""
+    proc, hosts_file, progress = start_job(tmp_path, kill_step=0)
+    write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    out = finish(proc)
+    assert out.count("WORKER_BOOT") == 2, out
+    assert "CKPT_KILL" not in out, out
+    assert not any("source=checkpoint" in l
+                   for l in out.splitlines() if "RESUME step=" in l), out
+    done = _done_w(out)
+    assert len(done) == 2 and all(w == f"{float(TOTAL_STEPS):.3f}"
+                                  for w in done), out
+    steps = [int(x) for x in progress.read_text().split()]
+    assert max(steps) == TOTAL_STEPS and len(set(steps)) == len(steps)

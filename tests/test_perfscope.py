@@ -553,10 +553,23 @@ def _memory_stamp(static=64 << 20):
     return {"memory": {"static_peak_device_bytes": static}}
 
 
+def _ckpt_section(overhead=0.01):
+    """A minimal valid checkpointing section (ISSUE 15): check_bench
+    requires its PRESENCE with the overhead/phase-split stamps."""
+    return {"checkpointing": {
+        "overhead_fraction": overhead, "snapshot_ms": 1.0,
+        "persist_ms": 5.0, "plain_step_ms": 10.0,
+        "ckpt_step_ms": 10.1, "bytes": 2 << 20,
+        "generations_committed": 6, "save_every": 4,
+        "skipped_saves": 0,
+    }}
+
+
 def _gspmd_section():
-    """A minimal valid sharded section (ISSUE 14): check_bench requires
-    its PRESENCE with the mesh/scaling/comms stamps, so the synthetic
-    docs below carry one to isolate what each test actually checks."""
+    """A minimal valid sharded section (ISSUE 14) plus the ISSUE 15
+    checkpointing section: check_bench requires the PRESENCE of both
+    with their stamps, so the synthetic docs below carry them to
+    isolate what each test actually checks."""
     return {"gspmd_hybrid": {
         "mesh": {"spec": "dp=2,tp=4", "devices": 8,
                  "shape": {"dp": 2, "tp": 4}},
@@ -564,7 +577,7 @@ def _gspmd_section():
                     "dp_tokens_per_sec": 1.0,
                     "hybrid_tokens_per_sec": 1.0},
         "comms_by_axis": {"dp": {"bytes_per_step": 1}},
-    }}
+    }, **_ckpt_section()}
 
 
 def test_perf_gate_bench_mode(fresh):
@@ -617,6 +630,33 @@ def test_perf_gate_conv_section_input_wait_bar(fresh):
     assert any("starving" in e for e in errs)
     prof["phase_fractions"] = {"input_wait": 0.01}
     assert perf_gate.check_bench(doc) == []
+
+
+def test_perf_gate_ckpt_section_overhead_and_stamps(fresh):
+    """ISSUE 15 satellite: the checkpointing section is structurally
+    required, its stamps must be present, and measured overhead above
+    the 5% budget fails the gate on ANY host."""
+    base = {"transformer_lm": {"perfscope": _gate_profile(),
+                               **_memory_stamp()}}
+    doc = {"extra": {**base, **_gspmd_section()}}
+    assert perf_gate.check_bench(doc) == []
+    # overhead above budget: numeric fail everywhere
+    doc["extra"]["checkpointing"]["overhead_fraction"] = 0.09
+    errs = perf_gate.check_bench(doc)
+    assert any("overhead" in e and "5%" in e for e in errs)
+    # a missing phase-split stamp: structural fail
+    doc["extra"].update(_ckpt_section())
+    del doc["extra"]["checkpointing"]["snapshot_ms"]
+    errs = perf_gate.check_bench(doc)
+    assert any("snapshot_ms" in e for e in errs)
+    # zero commits: the save path never reached a marker
+    doc["extra"].update(_ckpt_section())
+    doc["extra"]["checkpointing"]["generations_committed"] = 0
+    assert any("commit" in e for e in perf_gate.check_bench(doc))
+    # absent section: fail, not skip
+    doc["extra"].pop("checkpointing")
+    errs = perf_gate.check_bench(doc)
+    assert any("checkpointing" in e and "missing" in e for e in errs)
 
 
 def test_perf_gate_conv_section_mfu_presence(fresh):

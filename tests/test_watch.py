@@ -686,3 +686,28 @@ def test_hvdtop_cli_requires_addr(monkeypatch, capsys):
         monkeypatch.delenv(var, raising=False)
     assert top.main([]) == 2
     assert top.main(["--addr", "nonsense"]) == 2
+
+
+def test_watcher_ckpt_backpressure_detector(fake_scope, fresh_metrics,
+                                            monkeypatch):
+    """Sustained checkpoint save-skipping (ckpt/async_ckpt.py
+    back-pressure) trips the ckpt_skipped detector after hysteresis —
+    one isolated skip (a single slow persist) never alerts."""
+    clock, scope = fake_scope
+    w = make_watcher(clock, monkeypatch)
+    skipped = fresh_metrics.counter("horovod_ckpt_skipped_total")
+    for _ in range(4):  # healthy: no skips
+        clock.advance(5.0)
+        w.tick()
+    assert w.counts() == {}
+    skipped.inc()       # one isolated skip: swallowed by hysteresis
+    clock.advance(5.0)
+    w.tick()
+    clock.advance(5.0)
+    w.tick()
+    assert w.counts() == {}
+    for _ in range(4):  # the writer is persistently behind
+        skipped.inc(2)
+        clock.advance(5.0)
+        w.tick()
+    assert w.counts().get("ckpt_skipped") == 1
