@@ -83,9 +83,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke fusion-smoke conv-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate
 
-test: lint hlo-lint shard-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke fusion-smoke conv-smoke perf-gate entry
+test: lint hlo-lint shard-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -139,6 +139,14 @@ serve-smoke:
 ckpt-smoke:
 	$(PYTEST) tests/test_ckpt.py
 	$(PYTEST) tests/test_ckpt_e2e.py --run-faults -m faults
+
+# Replicated rendezvous control plane (docs/resilience.md): the fencing/
+# replication/failover unit suite runs in tier 1 too; the host_kill
+# chaos e2e (faults marker — SIGKILL the PRIMARY KV replica's process
+# group mid-training and mid-serving-load) only here.
+kv-ha-smoke:
+	$(PYTEST) tests/test_kv_ha.py
+	$(PYTEST) tests/test_kv_ha_e2e.py --run-faults -m faults
 
 # perfscope CI sentinel (docs/perf.md): emit StepProfiles from the
 # synthetic CPU workloads and compare against the checked-in baseline.
@@ -249,6 +257,7 @@ race:
 	    tests/test_watch.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
 	    tests/test_hvdlint.py tests/test_serve.py tests/test_ckpt.py \
+	    tests/test_kv_ha.py \
 	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache
 
 entry:

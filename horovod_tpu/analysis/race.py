@@ -68,6 +68,7 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.observability.watch",
     "horovod_tpu.elastic.driver",
     "horovod_tpu.runner.rendezvous",
+    "horovod_tpu.runner.kv_ha",
     "horovod_tpu.analysis.verifier",
     "horovod_tpu.core.topology",
     "horovod_tpu.core.process_sets",

@@ -547,8 +547,8 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
     from horovod_tpu.common import config as C
     from horovod_tpu.elastic.discovery import HostDiscoveryScript
     from horovod_tpu.runner import safe_exec
+    from horovod_tpu.runner.kv_ha import start_control_plane
     from horovod_tpu.runner.launch import _local_ip, make_worker_cmd
-    from horovod_tpu.runner.rendezvous import RendezvousServer
 
     cooldown = getattr(args, "blacklist_cooldown_range", None)
     hm = HostManager(
@@ -559,16 +559,16 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
     # A pre-set HOROVOD_SECRET_KEY is honored (job_secret_key) so
     # `hvdtop` / `hvddoctor --kv` can sign reads against the live job.
     job_secret = secret_mod.job_secret_key()
-    rdv = RendezvousServer(secret=job_secret.encode())
-    rdv_port = rdv.start()
+    # Plain in-process server, or (HOROVOD_KV_REPLICAS>1) the replicated
+    # control plane with epoch-fenced failover (runner/kv_ha.py).
+    rdv = start_control_plane(job_secret.encode())
     ip = _local_ip()
     publisher = RoundPublisher(rdv, ip)
 
     def spawn(slot: SlotInfo, round_id: int):
         env = dict(extra_env)
+        env.update(rdv.worker_env(ip))
         env.update({
-            C.HOROVOD_RENDEZVOUS_ADDR: ip,
-            C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
             secret_mod.SECRET_ENV: job_secret,
             C.HOROVOD_ELASTIC: "1",
             "HOROVOD_ELASTIC_ROUND": str(round_id),

@@ -162,7 +162,122 @@ HOROVOD_CKPT_EVERY = "HOROVOD_CKPT_EVERY"
 HOROVOD_CKPT_RESUME = "HOROVOD_CKPT_RESUME"
 
 
-class TrainLoopState(JaxState):
+class CheckpointableState:
+    """Mixin tying an elastic State to a ``ckpt.AsyncCheckpointer``:
+    checkpoint cadence (HOROVOD_CKPT_EVERY), the rank-0 disk-vs-memory
+    resume probe, and attach/replace plumbing. ``TrainLoopState`` wires
+    it for JAX pytrees; the framework frontends wire it for
+    ``TorchState`` (frontends/torch_elastic.py) and ``TfKerasState``
+    (frontends/tensorflow_elastic.py), so a torch or Keras elastic job
+    gets the same exactly-once step-resume the JAX loop has.
+
+    Subclass contract (both hooks operate on the last COMMITTED
+    snapshot, never live values — the checkpoint.save_state contract):
+
+      ``_ckpt_payload() -> (tree, objects)`` — what to persist;
+      ``_ckpt_adopt(tree, objects)`` — install a restored payload into
+      the saved snapshot AND the live attributes (usually ends in
+      ``self.restore()``).
+    """
+
+    _ckpt = None
+    every_n = 0
+
+    def _init_checkpointer(self, checkpointer: Any = None,
+                           root: Optional[str] = None) -> None:
+        import os
+        self._ckpt = checkpointer
+        if self._ckpt is None:
+            root = root or os.environ.get(HOROVOD_CKPT_DIR, "")
+            if root:
+                from horovod_tpu.ckpt import AsyncCheckpointer
+                self._ckpt = AsyncCheckpointer(root)
+        try:
+            self.every_n = max(
+                0, int(os.environ.get(HOROVOD_CKPT_EVERY, "") or 0))
+        except ValueError:
+            self.every_n = 0
+
+    # ------------------------------------------------------------ plumbing
+    @property
+    def checkpointer(self):
+        return self._ckpt
+
+    def attach_checkpointer(self, ckpt) -> None:
+        self._ckpt = ckpt
+
+    def _ckpt_payload(self):
+        raise NotImplementedError
+
+    def _ckpt_adopt(self, tree: Any, objects: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    # ---------------------------------------------------------- checkpoint
+    def checkpoint(self, block: bool = False) -> bool:
+        """Async-save the last commit()'s snapshot at this step
+        boundary. Returns the checkpointer's accepted/skipped verdict
+        (False also when no checkpointer is attached)."""
+        if self._ckpt is None:
+            return False
+        tree, objects = self._ckpt_payload()
+        step = int(objects.get("step", getattr(self, "step", 0)) or 0)
+        return self._ckpt.save(step, tree, objects=objects, block=block)
+
+    def maybe_checkpoint(self) -> bool:
+        """commit-then-save every HOROVOD_CKPT_EVERY steps, keyed on
+        the state's ``step`` attribute (no-op when the knob is
+        unset)."""
+        if self._ckpt is None or self.every_n <= 0:
+            return False
+        if int(getattr(self, "step", 0) or 0) % self.every_n != 0:
+            return False
+        return self.checkpoint()
+
+    # -------------------------------------------------------------- resume
+    @staticmethod
+    def _resume_enabled() -> bool:
+        from horovod_tpu.common.config import _env_on
+        return _env_on(HOROVOD_CKPT_RESUME, True)
+
+    def maybe_resume(self) -> bool:
+        """Rank 0's restore probe (see TrainLoopState docstring).
+        Returns True when a disk restore happened.
+        ``last_resume_source`` records the decision
+        ("checkpoint"/"memory"/None) for logging."""
+        self.last_resume_source = None
+        if self._ckpt is None or not self._resume_enabled():
+            return False
+        from horovod_tpu.core import topology
+        rank = topology.rank_or_none()
+        if rank not in (None, 0):
+            return False  # followers adopt rank 0's state via sync()
+        from horovod_tpu.ckpt import manifest as _mf
+        latest = _mf.latest_committed(self._ckpt.root)
+        if latest is None:
+            return False
+        gen, disk_step = latest
+        mem_step = int(getattr(self, "step", 0) or 0)
+        if disk_step <= mem_step:
+            # survivor: in-memory state is at least as fresh — the
+            # round resumes from memory, and the doctor's [ckpt]
+            # section can see that it did
+            from horovod_tpu.ckpt.async_ckpt import _ident
+            from horovod_tpu.observability import flight
+            flight.record(
+                "ckpt", f"restore step={mem_step} gen={gen} "
+                f"source=memory {_ident()}")
+            self.last_resume_source = "memory"
+            return False
+        like, _ = self._ckpt_payload()
+        got = self._ckpt.restore_latest(like=like)
+        if got is None:
+            return False
+        self._ckpt_adopt(got.tree, got.objects)
+        self.last_resume_source = "checkpoint"
+        return True
+
+
+class TrainLoopState(CheckpointableState, JaxState):
     """The exactly-once elastic resume unit (docs/checkpointing.md):
     params + optimizer state + step counter + data-stream cursor
     (records consumed this epoch) + RNG state, tied to an
@@ -189,28 +304,9 @@ class TrainLoopState(JaxState):
                  step: int = 0, epoch: int = 0, cursor: int = 0,
                  rng: Any = None, checkpointer: Any = None,
                  root: Optional[str] = None, **kwargs):
-        import os
-        self._ckpt = checkpointer
-        if self._ckpt is None:
-            root = root or os.environ.get(HOROVOD_CKPT_DIR, "")
-            if root:
-                from horovod_tpu.ckpt import AsyncCheckpointer
-                self._ckpt = AsyncCheckpointer(root)
-        try:
-            self.every_n = max(
-                0, int(os.environ.get(HOROVOD_CKPT_EVERY, "") or 0))
-        except ValueError:
-            self.every_n = 0
+        self._init_checkpointer(checkpointer=checkpointer, root=root)
         super().__init__(params=params, opt_state=opt_state, step=step,
                          epoch=epoch, cursor=cursor, rng=rng, **kwargs)
-
-    # ------------------------------------------------------------ plumbing
-    @property
-    def checkpointer(self):
-        return self._ckpt
-
-    def attach_checkpointer(self, ckpt) -> None:
-        self._ckpt = ckpt
 
     def record_batch(self, records: int) -> None:
         """Advance the data-stream cursor by `records` consumed
@@ -235,7 +331,7 @@ class TrainLoopState(JaxState):
         self.cursor = 0
 
     # ---------------------------------------------------------- checkpoint
-    def _payload(self):
+    def _ckpt_payload(self):
         """(tree, objects) of the last COMMITTED snapshot — never live
         values (the checkpoint.save_state contract: a mid-step save
         must not capture uncommitted state)."""
@@ -243,71 +339,16 @@ class TrainLoopState(JaxState):
                  if v is not None}
         return {"trees": trees}, dict(self._saved)
 
-    def checkpoint(self, block: bool = False) -> bool:
-        """Async-save the last commit()'s snapshot at this step
-        boundary. Returns the checkpointer's accepted/skipped verdict
-        (False also when no checkpointer is attached)."""
-        if self._ckpt is None:
-            return False
-        tree, objects = self._payload()
-        step = int(objects.get("step", getattr(self, "step", 0)) or 0)
-        return self._ckpt.save(step, tree, objects=objects, block=block)
+    # kept as an alias: the pre-mixin name for the same hook
+    _payload = _ckpt_payload
 
-    def maybe_checkpoint(self) -> bool:
-        """commit-then-save every HOROVOD_CKPT_EVERY steps (no-op when
-        the knob is unset)."""
-        if self._ckpt is None or self.every_n <= 0:
-            return False
-        if int(self.step) % self.every_n != 0:
-            return False
-        return self.checkpoint()
-
-    # -------------------------------------------------------------- resume
-    @staticmethod
-    def _resume_enabled() -> bool:
-        from horovod_tpu.common.config import _env_on
-        return _env_on(HOROVOD_CKPT_RESUME, True)
-
-    def maybe_resume(self) -> bool:
-        """Rank 0's restore probe (see class docstring). Returns True
-        when a disk restore happened. ``last_resume_source`` records
-        the decision ("checkpoint"/"memory"/None) for logging."""
-        self.last_resume_source = None
-        if self._ckpt is None or not self._resume_enabled():
-            return False
-        from horovod_tpu.core import topology
-        rank = topology.rank_or_none()
-        if rank not in (None, 0):
-            return False  # followers adopt rank 0's state via sync()
-        from horovod_tpu.ckpt import manifest as _mf
-        latest = _mf.latest_committed(self._ckpt.root)
-        if latest is None:
-            return False
-        gen, disk_step = latest
-        mem_step = int(getattr(self, "step", 0) or 0)
-        if disk_step <= mem_step:
-            # survivor: in-memory state is at least as fresh — the
-            # round resumes from memory, and the doctor's [ckpt]
-            # section can see that it did
-            from horovod_tpu.observability import flight
-            from horovod_tpu.ckpt.async_ckpt import _ident
-            flight.record(
-                "ckpt", f"restore step={mem_step} gen={gen} "
-                f"source=memory {_ident()}")
-            self.last_resume_source = "memory"
-            return False
-        like, _ = self._payload()
-        got = self._ckpt.restore_latest(like=like)
-        if got is None:
-            return False
-        for k, v in got.tree.get("trees", {}).items():
+    def _ckpt_adopt(self, tree: Any, objects: Dict[str, Any]) -> None:
+        for k, v in tree.get("trees", {}).items():
             self._saved_trees[k] = v
-        for k, v in got.objects.items():
+        for k, v in objects.items():
             self._saved[k] = v
             self._known_attrs.add(k)
         self.restore()
-        self.last_resume_source = "checkpoint"
-        return True
 
     def sync(self) -> None:
         self.maybe_resume()

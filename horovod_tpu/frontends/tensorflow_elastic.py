@@ -15,20 +15,30 @@ topology change.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import copy
+from typing import Any, Dict, List, Optional
 
 from horovod_tpu.elastic import run  # noqa: F401  (re-exported: @elastic.run)
-from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.elastic.state import CheckpointableState, ObjectState
 
 
-class TfKerasState(ObjectState):
+class TfKerasState(CheckpointableState, ObjectState):
     """In-memory checkpoint of Keras model + optimizer variables
-    (reference: tensorflow/elastic.py TensorFlowKerasState)."""
+    (reference: tensorflow/elastic.py TensorFlowKerasState).
 
-    def __init__(self, model=None, optimizer=None, **kwargs):
+    With a checkpointer attached (``checkpointer=``/``root=`` or
+    HOROVOD_CKPT_DIR), the committed variable snapshots persist as the
+    checkpoint's array tree (they are already numpy copies) and plain
+    values ride the object channel; ``sync()`` runs rank 0's
+    disk-vs-memory resume probe before broadcasting — see
+    ``CheckpointableState``."""
+
+    def __init__(self, model=None, optimizer=None, checkpointer=None,
+                 root=None, **kwargs):
         self.model = model
         self.optimizer = optimizer
         self._saved_vars: Optional[List[Any]] = None
+        self._init_checkpointer(checkpointer=checkpointer, root=root)
         super().__init__(**kwargs)
         self._known_attrs -= {"model", "optimizer"}
 
@@ -51,9 +61,26 @@ class TfKerasState(ObjectState):
         super().restore()
 
     def sync(self) -> None:
+        # resume probe first: a restored rank 0 broadcasts the
+        # checkpoint's variables (CheckpointableState.maybe_resume)
+        self.maybe_resume()
         from horovod_tpu.frontends.tensorflow import broadcast_variables
         broadcast_variables(self._all_vars(), root_rank=0)
         super().sync()
+
+    # ---- CheckpointableState hooks (last COMMITTED snapshot only) ----
+    def _ckpt_payload(self):
+        tree = {"vars": [v.copy() for v in (self._saved_vars or [])]}
+        return tree, dict(self._saved)
+
+    def _ckpt_adopt(self, tree: Any, objects: Dict[str, Any]) -> None:
+        vars_ = list((tree or {}).get("vars", []))
+        if vars_:
+            self._saved_vars = vars_
+        for k, v in (objects or {}).items():
+            self._saved[k] = copy.deepcopy(v)
+            self._known_attrs.add(k)
+        self.restore()
 
 
 # Reference exposes the non-Keras variant under the same module.

@@ -22,7 +22,7 @@ import copy
 from typing import Any, Dict, Iterator, List, Optional
 
 from horovod_tpu.elastic import run  # noqa: F401  (re-exported: @elastic.run)
-from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.elastic.state import CheckpointableState, ObjectState
 
 
 def _torch():
@@ -139,7 +139,7 @@ def _get_handler(value) -> Optional[StateHandler]:
     return None
 
 
-class TorchState(ObjectState):
+class TorchState(CheckpointableState, ObjectState):
     """In-memory checkpoint of a torch model + optimizer (reference:
     torch/elastic/state.py:27-110). commit() snapshots state dicts;
     restore() rolls back; sync() broadcasts rank 0's weights and optimizer
@@ -147,13 +147,22 @@ class TorchState(ObjectState):
 
     Any extra kwarg whose value matches the handler registry (samplers,
     additional modules/optimizers, user-registered types) is managed by
-    its handler; plain values fall through to ObjectState."""
+    its handler; plain values fall through to ObjectState.
 
-    def __init__(self, model=None, optimizer=None, **kwargs):
+    With a checkpointer attached (``checkpointer=``/``root=`` or
+    HOROVOD_CKPT_DIR), ``checkpoint()``/``maybe_checkpoint()`` persist
+    the last commit's snapshots — handler state dicts and plain values
+    ride the pickled object channel; torch tensors stay torch tensors —
+    and ``sync()`` runs rank 0's disk-vs-memory resume probe before the
+    broadcast, the same exactly-once step-resume the JAX loop has."""
+
+    def __init__(self, model=None, optimizer=None, checkpointer=None,
+                 root=None, **kwargs):
         # model/optimizer go through the SAME handler mechanism as extra
         # kwargs (reference: torch/elastic/state.py:27-44) so __setattr__
         # rebinds them too when the user swaps the object mid-training.
         self._handlers: Dict[str, StateHandler] = {}
+        self._init_checkpointer(checkpointer=checkpointer, root=root)
         self.model = model
         self.optimizer = optimizer
         if model is not None:
@@ -209,9 +218,34 @@ class TorchState(ObjectState):
         super().restore()
 
     def sync(self) -> None:
+        # Disk-vs-memory resume probe BEFORE the broadcast: a restored
+        # rank 0 broadcasts the checkpoint's weights, survivors their
+        # (fresher-or-equal) memory — see CheckpointableState.
+        self.maybe_resume()
         for h in self._handlers.values():
             h.sync()
         super().sync()
+
+    # ---- CheckpointableState hooks (last COMMITTED snapshot only) ----
+    def _ckpt_payload(self):
+        objects: Dict[str, Any] = dict(self._saved)
+        objects["__handlers__"] = {
+            k: copy.deepcopy(h._saved)
+            for k, h in self._handlers.items() if h._saved is not None}
+        # no array tree: torch tensors pickle through the object
+        # channel; the npy shard path is for JAX/numpy leaves
+        return {"trees": {}}, objects
+
+    def _ckpt_adopt(self, tree: Any, objects: Dict[str, Any]) -> None:
+        objects = dict(objects or {})
+        for name, saved in objects.pop("__handlers__", {}).items():
+            h = self._handlers.get(name)
+            if h is not None:
+                h._saved = copy.deepcopy(saved)
+        for k, v in objects.items():
+            self._saved[k] = copy.deepcopy(v)
+            self._known_attrs.add(k)
+        self.restore()
 
 
 class ElasticSampler:

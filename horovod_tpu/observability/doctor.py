@@ -768,6 +768,73 @@ def analyze_ckpt(dumps: List[RankDump]) -> Optional[Dict[str, Any]]:
     }
 
 
+def analyze_control_plane(
+        dumps: List[RankDump]) -> Optional[Dict[str, Any]]:
+    """The [control-plane] section (docs/resilience.md): the replicated
+    rendezvous lifecycle from the launcher's flight `kv-failover` events
+    (runner/kv_ha.py) — replica count, every replica death, and every
+    failover with old/new primary, the epoch bump and the catch-up lag
+    the promoted primary started from. None when the job ran the plain
+    single-server control plane (HOROVOD_KV_REPLICAS=1 emits nothing)."""
+    import re
+    replicas: Optional[int] = None
+    epoch: Optional[int] = None
+    deaths: List[Dict[str, Any]] = []
+    failovers: List[Dict[str, Any]] = []
+    errors: List[str] = []
+    seen = False
+    seen_keys: set = set()  # (ts, desc): full dump + KV tail dedupe
+    for d in dumps:
+        for ev in d.events:
+            if len(ev) < 4 or ev[2] != "kv-failover":
+                continue
+            key = (float(ev[1]), str(ev[3]))
+            if key in seen_keys:
+                continue
+            seen_keys.add(key)
+            seen = True
+            desc = str(ev[3])
+            m = re.match(r"control-plane up replicas=(\d+) "
+                         r"primary=r(\d+) epoch=(\d+)", desc)
+            if m:
+                replicas = int(m.group(1))
+                epoch = max(epoch or 0, int(m.group(3)))
+                continue
+            m = re.match(r"replica r(\d+) died(?: rc=(-?\d+))?"
+                         r"( \(primary\))?", desc)
+            if m:
+                deaths.append({
+                    "replica": int(m.group(1)),
+                    "rc": int(m.group(2)) if m.group(2) else None,
+                    "primary": bool(m.group(3)),
+                    "time": float(ev[1])})
+                continue
+            m = re.match(r"failover: primary r(\d+) -> r(\d+) "
+                         r"epoch (\d+)->(\d+) lag=(\d+)", desc)
+            if m:
+                failovers.append({
+                    "old_primary": int(m.group(1)),
+                    "new_primary": int(m.group(2)),
+                    "old_epoch": int(m.group(3)),
+                    "epoch": int(m.group(4)),
+                    "lag": int(m.group(5)),
+                    "time": float(ev[1])})
+                epoch = max(epoch or 0, int(m.group(4)))
+                continue
+            m = re.match(r"control-plane down epoch=(\d+)", desc)
+            if m:
+                epoch = max(epoch or 0, int(m.group(1)))
+                continue
+            if "FAILED" in desc:
+                errors.append(desc)
+    if not seen:
+        return None
+    return {"replicas": replicas, "epoch": epoch,
+            "deaths": sorted(deaths, key=lambda x: x["time"]),
+            "failovers": sorted(failovers, key=lambda x: x["time"]),
+            "errors": errors[:10]}
+
+
 def dedupe(dumps: List[RankDump]) -> List[RankDump]:
     """Collapse redundant dumps, keeping non-overlapping evidence.
 
@@ -904,6 +971,7 @@ def merge(dumps: List[RankDump], tail: int = 8,
         "perf": analyze_perf(dedupe_perf(perf)) if perf else None,
         "serve": analyze_serve(dumps),
         "ckpt": analyze_ckpt(dumps),
+        "control_plane": analyze_control_plane(dumps),
         "per_rank": {},
     }
     report["anomalies"] = analyze_anomalies(
@@ -1024,6 +1092,26 @@ def render(report: Dict[str, Any], tail: int = 8) -> str:
                 add(f"  rank {info['rank']} round {info['round']}: "
                     f"still ACTIVE at last push: "
                     f"{', '.join(info['active'])}")
+        add("")
+    cp = report.get("control_plane")
+    if cp:
+        add("[control-plane] replicated rendezvous (flight "
+            "`kv-failover` events; docs/resilience.md)")
+        if cp["replicas"] is not None:
+            add(f"  {cp['replicas']} replica(s), final epoch "
+                f"{cp['epoch']}")
+        for dd in cp["deaths"]:
+            role = " (PRIMARY)" if dd["primary"] else ""
+            rc = f" rc={dd['rc']}" if dd.get("rc") is not None else ""
+            add(f"  replica r{dd['replica']} died{rc}{role}")
+        for fo in cp["failovers"]:
+            add(f"  FAILOVER: primary r{fo['old_primary']} -> "
+                f"r{fo['new_primary']}, epoch {fo['old_epoch']}->"
+                f"{fo['epoch']}, catch-up lag {fo['lag']} entr(ies)")
+        if not cp["failovers"]:
+            add("  no failover recorded")
+        for e in cp["errors"]:
+            add(f"  CONTROL-PLANE ERROR: {e}")
         add("")
     serve = report.get("serve")
     if serve:
@@ -1187,9 +1275,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dir", default=os.environ.get("HOROVOD_FLIGHT_DIR", ""),
                    help="directory of per-rank dumps (<rank>.json) and "
                         "persisted KV tails (default: $HOROVOD_FLIGHT_DIR)")
-    p.add_argument("--kv", default="", metavar="HOST:PORT",
+    p.add_argument("--kv", default="", metavar="HOST:PORT[,HOST:PORT...]",
                    help="scrape flight tails from a live rendezvous "
-                        "server (HOROVOD_SECRET_KEY honored from env)")
+                        "server (HOROVOD_SECRET_KEY honored from env); "
+                        "a comma list names every replica of a "
+                        "replicated control plane")
     p.add_argument("--json", action="store_true",
                    help="emit the machine-readable report instead of text")
     p.add_argument("--trace", default="", metavar="PATH",
@@ -1213,16 +1303,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         perf.extend(load_perf_dir(args.dir))
         watch.extend(load_watch_dir(args.dir))
     if args.kv:
-        addr, _, port = args.kv.rpartition(":")
-        if not addr or not port.isdigit():
-            print(f"doctor: bad --kv '{args.kv}' (want HOST:PORT)",
-                  file=sys.stderr)
+        from horovod_tpu.runner.rendezvous import (
+            HOROVOD_RENDEZVOUS_ADDRS, parse_endpoints)
+        try:
+            eps = parse_endpoints(args.kv)
+        except ValueError:
+            eps = []
+        if not eps:
+            print(f"doctor: bad --kv '{args.kv}' "
+                  f"(want HOST:PORT[,HOST:PORT...])", file=sys.stderr)
             return 2
-        loaded.extend(load_kv(addr, int(port), max_ranks=args.max_ranks))
-        perf.extend(load_perf_kv(addr, int(port),
-                                 max_ranks=args.max_ranks))
-        watch.extend(load_watch_kv(addr, int(port),
-                                   max_ranks=args.max_ranks))
+        addr, port = eps[0]
+        if len(eps) > 1:
+            # Every KVClient built below folds the extra endpoints in
+            # (multi-endpoint failover, runner/rendezvous.py): reads
+            # against a replicated control plane ride failover too.
+            os.environ[HOROVOD_RENDEZVOUS_ADDRS] = \
+                ",".join(f"{h}:{p}" for h, p in eps)
+        loaded.extend(load_kv(addr, port, max_ranks=args.max_ranks))
+        perf.extend(load_perf_kv(addr, port, max_ranks=args.max_ranks))
+        watch.extend(load_watch_kv(addr, port, max_ranks=args.max_ranks))
     if not args.dir and not args.kv:
         build_parser().print_help(sys.stderr)
         return 2

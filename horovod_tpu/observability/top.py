@@ -271,9 +271,14 @@ def _default_addr() -> str:
         return f"{addr}:{port}"
     path = os.environ.get("HOROVOD_RENDEZVOUS_PORT_FILE", "")
     if path:
+        from horovod_tpu.runner.rendezvous import read_endpoints
         try:
-            with open(path) as f:
-                return f"127.0.0.1:{int(f.read().strip())}"
+            # Either announcement format: legacy bare port, or the
+            # "host:port[,host:port...]" replica list (runner/kv_ha.py);
+            # the primary is announced first.
+            eps = read_endpoints(path)
+            if eps:
+                return ",".join(f"{h}:{p}" for h, p in eps)
         except (OSError, ValueError):
             pass
     return ""
@@ -285,10 +290,13 @@ def build_parser() -> argparse.ArgumentParser:
         description="Live per-rank fleet view of a running job "
                     "(step time, phase split, MFU, queue depth, "
                     "elastic round, active hvdwatch anomalies).")
-    p.add_argument("--addr", default=_default_addr(), metavar="HOST:PORT",
+    p.add_argument("--addr", default=_default_addr(),
+                   metavar="HOST:PORT[,HOST:PORT...]",
                    help="rendezvous server (default: "
                         "$HOROVOD_GLOO_RENDEZVOUS_ADDR:PORT, or "
-                        "127.0.0.1 + $HOROVOD_RENDEZVOUS_PORT_FILE)")
+                        "$HOROVOD_RENDEZVOUS_PORT_FILE); a comma list "
+                        "names every replica of a replicated control "
+                        "plane")
     p.add_argument("--once", action="store_true",
                    help="render one snapshot and exit")
     p.add_argument("--json", action="store_true",
@@ -307,11 +315,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("hvdtop: no --addr and no rendezvous env/port-file to "
               "discover one from", file=sys.stderr)
         return 2
-    addr, _, port = args.addr.rpartition(":")
-    if not addr or not port.isdigit():
-        print(f"hvdtop: bad --addr '{args.addr}' (want HOST:PORT)",
-              file=sys.stderr)
+    from horovod_tpu.runner.rendezvous import (HOROVOD_RENDEZVOUS_ADDRS,
+                                               parse_endpoints)
+    try:
+        eps = parse_endpoints(args.addr)
+    except ValueError:
+        eps = []
+    if not eps:
+        print(f"hvdtop: bad --addr '{args.addr}' "
+              f"(want HOST:PORT[,HOST:PORT...])", file=sys.stderr)
         return 2
+    addr, port = eps[0]
+    if len(eps) > 1:
+        # The KVClients built inside snapshot() fold the extra
+        # endpoints in (multi-endpoint failover, runner/rendezvous.py).
+        os.environ[HOROVOD_RENDEZVOUS_ADDRS] = \
+            ",".join(f"{h}:{p}" for h, p in eps)
     while True:
         snap = snapshot(addr, int(port), max_ranks=args.max_ranks)
         if args.json:

@@ -30,7 +30,6 @@ from horovod_tpu.common import config as C
 from horovod_tpu.common.exceptions import HorovodTpuError
 from horovod_tpu.runner import hosts as hosts_mod
 from horovod_tpu.runner import safe_exec
-from horovod_tpu.runner.rendezvous import RendezvousServer
 
 
 def _version_string() -> str:
@@ -542,9 +541,11 @@ def launch_static(np: int, host_spec: str, command: List[str],
     # honored (job_secret_key) so out-of-band tooling — `hvdtop`,
     # `hvddoctor --kv` — can sign its reads against a live job.
     from horovod_tpu.runner import secret as secret_mod
+    from horovod_tpu.runner.kv_ha import start_control_plane
     job_secret = secret_mod.job_secret_key()
-    rdv = RendezvousServer(secret=job_secret.encode())
-    rdv_port = rdv.start()
+    # Plain in-process server, or (HOROVOD_KV_REPLICAS>1) the replicated
+    # control plane with epoch-fenced failover (runner/kv_ha.py).
+    rdv = start_control_plane(job_secret.encode())
     ip = coordinator_ip or _local_ip()
     remote_hosts = sorted({s.hostname for s in slots
                            if not _is_local(s.hostname)})
@@ -577,9 +578,8 @@ def launch_static(np: int, host_spec: str, command: List[str],
         nkv = None
 
     base_env = dict(extra_env)
+    base_env.update(rdv.worker_env(ip))
     base_env.update({
-        C.HOROVOD_RENDEZVOUS_ADDR: ip,
-        C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
         C.HOROVOD_CONTROLLER: "tpu",
         secret_mod.SECRET_ENV: job_secret,
     })

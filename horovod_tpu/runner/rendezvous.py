@@ -32,12 +32,16 @@ import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.runner import secret as secret_mod
 
 METRICS_SCOPE = "metrics"   # KV scope worker snapshots are pushed under
 HOROVOD_RENDEZVOUS_PORT_FILE = "HOROVOD_RENDEZVOUS_PORT_FILE"
+# Replica endpoint list for the replicated control plane (runner/kv_ha.py):
+# "host:port[,host:port...]". Clients fold it into their endpoint set so
+# exhausted retries against the current endpoint fail over to the next.
+HOROVOD_RENDEZVOUS_ADDRS = "HOROVOD_RENDEZVOUS_ADDRS"
 
 _kv_mx = None
 
@@ -65,18 +69,46 @@ def _metrics():
     return _kv_mx[1]
 
 
-def announce_port(port: int) -> None:
-    """Write the rendezvous port to HOROVOD_RENDEZVOUS_PORT_FILE (when
-    set) so out-of-band tooling — a Prometheus scraper, the metrics e2e
-    test — can find the `/metrics` route of a job whose port was
-    OS-assigned."""
+def announce_endpoints(endpoints: List[str]) -> None:
+    """Write the rendezvous endpoint list ("host:port[,host:port...]")
+    to HOROVOD_RENDEZVOUS_PORT_FILE (when set) so out-of-band tooling —
+    a Prometheus scraper, `hvdtop`, `doctor --kv` — can find a job whose
+    port was OS-assigned. Replicated control planes (runner/kv_ha.py)
+    announce every replica, primary first."""
     path = os.environ.get(HOROVOD_RENDEZVOUS_PORT_FILE, "")
     if not path:
         return
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:
-        f.write(str(port))
+        f.write(",".join(endpoints))
     os.replace(tmp, path)
+
+
+def announce_port(port: int) -> None:
+    """Single-server announcement (loopback host, matching what the old
+    bare-port file format implied to its readers)."""
+    announce_endpoints([f"127.0.0.1:{port}"])
+
+
+def parse_endpoints(text: str) -> List[Tuple[str, int]]:
+    """Parse "host:port[,host:port...]"; a legacy bare "port" (the
+    pre-HA port-file format) reads as a single loopback endpoint."""
+    out: List[Tuple[str, int]] = []
+    for part in text.strip().split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host:
+            host, port = "127.0.0.1", part
+        out.append((host, int(port)))
+    return out
+
+
+def read_endpoints(path: str) -> List[Tuple[str, int]]:
+    """Read a HOROVOD_RENDEZVOUS_PORT_FILE announcement (either format)."""
+    with open(path) as f:
+        return parse_endpoints(f.read())
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -177,9 +209,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             snap = m.parse_snapshot(raw)
             if snap is not None:
                 # Age against the SERVER-clock arrival stamp when one
-                # exists (HTTP pushes): worker clock skew must not hide
-                # a live rank. Server-side put() (no stamp) keeps the
-                # snapshot's own time.
+                # exists (both HTTP pushes and server-side put() stamp
+                # it): worker clock skew must not hide a live rank.
                 if arrived is not None:
                     snap["time"] = arrived
                 worker_snaps.append(snap)
@@ -216,8 +247,22 @@ class RendezvousServer:
         return self.port
 
     def put(self, scope: str, key: str, value: bytes) -> None:
+        full = f"{scope}/{key}"
         with self._handler.lock:
-            self._handler.store[f"{scope}/{key}"] = value
+            self._handler.store[full] = value
+            if full.startswith(METRICS_SCOPE + "/"):
+                # Same arrival stamping as the HTTP PUT path: without it
+                # launcher-written snapshots would be exempt from
+                # HOROVOD_METRICS_STALE_SECONDS aging and a dead
+                # launcher-side pusher would render frozen series forever.
+                self._handler.put_times[full] = time.time()
+
+    def worker_env(self, ip: str) -> Dict[str, str]:
+        """The env entries a worker needs to reach this control plane
+        (the HA variant adds the replica endpoint list)."""
+        from horovod_tpu.common import config as C
+        return {C.HOROVOD_RENDEZVOUS_ADDR: ip,
+                C.HOROVOD_RENDEZVOUS_PORT: str(self.port)}
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         with self._handler.lock:
@@ -253,6 +298,16 @@ class KVClient:
     attempt/deadline bounds. Non-transient responses (403 auth rejection,
     404 missing key) surface immediately: retrying them would mask a real
     error or add latency to the get() not-found poll.
+
+    Multi-endpoint failover (runner/kv_ha.py): when the replicated
+    control plane announces more than one endpoint
+    (HOROVOD_RENDEZVOUS_ADDRS, or an explicit `endpoints=` list), an
+    exhausted retry schedule or a 409 fencing/not-leader rejection
+    rotates the client to the next endpoint, rediscovering the primary
+    via each replica's unauthenticated `/leader` probe. With a single
+    endpoint (the default, non-replicated server) behavior is byte-
+    identical to before: RetryError and every HTTP error surface
+    unchanged.
     """
 
     # GET polls for keys that do not exist yet (assignment publication
@@ -260,11 +315,26 @@ class KVClient:
     # old fixed 50 ms busy-wait.
     POLL_BASE = 0.02
     POLL_CAP = 0.5
+    # Pause between failover sweeps that found NO replica claiming the
+    # primary role — promotion (kv_ha coordinator) takes a probe
+    # interval or two to land.
+    FAILOVER_PAUSE = 0.2
 
     def __init__(self, addr: str, port: int, secret=_FROM_ENV,
-                 retry_policy=None, request_timeout: Optional[float] = None):
+                 retry_policy=None, request_timeout: Optional[float] = None,
+                 endpoints: Optional[List[str]] = None):
         from horovod_tpu.common import resilience
-        self.base = f"http://{addr}:{port}"
+        eps = [f"{addr}:{port}"]
+        if endpoints is None:
+            extra = [f"{h}:{p}" for h, p in parse_endpoints(
+                os.environ.get(HOROVOD_RENDEZVOUS_ADDRS, ""))]
+        else:
+            extra = list(endpoints)
+        for e in extra:
+            if e not in eps:
+                eps.append(e)
+        self.endpoints = eps
+        self.base = f"http://{eps[0]}"
         self.secret = secret_mod.secret_from_env() \
             if secret is _FROM_ENV else secret
         self.retry = retry_policy if retry_policy is not None \
@@ -275,7 +345,8 @@ class KVClient:
         # PUTs), which is what low-latency callers (telemetry pushes
         # inside shutdown) must cap.
         self.request_timeout = request_timeout
-        self.attempts = 0  # total request attempts (test observability)
+        self.attempts = 0   # total request attempts (test observability)
+        self.failovers = 0  # endpoint rotations (test observability)
 
     def _request_once(self, method: str, path: str, data: Optional[bytes]):
         import urllib.request
@@ -295,7 +366,59 @@ class KVClient:
         return urllib.request.urlopen(req, timeout=timeout)
 
     def _request(self, method: str, path: str, data: Optional[bytes]):
-        return self.retry.call(self._request_once, method, path, data)
+        import urllib.error
+
+        from horovod_tpu.common.resilience import RetryError
+        if len(self.endpoints) == 1:
+            # Non-replicated control plane: exactly the pre-HA behavior
+            # (RetryError and every HTTP error surface to the caller).
+            return self.retry.call(self._request_once, method, path, data)
+        last: Optional[BaseException] = None
+        for _ in range(2 * len(self.endpoints)):
+            try:
+                return self.retry.call(self._request_once, method, path,
+                                       data)
+            except RetryError as e:
+                last = e      # endpoint dead/unreachable: try the next
+            except urllib.error.HTTPError as e:
+                if e.code != 409:
+                    raise     # 403/404/...: a real answer, not a failover
+                last = e      # standby or fenced ex-primary: find leader
+            self._failover()
+        assert last is not None
+        raise last
+
+    def _failover(self) -> None:
+        """Rediscover the primary after the current endpoint failed:
+        probe every endpoint's unauthenticated `GET /leader` and move the
+        replica claiming role=="primary" with the highest epoch to the
+        front. If nobody claims leadership yet (promotion in flight),
+        rotate blindly and pause FAILOVER_PAUSE before the next sweep."""
+        import json
+        import urllib.request
+        old = self.endpoints[0]
+        best = None  # (epoch, endpoint)
+        for ep in self.endpoints:
+            try:
+                with urllib.request.urlopen(f"http://{ep}/leader",
+                                            timeout=2) as r:
+                    info = json.loads(r.read().decode("utf-8"))
+            except Exception:
+                continue
+            if info.get("role") == "primary":
+                e = int(info.get("epoch", 0))
+                if best is None or e > best[0]:
+                    best = (e, ep)
+        self.failovers += 1
+        if best is not None:
+            self.endpoints.remove(best[1])
+            self.endpoints.insert(0, best[1])
+        else:
+            self.endpoints.append(self.endpoints.pop(0))
+            time.sleep(self.FAILOVER_PAUSE)
+        self.base = f"http://{self.endpoints[0]}"
+        if self.endpoints[0] != old:
+            self._flight(f"failover {old} -> {self.endpoints[0]}")
 
     @staticmethod
     def _flight(desc: str) -> None:

@@ -73,8 +73,8 @@ def run_serve(args, command: List[str],
     from horovod_tpu.runner import safe_exec
     from horovod_tpu.runner import secret as secret_mod
     from horovod_tpu.runner.hosts import SlotInfo
+    from horovod_tpu.runner.kv_ha import start_control_plane
     from horovod_tpu.runner.launch import _local_ip, make_worker_cmd
-    from horovod_tpu.runner.rendezvous import RendezvousServer
     from horovod_tpu.serve.batching import ContinuousBatcher
     from horovod_tpu.serve.frontend import Frontend
     from horovod_tpu.serve.pool import ReplicaPool
@@ -89,8 +89,9 @@ def run_serve(args, command: List[str],
     # Honor a pre-set job secret (job_secret_key) so external clients
     # can authenticate against the frontend.
     job_secret = secret_mod.job_secret_key()
-    rdv = RendezvousServer(secret=job_secret.encode())
-    rdv_port = rdv.start()
+    # Plain in-process server, or (HOROVOD_KV_REPLICAS>1) the replicated
+    # control plane with epoch-fenced failover (runner/kv_ha.py).
+    rdv = start_control_plane(job_secret.encode())
     ip = _local_ip()
 
     preregister_metrics()
@@ -108,9 +109,8 @@ def run_serve(args, command: List[str],
 
     def spawn(slot: SlotInfo, round_id: int):
         env = dict(extra_env)
+        env.update(rdv.worker_env(ip))
         env.update({
-            C.HOROVOD_RENDEZVOUS_ADDR: ip,
-            C.HOROVOD_RENDEZVOUS_PORT: str(rdv_port),
             secret_mod.SECRET_ENV: job_secret,
             "HOROVOD_ELASTIC_ROUND": str(round_id),
         })

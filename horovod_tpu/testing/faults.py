@@ -21,9 +21,19 @@ Fields: `site` (required) names the hook point; `kind` (required) is one of
   latency         — sleep `ms` milliseconds, then continue
   crash           — os._exit(`code`, default 7): a hard worker kill
   flap            — raise FaultInjectedError (e.g. a discovery blink)
+  host_kill       — SIGKILL this process's whole PROCESS GROUP: the
+                    host-level failure mode (kernel panic, OOM-killer
+                    rampage, preemption) that takes the KV replica AND
+                    every helper it spawned down together
+  partition       — raise URLError(OSError EHOSTUNREACH): a network
+                    partition as seen from the caller — transient to
+                    RetryPolicy, so it retries/fails over rather than
+                    aborting (unlike flap)
 `p` is the per-hit probability (default 1.0), `after` skips the first N
 hits of the site, `count` caps total injections for the rule, `ms`/`code`
-parameterize latency/http_5xx/crash.
+parameterize latency/http_5xx/crash. `match` restricts a rule to hits
+whose `context` string (passed by the hook site, e.g. the peer endpoint a
+partition should cut) contains the given substring.
 
 Determinism: the RNG is seeded from HOROVOD_FAULT_SEED (default 0), and
 each rule draws from its own stream, so the same (spec, seed) replays the
@@ -31,7 +41,9 @@ same fault schedule regardless of unrelated sites' traffic.
 
 Hook sites currently wired: kv.request (runner/rendezvous.py),
 discovery.poll (elastic/discovery.py), worker.step
-(tests/elastic_worker.py). Adding one is one line:
+(tests/elastic_worker.py), kv_ha.put.r<id> and kv_ha.replicate.r<id>
+(runner/kv_ha.py — per-replica-id sites, so a host_kill rule can target
+exactly the initial primary). Adding one is one line:
 `from horovod_tpu.testing import faults; faults.inject("my.site")`.
 """
 
@@ -50,7 +62,8 @@ from horovod_tpu.common.exceptions import (FaultInjectedError,
 FAULT_SPEC_ENV = "HOROVOD_FAULT_SPEC"
 FAULT_SEED_ENV = "HOROVOD_FAULT_SEED"
 
-KINDS = ("connect_refused", "http_5xx", "latency", "crash", "flap")
+KINDS = ("connect_refused", "http_5xx", "latency", "crash", "flap",
+         "host_kill", "partition")
 
 
 @dataclasses.dataclass
@@ -62,6 +75,7 @@ class FaultRule:
     count: Optional[int] = None
     ms: float = 0.0
     code: int = 0
+    match: str = ""
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -93,7 +107,8 @@ def parse_spec(spec: str) -> List[FaultRule]:
             after=int(fields.get("after", "0")),
             count=int(fields["count"]) if "count" in fields else None,
             ms=float(fields.get("ms", "0")),
-            code=int(fields.get("code", "0"))))
+            code=int(fields.get("code", "0")),
+            match=fields.get("match", "")))
     return rules
 
 
@@ -125,13 +140,16 @@ class FaultInjector:
         seed = int(os.environ.get(FAULT_SEED_ENV, "0") or 0)
         return FaultInjector(parse_spec(spec), seed=seed)
 
-    def _pick(self, site: str) -> Optional[FaultRule]:
+    def _pick(self, site: str,
+              context: Optional[str] = None) -> Optional[FaultRule]:
         """Decide (under the lock) which rule, if any, fires for this hit."""
         with self._lock:
             hit_no = self.hits.get(site, 0)
             self.hits[site] = hit_no + 1
             for i, r in enumerate(self.rules):
                 if r.site != site:
+                    continue
+                if r.match and r.match not in (context or ""):
                     continue
                 if hit_no < r.after:
                     continue
@@ -144,8 +162,8 @@ class FaultInjector:
                 return r
             return None
 
-    def fire(self, site: str) -> None:
-        r = self._pick(site)
+    def fire(self, site: str, context: Optional[str] = None) -> None:
+        r = self._pick(site, context)
         if r is None:
             return
         if r.kind == "latency":
@@ -167,6 +185,18 @@ class FaultInjector:
             raise FaultInjectedError(f"[fault-injected] flap at {site}")
         if r.kind == "crash":
             os._exit(r.code or 7)
+        if r.kind == "partition":
+            import urllib.error
+            raise urllib.error.URLError(
+                OSError(113,  # EHOSTUNREACH: transient to RetryPolicy
+                        f"[fault-injected] partition at {site}"
+                        + (f" ({context})" if context else "")))
+        if r.kind == "host_kill":
+            import signal
+            # The whole process GROUP, exactly what `kill -9 -PID` at a
+            # dying host does: the replica, its HTTP threads, and any
+            # children all vanish without cleanup handlers running.
+            os.killpg(os.getpgrp(), signal.SIGKILL)
 
 
 # Process-wide injector: parsed from env once at import (workers launched
@@ -190,8 +220,9 @@ def uninstall() -> None:
     install(None)
 
 
-def inject(site: str) -> None:
+def inject(site: str, context: Optional[str] = None) -> None:
     """Production hook: no-op (one attribute check) unless an injector is
-    active."""
+    active. `context` lets `match=` rules target a specific hit — e.g.
+    the peer endpoint a partition rule should cut."""
     if _injector is not None:
-        _injector.fire(site)
+        _injector.fire(site, context)

@@ -1,0 +1,50 @@
+"""Sharded-checkpoint writer subprocess for test_kv_ha (ISSUE 16
+satellite): one REAL writer process of a writers=2 multi-writer save
+(ckpt/async_ckpt.py, PR 14). It persists its half of the leaf through
+`_persist` — shard files + fragment publish for the peer rank, fragment
+collection + merged-manifest commit for the primary — with the ckpt KV
+client built from the job env (`kv_from_env`), which is a multi-endpoint
+HA client whenever HOROVOD_RENDEZVOUS_ADDRS is set. The harness points
+this process's ADDR/PORT at a replica it already killed, so every KV op
+here lands only by failing over to the promoted primary.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+from horovod_tpu.ckpt import async_ckpt
+from horovod_tpu.ckpt import manifest as mf
+from horovod_tpu.ckpt import sharded
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--step", type=int, required=True)
+    ap.add_argument("--gen", type=int, required=True)
+    ap.add_argument("--val", type=float, required=True)
+    a = ap.parse_args(argv)
+    # this process IS rank a.rank of the 2-writer job
+    async_ckpt.AsyncCheckpointer._rank = staticmethod(lambda: a.rank)
+    s = async_ckpt.AsyncCheckpointer(a.root, writers=2)
+    lo, hi = (0, 4) if a.rank == 0 else (4, 8)
+    snaps = [sharded.LeafSnapshot(
+        mf.LeafEntry(path="['w']", shape=(8,), dtype="float32",
+                     spec=[["tp"]]),
+        [((lo,), (hi,), np.full((hi - lo,), a.val, np.float32))])]
+    s._persist(async_ckpt._Job(a.step, a.gen, snaps, 16, {}, 0.0))
+    if a.rank == 0 and mf.latest_committed(a.root) != (a.gen, a.step):
+        print(f"WRITER_FAIL rank=0 step={a.step} "
+              f"last_error={s.last_error}", flush=True)
+        return 1
+    print(f"WRITER_DONE rank={a.rank} step={a.step} "
+          f"failovers={getattr(s._kv_client(), 'failovers', 0)}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

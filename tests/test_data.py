@@ -215,8 +215,8 @@ def _fake_latency_injector(clock, ms):
     from horovod_tpu.testing import faults
 
     class FakeClockInjector(faults.FaultInjector):
-        def fire(self, site):
-            r = self._pick(site)
+        def fire(self, site, context=None):
+            r = self._pick(site, context)
             if r is not None and r.kind == "latency":
                 clock.advance(r.ms / 1000.0)
 

@@ -149,6 +149,83 @@ def test_injected_crash_kills_process():
     assert "unreachable" not in proc.stdout
 
 
+def test_parse_spec_match_field_and_new_kinds():
+    rules = parse_spec(
+        "site=kv_ha.replicate.r0,kind=partition,match=127.0.0.1:7001;"
+        "site=kv_ha.put.r0,kind=host_kill,after=4,count=1")
+    assert rules[0] == FaultRule("kv_ha.replicate.r0", "partition",
+                                 match="127.0.0.1:7001")
+    assert rules[1] == FaultRule("kv_ha.put.r0", "host_kill", after=4,
+                                 count=1)
+
+
+def test_match_rule_filters_on_context():
+    """A `match=` rule fires only when the site's context carries the
+    substring — the network-partition selector (ISSUE 16): cut one
+    replication link, leave the others healthy."""
+    inj = FaultInjector([FaultRule("rep", "partition", match=":7001")])
+    inj.fire("rep")                          # no context: skipped
+    inj.fire("rep", context="127.0.0.1:7002")  # other link: skipped
+    with pytest.raises(urllib.error.URLError):
+        inj.fire("rep", context="127.0.0.1:7001")
+    assert inj.injected["rep"] == 1
+
+
+def test_partition_kind_is_transient_to_retry_policy():
+    """Partition raises URLError(EHOSTUNREACH) — the same class the OS
+    gives a real partitioned connect, so RetryPolicy treats it as
+    transient (retry locally, then the client's failover loop moves
+    endpoints)."""
+    inj = FaultInjector([FaultRule("rep", "partition", count=2)])
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        inj.fire("rep", context="peer")
+        return "ok"
+
+    from test_kv_ha import fast_policy
+    assert fast_policy().call(flaky) == "ok"
+    assert calls["n"] == 3
+
+
+def test_host_kill_takes_down_the_process_group():
+    """host_kill SIGKILLs the whole process GROUP — children included —
+    the coordinator-visible signature of losing the host (rc -9,
+    nothing after the site runs)."""
+    code = (
+        "import os, subprocess, sys, time\n"
+        "child = subprocess.Popen(  # same group: dies with us\n"
+        "    [sys.executable, '-c', 'import time; time.sleep(60)'])\n"
+        "print('child', child.pid, flush=True)\n"
+        "from horovod_tpu.testing import faults\n"
+        "faults.inject('kv_ha.put.r0')\n"
+        "print('unreachable', flush=True)\n")
+    env = dict(os.environ)
+    env[faults.FAULT_SPEC_ENV] = "site=kv_ha.put.r0,kind=host_kill"
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    out, _ = proc.communicate(timeout=60)
+    assert proc.returncode == -9, proc.returncode
+    assert "unreachable" not in out
+    child_pid = int(out.split()[1])
+
+    def child_dead():
+        try:
+            with open(f"/proc/{child_pid}/stat") as f:
+                return f.read().split(") ")[-1][0] == "Z"  # unreaped
+        except OSError:
+            return True     # gone entirely
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if child_dead():
+            return
+        time.sleep(0.1)
+    os.kill(child_pid, 9)
+    pytest.fail("child survived host_kill of its group")
+
+
 # ------------------------------------------------- KVClient under injection
 
 @pytest.fixture()

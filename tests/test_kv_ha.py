@@ -1,0 +1,454 @@
+"""Replicated rendezvous control plane (runner/kv_ha.py; ISSUE 16).
+
+Unit coverage for the HA protocol with in-process ReplicaNodes —
+replication, seq catch-up (tail replay AND snapshot install), epoch
+fencing (a revived stale primary's write 409s and is NEVER observed on
+any replica), strictly-advancing promotion — plus the KVClient
+multi-endpoint failover, the endpoint announcement/parsing helpers,
+and the subprocess HAControlPlane facade with a real primary kill.
+
+The chaos e2e (training + serving jobs under host_kill) lives in
+test_kv_ha_e2e.py; this file is tier-1.
+"""
+
+import json
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.common.resilience import RetryError, RetryPolicy
+from horovod_tpu.runner.kv_ha import (HAControlPlane, ReplicaNode,
+                                      start_control_plane)
+from horovod_tpu.runner.rendezvous import (KVClient, RendezvousServer,
+                                           announce_endpoints, announce_port,
+                                           parse_endpoints, read_endpoints)
+
+
+def fast_policy(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("base_delay", 0.005)
+    kw.setdefault("max_delay", 0.02)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("deadline", 5.0)
+    return RetryPolicy(**kw)
+
+
+# ------------------------------------------------------ endpoint helpers
+def test_parse_endpoints_list_and_legacy_bare_port():
+    assert parse_endpoints("10.0.0.1:7000,10.0.0.2:7001") == [
+        ("10.0.0.1", 7000), ("10.0.0.2", 7001)]
+    # pre-HA port files held a bare port: still readable, loopback host
+    assert parse_endpoints("12345") == [("127.0.0.1", 12345)]
+    assert parse_endpoints(" 127.0.0.1:80 ,\n") == [("127.0.0.1", 80)]
+    assert parse_endpoints("") == []
+    with pytest.raises(ValueError):
+        parse_endpoints("nonsense")
+
+
+def test_announce_endpoints_roundtrip(tmp_path, monkeypatch):
+    pf = tmp_path / "rdv.port"
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT_FILE", str(pf))
+    announce_endpoints(["127.0.0.1:7000", "127.0.0.1:7001"])
+    assert pf.read_text() == "127.0.0.1:7000,127.0.0.1:7001"
+    assert read_endpoints(str(pf)) == [("127.0.0.1", 7000),
+                                       ("127.0.0.1", 7001)]
+    # single-server announcement stays readable by list-aware readers
+    announce_port(7002)
+    assert read_endpoints(str(pf)) == [("127.0.0.1", 7002)]
+    # legacy writer (bare port) stays readable too
+    pf.write_text("7003")
+    assert read_endpoints(str(pf)) == [("127.0.0.1", 7003)]
+
+
+# ---------------------------------------------- satellite: put_times parity
+def test_server_put_stamps_put_times_like_http_path():
+    """ISSUE 16 satellite: RendezvousServer.put() (the launcher's
+    in-process path) must stamp metrics/ arrival times exactly like the
+    HTTP PUT path — otherwise launcher-written snapshots are exempt
+    from HOROVOD_METRICS_STALE_SECONDS aging."""
+    srv = RendezvousServer(secret=None)
+    srv.start()
+    try:
+        t0 = time.time()
+        srv.put("metrics", "launcher", b"{}")
+        http = KVClient("127.0.0.1", srv.port, secret=None,
+                        retry_policy=fast_policy())
+        http.put("metrics", "rank-0", b"{}")
+        with srv._handler.lock:
+            stamps = dict(srv._handler.put_times)
+        assert "metrics/launcher" in stamps
+        assert "metrics/rank-0" in stamps
+        for k in ("metrics/launcher", "metrics/rank-0"):
+            assert stamps[k] >= t0 - 1.0
+        # non-metrics keys are not aged and must not be stamped
+        srv.put("discovery", "hosts", b"x")
+        http.put("elastic", "round", b"1")
+        with srv._handler.lock:
+            assert "discovery/hosts" not in srv._handler.put_times
+            assert "elastic/round" not in srv._handler.put_times
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------ in-process cluster
+def _cluster(n=2, secret=None):
+    nodes = [ReplicaNode(i, secret=secret) for i in range(n)]
+    for node in nodes:
+        node.start()
+    peers = [f"127.0.0.1:{node.port}" for node in nodes]
+    code, _ = nodes[0].on_promote({"epoch": 1, "peers": peers,
+                                   "leader": peers[0]})
+    assert code == 200
+    for node in nodes[1:]:
+        node.on_config({"peers": peers, "leader": peers[0]})
+    return nodes
+
+
+def _stop(nodes):
+    for node in nodes:
+        node.stop()
+
+
+def _client(node, **kw):
+    kw.setdefault("retry_policy", fast_policy())
+    return KVClient("127.0.0.1", node.port, secret=None, **kw)
+
+
+def test_replication_reaches_standby_before_ack(hvd=None):
+    a, b = _cluster(2)
+    try:
+        c = _client(a)
+        c.put("elastic", "round", b"7")
+        # synchronous replication: the acked write is ALREADY on the
+        # standby — failover at any instant after the ack keeps it
+        with b._lock:
+            assert b.store.get("elastic/round") == b"7"
+            assert b.applied_seq == 1
+        assert c.get("elastic", "round", timeout=0) == b"7"
+        c.delete("elastic", "round")
+        with b._lock:
+            assert "elastic/round" not in b.store
+            assert b.applied_seq == 2
+    finally:
+        _stop([a, b])
+
+
+def test_standby_rejects_client_ops_with_leader_hint():
+    a, b = _cluster(2)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{b.port}/elastic/round",
+                data=b"1", method="PUT"), timeout=5)
+        assert ei.value.code == 409
+        hint = json.loads(ei.value.read().decode())
+        assert hint["role"] == "standby"
+        assert hint["leader"].endswith(f":{a.port}")
+        # /leader is unauthenticated telemetry on both replicas
+        info = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{a.port}/leader", timeout=5).read())
+        assert info["role"] == "primary" and info["epoch"] == 1
+    finally:
+        _stop([a, b])
+
+
+def test_fencing_revived_stale_primary_write_never_observed():
+    """THE split-brain acceptance (ISSUE 16): a deposed primary that
+    comes back and tries to write gets 409, demotes itself, and the
+    poisoned key is observed on NO replica — fencing rejects the write
+    before any apply."""
+    a, b = _cluster(2)
+    try:
+        ca = _client(a)
+        ca.put("job", "owner", b"epoch1")
+        # Coordinator promotes b under epoch 2 ("a" looked dead —
+        # a pause, not a real death; it revives still thinking primary).
+        peers = [f"127.0.0.1:{b.port}", f"127.0.0.1:{a.port}"]
+        code, _ = b.on_promote({"epoch": 2, "peers": peers,
+                                "leader": peers[0]})
+        assert code == 200
+        with a._lock:
+            assert a.role == "primary"  # the stale primary, revived
+
+        # Its next write must fail loudly and leave no trace anywhere.
+        with pytest.raises((RetryError, urllib.error.HTTPError)) as ei:
+            ca_single = KVClient("127.0.0.1", a.port, secret=None,
+                                 retry_policy=fast_policy(),
+                                 endpoints=[f"127.0.0.1:{a.port}"])
+            ca_single.put("job", "owner", b"SPLIT-BRAIN")
+        err = ei.value
+        if isinstance(err, RetryError):
+            err = err.__cause__
+        assert isinstance(err, urllib.error.HTTPError) and err.code == 409
+        for node in (a, b):
+            with node._lock:
+                assert node.store.get("job/owner") == b"epoch1"
+        with a._lock:
+            assert a.fenced and a.role == "standby" and a.epoch == 2
+
+        # The NEW primary keeps working and replicates back to the
+        # deposed node (which follows the higher epoch).
+        cb = _client(b)
+        cb.put("job", "owner", b"epoch2")
+        for node in (a, b):
+            with node._lock:
+                assert node.store.get("job/owner") == b"epoch2"
+    finally:
+        _stop([a, b])
+
+
+def test_promotion_must_strictly_advance_epoch():
+    a, b = _cluster(2)
+    try:
+        # replaying the original promotion (same epoch) cannot
+        # resurrect leadership
+        code, _ = a.on_promote({"epoch": 1, "peers": [], "leader": ""})
+        assert code == 409
+        code, _ = b.on_promote({"epoch": 0, "peers": [], "leader": ""})
+        assert code == 409
+        code, info = b.on_promote({"epoch": 2,
+                                   "peers": [f"127.0.0.1:{b.port}"],
+                                   "leader": f"127.0.0.1:{b.port}"})
+        assert code == 200 and info["role"] == "primary"
+    finally:
+        _stop([a, b])
+
+
+def test_late_joiner_catches_up_from_log_tail():
+    a, b = _cluster(2)
+    c = ReplicaNode(2)
+    c.start()
+    try:
+        ca = _client(a)
+        for i in range(3):
+            ca.put("seed", f"k{i}", str(i).encode())
+        # c joins with an empty store; the primary learns about it
+        peers = [f"127.0.0.1:{n.port}" for n in (a, b, c)]
+        a.on_config({"peers": peers, "leader": peers[0]})
+        c.on_config({"peers": peers, "leader": peers[0]})
+        # next write -> 412 from c -> tail replay brings it current
+        ca.put("seed", "k3", b"3")
+        with c._lock:
+            assert c.applied_seq == 4
+            for i in range(4):
+                assert c.store.get(f"seed/k{i}") == str(i).encode()
+    finally:
+        _stop([a, b, c])
+
+
+def test_far_behind_joiner_gets_snapshot_install():
+    a, b = _cluster(2)
+    d = ReplicaNode(3)
+    d.start()
+    try:
+        ca = _client(a)
+        for i in range(3):
+            ca.put("seed", f"k{i}", str(i).encode())
+        with a._lock:
+            del a.log[:]    # tail evicted (as if > LOG_TAIL_MAX behind)
+        peers = [f"127.0.0.1:{n.port}" for n in (a, b, d)]
+        a.on_config({"peers": peers, "leader": peers[0]})
+        d.on_config({"peers": peers, "leader": peers[0]})
+        ca.put("seed", "k3", b"3")
+        with d._lock:
+            assert d.applied_seq == 4
+            assert d.epoch == 1 and d.role == "standby"
+            for i in range(4):
+                assert d.store.get(f"seed/k{i}") == str(i).encode()
+    finally:
+        _stop([a, b, d])
+
+
+# ------------------------------------------------- client-side failover
+def test_client_fails_over_to_new_primary_on_409():
+    a, b = _cluster(2)
+    try:
+        eps = [f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"]
+        c = KVClient("127.0.0.1", a.port, secret=None,
+                     retry_policy=fast_policy(), endpoints=eps)
+        c.put("x", "k", b"1")
+        # coordinator moves leadership to b; a demotes on first contact
+        b.on_promote({"epoch": 2, "peers": list(reversed(eps)),
+                      "leader": eps[1]})
+        c.put("x", "k", b"2")    # 409 at a -> /leader probe -> b
+        assert c.failovers >= 1
+        assert c.base.endswith(f":{b.port}")
+        with b._lock:
+            assert b.store.get("x/k") == b"2"
+        assert c.get("x", "k", timeout=0) == b"2"
+    finally:
+        _stop([a, b])
+
+
+def test_client_fails_over_on_exhausted_retries_dead_endpoint():
+    a, b = _cluster(2)
+    try:
+        eps = [f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"]
+        c = KVClient("127.0.0.1", a.port, secret=None,
+                     retry_policy=fast_policy(max_attempts=2),
+                     endpoints=eps)
+        c.put("x", "k", b"1")
+        a.stop()    # primary gone without ceremony
+        b.on_promote({"epoch": 2, "peers": [eps[1]], "leader": eps[1]})
+        c.put("x", "k", b"2")    # connect-refused exhausts -> probe -> b
+        assert c.failovers >= 1
+        with b._lock:
+            assert b.store.get("x/k") == b"2"
+    finally:
+        b.stop()
+
+
+def test_single_endpoint_client_behavior_unchanged():
+    """HOROVOD_KV_REPLICAS=1 compatibility: with one endpoint the client
+    raises RetryError exactly like the pre-HA client — no probe loop,
+    no failover pause, no rotation."""
+    c = KVClient("127.0.0.1", 1, secret=None,
+                 retry_policy=fast_policy(max_attempts=2, deadline=1.0))
+    assert c.endpoints == ["127.0.0.1:1"]
+    t0 = time.monotonic()
+    with pytest.raises(RetryError):
+        c.put("x", "k", b"1")
+    assert time.monotonic() - t0 < 3.0
+    assert c.failovers == 0
+
+
+# ------------------------------------------------- launcher control plane
+def test_start_control_plane_default_is_plain_server(monkeypatch):
+    monkeypatch.delenv("HOROVOD_KV_REPLICAS", raising=False)
+    rdv = start_control_plane(None)
+    try:
+        assert isinstance(rdv, RendezvousServer)
+        rdv.put("a", "b", b"c")
+        assert rdv.get("a", "b") == b"c"
+        env = rdv.worker_env("127.0.0.1")
+        assert "HOROVOD_RENDEZVOUS_ADDRS" not in env
+    finally:
+        rdv.stop()
+
+
+def test_ha_control_plane_requires_two_replicas():
+    with pytest.raises(ValueError):
+        HAControlPlane(secret=None, replicas=1)
+
+
+def test_ha_control_plane_subprocess_failover(tmp_path, monkeypatch):
+    """Real replica subprocesses: facade ops, endpoint announcement,
+    then SIGKILL of the primary's process group -> deterministic
+    successor under epoch 2, acked data intact, writes keep working."""
+    pf = tmp_path / "rdv.port"
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT_FILE", str(pf))
+    monkeypatch.setenv("HOROVOD_KV_PROBE_INTERVAL", "0.1")
+    monkeypatch.setenv("HOROVOD_KV_REPLICAS", "3")
+    cp = start_control_plane(b"kvhasecret-kvhasecret-kvhasecret")
+    assert isinstance(cp, HAControlPlane)
+    try:
+        cp.put("elastic", "round", b"1")
+        assert cp.get("elastic", "round") == b"1"
+        cp.put("elastic", "hosts", b"h0,h1")
+        assert cp.scope_items("elastic") == {"round": b"1",
+                                             "hosts": b"h0,h1"}
+        env = cp.worker_env("127.0.0.1")
+        addrs = env["HOROVOD_RENDEZVOUS_ADDRS"].split(",")
+        assert len(addrs) == 3
+        # announced list: primary first, all three present
+        assert read_endpoints(str(pf))[0][1] == cp.port
+        assert len(read_endpoints(str(pf))) == 3
+
+        old_port = cp.port
+        with cp._lock:
+            primary_pid = cp._procs[cp._primary_id].pid
+        os.killpg(os.getpgid(primary_pid), signal.SIGKILL)
+        deadline = time.monotonic() + 15
+        while cp.port == old_port and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert cp.port != old_port, "failover never happened"
+        info = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{cp.port}/leader", timeout=5).read())
+        assert info["role"] == "primary" and info["epoch"] == 2
+        # deterministic successor: all replicas share applied_seq, so
+        # the lowest surviving id (r1) wins
+        assert info["replica_id"] == 1
+        # the acked pre-failover writes survived; new writes land
+        assert cp.get("elastic", "round") == b"1"
+        cp.put("elastic", "round", b"2")
+        assert cp.get("elastic", "round") == b"2"
+        # the announcement now leads with the NEW primary, dead one gone
+        eps = read_endpoints(str(pf))
+        assert eps[0][1] == cp.port and len(eps) == 2
+    finally:
+        cp.stop()
+    with cp._lock:
+        assert all(p.poll() is not None for p in cp._procs)
+
+
+def test_multi_writer_sharded_save_across_failover(tmp_path, monkeypatch):
+    """ISSUE 16 satellite: PR 14's writers=2 sharded save with real
+    SEPARATE writer processes whose ckpt KV clients ride the HA control
+    plane. Generation 1 commits against the boot primary; then the
+    primary replica is SIGKILLed and generation 2's fragments +
+    merged-manifest commit land THROUGH the failover — both writers'
+    env still points at the dead replica, so every KV op succeeds only
+    via multi-endpoint failover."""
+    import subprocess
+    import sys as _sys
+    monkeypatch.setenv("HOROVOD_KV_PROBE_INTERVAL", "0.1")
+    secret = "mwsecret-mwsecret-mwsecret-mwsec"
+    cp = HAControlPlane(secret=secret.encode(), replicas=3)
+    cp.start()
+    root = str(tmp_path / "ckpt")
+    here = os.path.dirname(__file__)
+    try:
+        env = dict(os.environ)
+        env.update(cp.worker_env("127.0.0.1"))  # boot primary ADDR/PORT
+        env.update({"HOROVOD_SECRET_KEY": secret, "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": os.path.dirname(here)})
+
+        def writer(rank, step, gen, val):
+            return subprocess.run(
+                [_sys.executable, os.path.join(here, "ckpt_writer.py"),
+                 "--rank", str(rank), "--root", root, "--step", str(step),
+                 "--gen", str(gen), "--val", str(val)],
+                env=env, cwd=os.path.dirname(here), capture_output=True,
+                text=True, timeout=120)
+
+        # generation 1: the happy path (peer fragment, primary merge)
+        p1 = writer(1, 1, 1, 2.0)
+        p0 = writer(0, 1, 1, 1.0)
+        assert p1.returncode == 0, (p1.stdout, p1.stderr)
+        assert p0.returncode == 0, (p0.stdout, p0.stderr)
+        assert json.loads(cp.get("ckpt", "latest"))["generation"] == 1
+
+        old_port = cp.port
+        with cp._lock:
+            pid = cp._procs[cp._primary_id].pid
+        os.killpg(os.getpgid(pid), signal.SIGKILL)
+        deadline = time.monotonic() + 15
+        while cp.port == old_port and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert cp.port != old_port, "failover never happened"
+
+        # generation 2: fragments + commit through the failover
+        p1 = writer(1, 2, 2, 4.0)
+        assert p1.returncode == 0, (p1.stdout, p1.stderr)
+        assert "failovers=" in p1.stdout and "failovers=0" not in p1.stdout
+        p0 = writer(0, 2, 2, 3.0)
+        assert p0.returncode == 0, (p0.stdout, p0.stderr)
+
+        from horovod_tpu.ckpt import manifest as mf
+        from horovod_tpu.ckpt import sharded
+        assert mf.latest_committed(root) == (2, 2)
+        d = os.path.join(root, mf.dirname_for(2))
+        man = mf.read_manifest(d)
+        assert len(man.leaves[0].files) == 2  # both writers' shards
+        import numpy as np
+        np.testing.assert_array_equal(
+            sharded.assemble_leaf(d, man.leaves[0]),
+            [3, 3, 3, 3, 4, 4, 4, 4])
+        # the pointer landed on the NEW primary
+        assert json.loads(cp.get("ckpt", "latest"))["generation"] == 2
+    finally:
+        cp.stop()

@@ -20,11 +20,14 @@ from test_elastic_e2e import finish, start_job, wait_for_step, write_hosts
 
 
 def _wait_port(port_file, proc, timeout=60.0) -> int:
+    from horovod_tpu.runner.rendezvous import read_endpoints
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
-            return int(port_file.read_text())
-        except (FileNotFoundError, ValueError):
+            # Either announcement format (bare port or host:port list);
+            # the primary endpoint comes first.
+            return read_endpoints(str(port_file))[0][1]
+        except (FileNotFoundError, ValueError, IndexError):
             time.sleep(0.2)
     proc.kill()
     out, _ = proc.communicate()

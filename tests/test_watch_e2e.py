@@ -73,8 +73,9 @@ def _watch_env(tmp_path, slowdown: bool):
 
 
 def _run_hvdtop(env):
+    from horovod_tpu.runner.rendezvous import read_endpoints
     port_file = env["HOROVOD_RENDEZVOUS_PORT_FILE"]
-    port = int(open(port_file).read().strip())
+    port = read_endpoints(port_file)[0][1]
     sub_env = dict(os.environ)
     sub_env.update({"JAX_PLATFORMS": "cpu",
                     "HOROVOD_SECRET_KEY": env["HOROVOD_SECRET_KEY"]})
