@@ -83,9 +83,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke
 
-test: lint hlo-lint shard-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate entry
+test: lint hlo-lint shard-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -155,6 +155,18 @@ kv-ha-smoke:
 perf-gate:
 	$(PYTHON) scripts/perf_gate.py --run \
 	    --baseline scripts/perf_baseline.json
+	$(PYTHON) -m horovod_tpu.observability.perfboard --gate
+
+# Cross-round trajectory (docs/benchmarks.md): the perfboard unit
+# suite (loader pins against the real checked-in rounds + the gate run
+# both ways — the real trajectory passes, a synthetically regressed
+# fixture round fails naming section AND dominant moved phase), then
+# the CLI itself on the checked-in rounds: report, dashboard, gate.
+perfboard-smoke:
+	$(PYTEST) tests/test_perfboard.py
+	$(PYTHON) -m horovod_tpu.observability.perfboard > /dev/null
+	$(PYTHON) -m horovod_tpu.observability.perfboard --json > /dev/null
+	$(PYTHON) -m horovod_tpu.observability.perfboard --gate
 
 # Conv fast path (docs/perf.md): the fused-vs-reference equivalence
 # suite for the conv+BN+ReLU block kernels + the layout pass, then the
@@ -257,7 +269,7 @@ race:
 	    tests/test_watch.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
 	    tests/test_hvdlint.py tests/test_serve.py tests/test_ckpt.py \
-	    tests/test_kv_ha.py \
+	    tests/test_kv_ha.py tests/test_perfboard.py \
 	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache
 
 entry:

@@ -1570,6 +1570,17 @@ def _ckpt_bench_result(on_cpu, saver, root, plain, ckptd, per_round,
 _SECTION_ERRORS = {}
 
 
+def _provenance_meta():
+    """Round provenance stamp (perfboard.provenance_meta), tolerant:
+    a broken stamp must never cost the round its bench evidence —
+    especially not on the fatal emit path."""
+    try:
+        from horovod_tpu.observability.perfboard import provenance_meta
+        return provenance_meta(os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:
+        return {"meta_error": _err_str(e)}
+
+
 def _err_str(e):
     head = str(e).splitlines()[0][:300] if str(e) else ""
     return f"{type(e).__name__}: {head}" if head else type(e).__name__
@@ -1818,6 +1829,10 @@ def main():
         "vs_baseline": round(per_chip_ips / BASELINE_PER_CHIP, 3)
         if per_chip_ips else 0.0,
         "degraded": degraded,
+        # Provenance (git sha, UTC date, effective HOROVOD_* knob
+        # fingerprint, device platform/count) — what lets perfboard
+        # tell config drift from code regression across rounds.
+        "meta": _provenance_meta(),
         "extra": {
             "peak_tflops_per_chip": peak / 1e12 if peak else None,
             "device_health": health,
@@ -1861,6 +1876,7 @@ if __name__ == "__main__":
         print(json.dumps({
             "metric": "resnet50_synthetic_images_per_sec_per_chip",
             "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "meta": _provenance_meta(),
             "extra": {"fatal": _err_str(e),
                       "section_errors": _SECTION_ERRORS or None},
         }), flush=True)

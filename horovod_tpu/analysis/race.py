@@ -75,6 +75,7 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.serve.batching",
     "horovod_tpu.serve.pool",
     "horovod_tpu.ckpt.async_ckpt",
+    "horovod_tpu.observability.perfboard",
 )
 
 _LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))

@@ -1,0 +1,1341 @@
+"""perfboard: the cross-ROUND performance trajectory and its gate.
+
+    python -m horovod_tpu.observability.perfboard            # text report
+    python -m horovod_tpu.observability.perfboard --json
+    python -m horovod_tpu.observability.perfboard --html board.html
+    python -m horovod_tpu.observability.perfboard --gate     # CI mode
+
+Every observability layer before this one observes a *run*: perfscope
+summarizes steps, hvdwatch alerts inside a job, perf_gate checks one
+emitted profile against one baseline. What none of them sees is the
+repo's own history — the checked-in ``BENCH_rXX.json`` /
+``MULTICHIP_rXX.json`` round artifacts the driver records after each
+landed PR. Production systems treat performance as a longitudinally
+tracked, *attributed* signal (the Google-Wide Profiling lineage, Ren et
+al., IEEE Micro 2010; MLPerf's run rules, Mattson et al., MLSys 2020):
+a number is only meaningful against its trajectory, and a move is only
+actionable once something names *why* it moved. This module is that
+layer:
+
+* **Loader** — normalizes the heterogeneous round formats that actually
+  exist in the repo instead of demanding they be rewritten: ``full``
+  (driver-parsed doc with a ``meta`` provenance block — r06+),
+  ``tail-json`` (doc recovered whole from the captured stdout tail),
+  ``partial`` (head-truncated tails: complete per-section objects are
+  recovered by balanced-brace scanning), ``headline`` (metric line
+  only), ``failed`` (rc != 0, the exception summarized), and the
+  MULTICHIP ``legacy`` ``{rc, ok, n_devices, tail}`` blobs, reported as
+  presence-only points rather than crashed on or silently skipped.
+* **Diff engine** — per (section, metric) series over rounds, trend
+  breaks detected by the same median+MAD ``Detector`` hvdwatch runs
+  per-step (observability/watch.py), with the prior rounds as the
+  baseline window and the newest round as the judged sample. A flagged
+  move is then *attributed* from the stamps rounds already carry: the
+  perfscope phase split names the dominant moved phase, and the
+  ``layout`` / ``input_pipeline`` / ``memory`` / ``hlo_lint`` /
+  ``comms_by_axis`` / ``scaling`` / ``hvdwatch`` stamps plus the
+  ``meta`` provenance block separate code regressions from config
+  drift (platform change, knob change — r05 TPU vs r06 CPU mesh).
+* **Gate** — structural checks always (the newest round must load,
+  carry ``meta`` provenance, and validate); numeric trajectory checks
+  under the existing ``HOROVOD_PERF_GATE_NUMERIC`` convention, and only
+  between rounds whose provenance fingerprints match — a legacy or
+  cross-platform point is *reported*, never *gated on*, because a
+  platform change is drift, not regression.
+
+Knobs (docs/env_vars.md): HOROVOD_PERFBOARD_DIR (rounds directory),
+HOROVOD_PERFBOARD_Z (detector z threshold), HOROVOD_PERFBOARD_REL_FLOOR
+(relative sigma floor), HOROVOD_PERFBOARD_MIN_POINTS (prior points
+required before a series is judged).
+
+Exit codes: 0 OK, 1 gate failure, 2 usage/IO.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import hashlib
+import html as _html
+import json
+import os
+import re
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from horovod_tpu.observability.watch import Detector, DetectorConfig
+
+PERFBOARD_DIR_ENV = "HOROVOD_PERFBOARD_DIR"
+PERFBOARD_Z_ENV = "HOROVOD_PERFBOARD_Z"
+PERFBOARD_REL_FLOOR_ENV = "HOROVOD_PERFBOARD_REL_FLOOR"
+PERFBOARD_MIN_POINTS_ENV = "HOROVOD_PERFBOARD_MIN_POINTS"
+
+#: Schema tag stamped into the provenance `meta` block.
+META_VERSION = 1
+
+#: Round filename shapes the loader owns.
+BENCH_GLOB = "BENCH_r*.json"
+MULTICHIP_GLOB = "MULTICHIP_r*.json"
+_ROUND_RE = re.compile(r"(BENCH|MULTICHIP)_r(\d+)\.json$")
+
+#: bench.py `extra` section names — the recovery scanner's vocabulary
+#: for head-truncated tails (r04/r05: the JSON line's head is gone but
+#: every *complete* `"section": {...}` object inside the tail is not).
+KNOWN_SECTIONS: Tuple[str, ...] = (
+    "resnet50", "resnet101", "inception_v3", "vgg16", "transformer_lm",
+    "bert_base_finetune", "fusion_sweep_grouped_allreduce",
+    "gspmd_hybrid", "lm_overlap_train_step", "autotune",
+    "flash_attention_s8192", "serving", "checkpointing",
+    "device_health", "meta",
+)
+
+#: Tracked per-section metrics -> Detector direction (+1: higher is
+#: worse — times, overheads; -1: lower is worse — throughputs, MFU,
+#: speedups). Flat keys of a section dict; "scaling.efficiency_vs_dp"
+#: is the one nested stamp promoted to a first-class series.
+TRACKED: Dict[str, int] = {
+    "step_ms": +1,
+    "images_per_sec_per_chip": -1,
+    "tokens_per_sec_per_chip": -1,
+    "mfu": -1,
+    "mfu_vs_measured": -1,
+    "adasum_step_ms": +1,
+    "predivide_step_ms": +1,
+    "adasum_samples_per_sec": -1,
+    "predivide_samples_per_sec": -1,
+    "flash_fwd_bwd_ms": +1,
+    "speedup": -1,
+    "tuned_ms": +1,
+    "tuned_speedup_vs_default": -1,
+    "fused_step_ms": +1,
+    "bucketed_step_ms": +1,
+    "speedup_bucketed_vs_fused": -1,
+    "overhead_fraction": +1,
+    "snapshot_ms": +1,
+    "persist_ms": +1,
+    "requests_per_sec": -1,
+    "p50_ms": +1,
+    "p99_ms": +1,
+    "scaling.efficiency_vs_dp": -1,
+}
+
+#: The conv sections — the ROADMAP item 2 MFU campaign rides these.
+CONV_SECTIONS = ("resnet50", "resnet101", "inception_v3", "vgg16")
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+# ----------------------------------------------------------- provenance
+
+def provenance_meta(root: Optional[str] = None) -> Dict[str, Any]:
+    """The `meta` block bench.py / the dryrun stamp at the top of every
+    round (git sha, UTC date, effective HOROVOD_* knob fingerprint via
+    the docs/env_vars.md catalog, device platform/count) — what lets
+    perfboard tell config drift from code regression. Every field
+    degrades to None rather than raising: a bench run on a stripped
+    checkout must still produce a round."""
+    import datetime
+    import platform as _platform
+    import subprocess
+
+    root = root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sha = None
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=root, capture_output=True,
+            text=True, timeout=10).stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        pass
+    knobs: Dict[str, str] = {}
+    uncataloged: List[str] = []
+    try:
+        import pathlib
+
+        from horovod_tpu.analysis.env_rule import documented_vars
+        catalog = documented_vars(pathlib.Path(root))
+    except Exception:
+        catalog = None
+    for name in sorted(os.environ):
+        if not name.startswith("HOROVOD_"):
+            continue
+        if catalog is None or name in catalog:
+            knobs[name] = os.environ[name]
+        else:
+            uncataloged.append(name)
+    dev_platform = dev_kind = None
+    num_devices = None
+    try:
+        import jax
+        devs = jax.devices()
+        dev_platform = devs[0].platform
+        dev_kind = devs[0].device_kind
+        num_devices = len(devs)
+    except Exception:
+        pass
+    meta: Dict[str, Any] = {
+        "meta_version": META_VERSION,
+        "git_sha": sha,
+        "date_utc": datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "hostname": _platform.node() or None,
+        "python": _platform.python_version(),
+        "device_platform": dev_platform,
+        "device_kind": dev_kind,
+        "num_devices": num_devices,
+        "knobs": knobs,
+        "uncataloged_knobs": uncataloged or None,
+    }
+    meta["fingerprint"] = meta_fingerprint(meta)
+    return meta
+
+
+#: Knobs that only name OUTPUT destinations — they cannot change what
+#: was measured, and paths differ run to run, so they stay out of the
+#: comparability fingerprint (while still recorded in meta.knobs).
+_FINGERPRINT_EXCLUDE = frozenset({
+    "HOROVOD_MULTICHIP_JSON", "HOROVOD_FLIGHT_DIR",
+    "HOROVOD_PERFBOARD_DIR", "HOROVOD_WATCH_WEBHOOK",
+    "HOROVOD_TIMELINE",
+})
+
+
+def meta_fingerprint(meta: Dict[str, Any]) -> str:
+    """Comparability fingerprint of a `meta` block: platform, device,
+    device count and the effective knob set — NOT the sha, date,
+    hostname, or output-path knobs, so two runs of the same
+    configuration compare even across commits. Two rounds are
+    numerically comparable iff this matches."""
+    basis = json.dumps({
+        "device_platform": meta.get("device_platform"),
+        "device_kind": meta.get("device_kind"),
+        "num_devices": meta.get("num_devices"),
+        "knobs": {k: v for k, v in (meta.get("knobs") or {}).items()
+                  if k not in _FINGERPRINT_EXCLUDE},
+    }, sort_keys=True)
+    return hashlib.sha256(basis.encode()).hexdigest()[:12]
+
+
+# ------------------------------------------------------------- recovery
+
+def _scan_object(text: str, start: int) -> Optional[str]:
+    """The balanced `{...}` JSON object starting at `start`, honoring
+    strings/escapes, or None if it never closes (truncated)."""
+    depth = 0
+    in_str = False
+    esc = False
+    for i in range(start, len(text)):
+        c = text[i]
+        if esc:
+            esc = False
+            continue
+        if in_str:
+            if c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+            continue
+        if c == '"':
+            in_str = True
+        elif c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start:i + 1]
+    return None
+
+
+def recover_sections(tail: str) -> Dict[str, Any]:
+    """Salvage complete `"section": {...}` objects (KNOWN_SECTIONS) from
+    a head-truncated bench stdout tail — the r04/r05 shape: the JSON
+    line's head scrolled out of the captured window, but its suffix
+    (whole sections, brace-balanced) did not. Incomplete objects are
+    skipped, never guessed at."""
+    out: Dict[str, Any] = {}
+    for name in KNOWN_SECTIONS:
+        key = f'"{name}": '
+        pos = tail.rfind(key)
+        if pos < 0:
+            continue
+        start = pos + len(key)
+        if start >= len(tail) or tail[start] != "{":
+            continue
+        blob = _scan_object(tail, start)
+        if blob is None:
+            continue
+        try:
+            out[name] = json.loads(blob)
+        except ValueError:
+            continue
+    # Top-level scalars worth keeping when present after the last
+    # recovered section boundary (platform identification).
+    m = re.search(r'"device": "([^"]+)"', tail)
+    if m:
+        out["device"] = m.group(1)
+    m = re.search(r'"num_chips": (\d+)', tail)
+    if m:
+        out["num_chips"] = int(m.group(1))
+    return out
+
+
+def _last_json_line(tail: str) -> Optional[Dict[str, Any]]:
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
+# ----------------------------------------------------------------- load
+
+class Round:
+    """One normalized round artifact (the unit of observation here is a
+    ROUND, not a step)."""
+
+    def __init__(self, kind: str, n: int, path: str) -> None:
+        self.kind = kind              # "bench" | "multichip"
+        self.n = n
+        self.path = path
+        self.format = "unknown"       # full|tail-json|partial|headline|
+        #                               failed|legacy
+        self.rc: Optional[int] = None
+        self.ok: Optional[bool] = None
+        self.meta: Optional[Dict[str, Any]] = None
+        self.headline: Optional[Dict[str, Any]] = None
+        self.sections: Dict[str, Any] = {}
+        self.top: Dict[str, Any] = {}  # top-level extra scalars
+        self.notes: List[str] = []
+
+    @property
+    def label(self) -> str:
+        return f"r{self.n:02d}"
+
+    def platform(self) -> Optional[str]:
+        """Normalized platform token for comparability: meta first,
+        then the recorded device string, then the structural tell that
+        only TPU rounds carry per-section `window_tflops` stamps."""
+        if self.meta and self.meta.get("device_platform"):
+            return str(self.meta["device_platform"]).lower()
+        dev = str(self.top.get("device") or "")
+        if "tpu" in dev.lower():
+            return "tpu"
+        if "cpu" in dev.lower():
+            return "cpu"
+        for sec in self.sections.values():
+            if isinstance(sec, dict) and "window_tflops" in sec:
+                return "tpu"
+        return None
+
+    def fingerprint(self) -> Optional[str]:
+        return self.meta.get("fingerprint") if self.meta else None
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind, "n": self.n,
+            "path": os.path.basename(self.path),
+            "format": self.format, "rc": self.rc, "ok": self.ok,
+            "platform": self.platform(),
+            "meta": bool(self.meta),
+            "fingerprint": self.fingerprint(),
+            "sections": sorted(self.sections),
+            "notes": self.notes,
+        }
+
+
+def _round_n(path: str) -> Optional[Tuple[str, int]]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    if not m:
+        return None
+    return m.group(1).lower(), int(m.group(2))
+
+
+def _adopt_bench_doc(r: Round, inner: Dict[str, Any]) -> None:
+    """Fold a full bench JSON document into the round."""
+    r.headline = {k: inner.get(k)
+                  for k in ("metric", "value", "unit", "vs_baseline")
+                  if inner.get(k) is not None} or None
+    extra = inner.get("extra")
+    if isinstance(extra, dict):
+        for k, v in extra.items():
+            if isinstance(v, dict) and k != "meta":
+                r.sections[k] = v
+            elif not isinstance(v, dict):
+                r.top[k] = v
+    meta = inner.get("meta")
+    if meta is None and isinstance(extra, dict):
+        meta = extra.get("meta")
+    if isinstance(meta, dict):
+        r.meta = meta
+        if "fingerprint" not in meta:
+            meta["fingerprint"] = meta_fingerprint(meta)
+    fatal = (extra or {}).get("fatal") if isinstance(extra, dict) else None
+    if fatal:
+        r.notes.append(f"fatal: {fatal}")
+
+
+def load_bench_round(path: str) -> Round:
+    """Normalize one BENCH_rXX.json driver artifact (`{n, cmd, rc,
+    tail, parsed}`) into a Round, tolerating every legacy shape that is
+    actually checked in — see the module docstring's format taxonomy."""
+    named = _round_n(path)
+    n = named[1] if named else -1
+    r = Round("bench", n, path)
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: round is not a JSON object")
+    r.rc = doc.get("rc")
+    tail = doc.get("tail") or ""
+    parsed = doc.get("parsed")
+    inner: Optional[Dict[str, Any]] = None
+    if isinstance(parsed, dict):
+        inner = parsed
+        r.format = "headline" if "extra" not in parsed else "tail-json"
+    elif r.rc == 0:
+        inner = _last_json_line(tail)
+        if inner is not None and "extra" in inner:
+            r.format = "tail-json"
+        elif inner is not None:
+            r.format = "headline"
+        else:
+            recovered = recover_sections(tail)
+            secs = {k: v for k, v in recovered.items()
+                    if isinstance(v, dict) and k != "meta"}
+            if secs:
+                r.format = "partial"
+                r.sections = secs
+                r.top = {k: v for k, v in recovered.items()
+                         if not isinstance(v, dict)}
+                if isinstance(recovered.get("meta"), dict):
+                    r.meta = recovered["meta"]
+                r.notes.append(
+                    f"head-truncated tail: recovered "
+                    f"{len(secs)} complete section(s) by brace scan")
+            else:
+                r.format = "failed"
+                r.notes.append("rc=0 but no JSON document in tail")
+    else:
+        r.format = "failed"
+        lines = [ln for ln in tail.strip().splitlines() if ln.strip()]
+        if lines:
+            r.notes.append(f"rc={r.rc}: {lines[-1][:160]}")
+    if inner is not None:
+        _adopt_bench_doc(r, inner)
+        if r.meta is not None and r.format == "tail-json":
+            r.format = "full"
+    if r.meta is None and r.format not in ("failed",):
+        r.notes.append("no meta provenance block (pre-r06 legacy round)")
+    r.ok = r.rc == 0 and r.format != "failed"
+    return r
+
+
+def load_multichip_round(path: str) -> Round:
+    """Normalize one MULTICHIP_rXX.json. r01–r05 are legacy `{rc, ok,
+    n_devices, skipped, tail}` blobs (the structured MULTICHIP_JSON
+    emitter landed in PR 13 but no structured round was ever checked
+    in) — classified `legacy` and reported as presence-only points.
+    Modern rounds carry the dryrun report (with `models` and `meta`)
+    either as `parsed` or as the whole document."""
+    named = _round_n(path)
+    n = named[1] if named else -1
+    r = Round("multichip", n, path)
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: round is not a JSON object")
+    r.rc = doc.get("rc")
+    r.ok = doc.get("ok")
+    report = None
+    if isinstance(doc.get("parsed"), dict) and "models" in doc["parsed"]:
+        report = doc["parsed"]
+    elif "models" in doc:
+        report = doc
+    if report is None:
+        tail = doc.get("tail") or ""
+        for line in reversed(tail.splitlines()):
+            if line.startswith("MULTICHIP_JSON "):
+                try:
+                    cand = json.loads(line[len("MULTICHIP_JSON "):])
+                except ValueError:
+                    break
+                if isinstance(cand, dict) and "models" in cand:
+                    report = cand
+                break
+    if report is not None:
+        r.format = "full"
+        r.top["n_devices"] = report.get("n_devices",
+                                        doc.get("n_devices"))
+        for name, res in (report.get("models") or {}).items():
+            if isinstance(res, dict):
+                r.sections[name] = res
+        for name in ("tied_lm_dp", "tied_lm_hybrid"):
+            if isinstance(report.get(name), dict):
+                r.sections[name] = report[name]
+        if isinstance(report.get("scaling"), dict):
+            r.sections["scaling"] = {"scaling": report["scaling"]}
+        if isinstance(report.get("meta"), dict):
+            r.meta = report["meta"]
+            if "fingerprint" not in r.meta:
+                r.meta["fingerprint"] = meta_fingerprint(r.meta)
+    else:
+        r.format = "legacy"
+        r.top["n_devices"] = doc.get("n_devices")
+        r.notes.append(
+            "legacy {rc, ok, tail} blob — presence-only point "
+            "(no structured MULTICHIP_JSON in this round)")
+        if r.rc not in (0, None):
+            tail = doc.get("tail") or ""
+            lines = [ln for ln in tail.strip().splitlines()
+                     if ln.strip()]
+            if lines:
+                r.notes.append(f"rc={r.rc}: {lines[-1][:160]}")
+    if r.meta is None:
+        r.notes.append("no meta provenance block (pre-r06 legacy round)")
+    return r
+
+
+def load_rounds(dirpath: str) -> Dict[str, List[Round]]:
+    """Every checked-in round under `dirpath`, sorted by round number.
+    Unreadable files raise — the trajectory-integrity test exists so a
+    hand-edited round breaks loudly, not silently."""
+    out: Dict[str, List[Round]] = {"bench": [], "multichip": []}
+    for path in sorted(glob.glob(os.path.join(dirpath, BENCH_GLOB))):
+        out["bench"].append(load_bench_round(path))
+    for path in sorted(glob.glob(os.path.join(dirpath, MULTICHIP_GLOB))):
+        out["multichip"].append(load_multichip_round(path))
+    for k in out:
+        out[k].sort(key=lambda r: r.n)
+    n = len(out["bench"]) + len(out["multichip"])
+    if n:
+        _METRICS.handles()["rounds_loaded"].inc(n)
+    return out
+
+
+def validate_file(path: str) -> List[str]:
+    """Schema validation of one round artifact — the tier-1 trajectory
+    integrity check. Returns human-readable problems; empty means the
+    round loads and is internally consistent. A FAILED round is valid
+    (failure is part of the trajectory); a corrupted one is not."""
+    errs: List[str] = []
+    name = os.path.basename(path)
+    named = _round_n(path)
+    if named is None:
+        return [f"{name}: filename does not match "
+                f"(BENCH|MULTICHIP)_rNN.json"]
+    kind, n = named
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{name}: unreadable round: {e}"]
+    if not isinstance(doc, dict):
+        return [f"{name}: round is not a JSON object"]
+    if not isinstance(doc.get("tail", ""), str):
+        errs.append(f"{name}: tail is not a string")
+    if doc.get("rc") is not None and not isinstance(doc["rc"], int):
+        errs.append(f"{name}: rc is not an int")
+    if kind == "bench":
+        if not isinstance(doc.get("n"), int):
+            errs.append(f"{name}: missing driver round number `n`")
+        elif doc["n"] != n:
+            errs.append(f"{name}: driver n={doc['n']} disagrees with "
+                        f"filename round {n}")
+        if doc.get("parsed") is not None \
+                and not isinstance(doc["parsed"], dict):
+            errs.append(f"{name}: parsed is neither null nor an object")
+        try:
+            r = load_bench_round(path)
+        except Exception as e:  # defensive: loader must never crash CI
+            return errs + [f"{name}: loader raised: {e}"]
+        if r.format == "unknown":
+            errs.append(f"{name}: unclassifiable round format")
+        if r.rc == 0 and r.format == "failed":
+            errs.append(f"{name}: rc=0 round carries no recoverable "
+                        "bench document")
+        if r.meta is not None:
+            for k in ("git_sha", "date_utc", "device_platform",
+                      "num_devices", "knobs", "fingerprint"):
+                if k not in r.meta:
+                    errs.append(f"{name}: meta provenance block is "
+                                f"missing `{k}`")
+    else:
+        if "n_devices" in doc and not isinstance(
+                doc["n_devices"], int):
+            errs.append(f"{name}: n_devices is not an int")
+        try:
+            r = load_multichip_round(path)
+        except Exception as e:
+            return errs + [f"{name}: loader raised: {e}"]
+        if r.format == "full" and not r.sections:
+            errs.append(f"{name}: structured round carries no models")
+    return errs
+
+
+def validate_dir(dirpath: str) -> List[str]:
+    errs: List[str] = []
+    for pat in (BENCH_GLOB, MULTICHIP_GLOB):
+        for path in sorted(glob.glob(os.path.join(dirpath, pat))):
+            errs.extend(validate_file(path))
+    return errs
+
+
+# ----------------------------------------------------------- trajectory
+
+def _section_platform(rnd: Round, sec: Dict[str, Any]) -> Optional[str]:
+    """Sections carry their own platform when they ran somewhere other
+    than the round's device (the fusion/autotune/gspmd CPU-mesh
+    subprocess inside a TPU round)."""
+    plat = sec.get("platform")
+    if isinstance(plat, str):
+        low = plat.lower()
+        if "cpu mesh" in low or "cpu" in low:
+            return "cpu-mesh"
+        if "tpu" in low:
+            return "tpu"
+    return rnd.platform()
+
+
+def section_metrics(sec: Dict[str, Any]) -> Dict[str, float]:
+    """The tracked numeric metrics of one section dict."""
+    out: Dict[str, float] = {}
+    for k, direction in TRACKED.items():
+        if "." in k:
+            head, leaf = k.split(".", 1)
+            v = (sec.get(head) or {}).get(leaf) \
+                if isinstance(sec.get(head), dict) else None
+        else:
+            v = sec.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def build_series(rounds: Sequence[Round]
+                 ) -> Dict[Tuple[str, str], List[Dict[str, Any]]]:
+    """{(section, metric): [point...]} over the given rounds; each
+    point carries the value plus the comparability context (platform,
+    provenance fingerprint) the diff engine filters on."""
+    series: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for rnd in rounds:
+        if rnd.headline and isinstance(rnd.headline.get("value"),
+                                       (int, float)) \
+                and rnd.headline["value"]:
+            series.setdefault(("headline", "value"), []).append({
+                "round": rnd.n, "value": float(rnd.headline["value"]),
+                "platform": rnd.platform(),
+                "fingerprint": rnd.fingerprint(),
+            })
+        for name, sec in sorted(rnd.sections.items()):
+            if not isinstance(sec, dict):
+                continue
+            plat = _section_platform(rnd, sec)
+            for met, val in section_metrics(sec).items():
+                series.setdefault((name, met), []).append({
+                    "round": rnd.n, "value": val, "platform": plat,
+                    "fingerprint": rnd.fingerprint(),
+                })
+    return series
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def judge_series(points: List[Dict[str, Any]], direction: int,
+                 z: float, rel_floor: float, min_points: int
+                 ) -> Optional[Dict[str, Any]]:
+    """Feed the prior points to a watch.py Detector as its baseline and
+    judge the newest point — the per-step anomaly machinery reused at
+    round granularity. Returns the verdict dict (regressed flag, z,
+    median, delta) or None when too few priors exist."""
+    if len(points) < min_points + 1:
+        return None
+    *prior, last = points
+    vals = [p["value"] for p in prior]
+    cfg = DetectorConfig(
+        name="round", warmup=len(vals), z=z, hysteresis=1,
+        cooldown_s=0.0, window=max(8, len(vals) + 1),
+        direction=direction, rel_floor=rel_floor)
+    det = Detector(cfg)
+    for i, v in enumerate(vals):
+        det.observe(v, float(i))
+    fired = det.observe(last["value"], float(len(vals)))
+    med = det.last_median
+    delta_pct = ((last["value"] - med) / med * 100.0) if med else None
+    # Improvements: same machinery, judged from the other side.
+    det2 = Detector(DetectorConfig(
+        name="round", warmup=len(vals), z=z, hysteresis=1,
+        cooldown_s=0.0, window=max(8, len(vals) + 1),
+        direction=-direction, rel_floor=rel_floor))
+    for i, v in enumerate(vals):
+        det2.observe(v, float(i))
+    improved = det2.observe(last["value"], float(len(vals)))
+    return {
+        "round": last["round"], "value": last["value"],
+        "median": med, "z": det.last_z, "delta_pct": delta_pct,
+        "regressed": fired is not None,
+        "improved": improved is not None,
+        "n_prior": len(vals),
+    }
+
+
+def _phase_attribution(cur: Dict[str, Any], ref: Dict[str, Any]
+                       ) -> Optional[Dict[str, Any]]:
+    """Dominant moved perfscope phase between two stamped sections:
+    which phase absorbed the step-time delta."""
+    cp = (cur.get("perfscope") or {}).get("phases_s") or {}
+    rp = (ref.get("perfscope") or {}).get("phases_s") or {}
+    if not cp or not rp:
+        return None
+    deltas = {ph: cp.get(ph, 0.0) - rp.get(ph, 0.0)
+              for ph in set(cp) | set(rp)}
+    dominant = max(deltas, key=lambda ph: abs(deltas[ph]))
+    return {
+        "dominant_phase": dominant,
+        "dominant_delta_ms": round(deltas[dominant] * 1e3, 3),
+        "phase_deltas_ms": {ph: round(d * 1e3, 3)
+                            for ph, d in sorted(deltas.items())},
+    }
+
+
+def attribute(sec_name: str, cur_rnd: Round, ref_rnd: Round
+              ) -> Dict[str, Any]:
+    """WHY a section moved between two rounds, from the stamps the
+    rounds already carry — attribution, not just detection."""
+    cur = cur_rnd.sections.get(sec_name) or {}
+    ref = ref_rnd.sections.get(sec_name) or {}
+    out: Dict[str, Any] = {"vs_round": ref_rnd.n}
+    causes: List[str] = []
+    # Config drift first: a platform/knob change explains everything
+    # downstream of it and must not be misread as a code regression.
+    cur_fp, ref_fp = cur_rnd.fingerprint(), ref_rnd.fingerprint()
+    cur_plat = _section_platform(cur_rnd, cur)
+    ref_plat = _section_platform(ref_rnd, ref)
+    if cur_plat and ref_plat and cur_plat != ref_plat:
+        out["config_drift"] = (f"platform changed "
+                               f"{ref_plat} -> {cur_plat}")
+        causes.append(out["config_drift"])
+    elif cur_fp and ref_fp and cur_fp != ref_fp:
+        drift = []
+        ck = (cur_rnd.meta or {}).get("knobs") or {}
+        rk = (ref_rnd.meta or {}).get("knobs") or {}
+        for k in sorted(set(ck) | set(rk)):
+            if ck.get(k) != rk.get(k):
+                drift.append(f"{k}: {rk.get(k)!r} -> {ck.get(k)!r}")
+        out["config_drift"] = ("provenance fingerprint changed"
+                               + (f" ({'; '.join(drift[:4])})"
+                                  if drift else ""))
+        causes.append(out["config_drift"])
+    phases = _phase_attribution(cur, ref)
+    if phases:
+        out.update(phases)
+        causes.append(
+            f"dominant moved phase: {phases['dominant_phase']} "
+            f"({phases['dominant_delta_ms']:+.2f} ms)")
+    for stamp, label in (("layout", "layout mode"),
+                         ("input_pipeline", "input pipeline")):
+        cm = (cur.get(stamp) or {}).get("mode") \
+            if isinstance(cur.get(stamp), dict) else None
+        rm = (ref.get(stamp) or {}).get("mode") \
+            if isinstance(ref.get(stamp), dict) else None
+        if cm != rm and (cm or rm):
+            out[f"{stamp}_change"] = f"{rm} -> {cm}"
+            causes.append(f"{label} changed {rm} -> {cm}")
+    cw = (cur.get("hvdwatch") or {}).get("anomalies_total")
+    rw = (ref.get("hvdwatch") or {}).get("anomalies_total")
+    if isinstance(cw, (int, float)) and cw and cw != (rw or 0):
+        out["hvdwatch_anomalies"] = {"current": cw, "reference": rw}
+        causes.append(f"{int(cw)} hvdwatch anomaly(ies) during the "
+                      "measured run")
+    cm_ = (cur.get("memory") or {}).get("static_peak_device_bytes")
+    rm_ = (ref.get("memory") or {}).get("static_peak_device_bytes")
+    if isinstance(cm_, (int, float)) and isinstance(rm_, (int, float)) \
+            and rm_ and abs(cm_ - rm_) / rm_ > 0.10:
+        out["memory_delta_pct"] = round((cm_ - rm_) / rm_ * 100, 1)
+        causes.append(f"static peak HBM moved "
+                      f"{out['memory_delta_pct']:+.1f}%")
+    ch = cur.get("hlo_lint")
+    rh = ref.get("hlo_lint")
+    if isinstance(ch, dict) and isinstance(rh, dict):
+        cn = len(ch.get("findings") or []) \
+            if isinstance(ch.get("findings"), list) else 0
+        rn = len(rh.get("findings") or []) \
+            if isinstance(rh.get("findings"), list) else 0
+        if cn > rn:
+            out["hlo_lint_new_findings"] = cn - rn
+            causes.append(f"{cn - rn} new hvdhlo finding(s) in the "
+                          "lowered program")
+    cc = cur.get("comms_by_axis")
+    rc_ = ref.get("comms_by_axis")
+    if isinstance(cc, dict) and isinstance(rc_, dict):
+        for axis in sorted(set(cc) | set(rc_)):
+            cb = (cc.get(axis) or {}).get("bytes_per_step")
+            rb = (rc_.get(axis) or {}).get("bytes_per_step")
+            if isinstance(cb, (int, float)) \
+                    and isinstance(rb, (int, float)) and rb \
+                    and abs(cb - rb) / rb > 0.10:
+                out.setdefault("comms_delta_pct", {})[axis] = round(
+                    (cb - rb) / rb * 100, 1)
+                causes.append(f"comms bytes on axis {axis!r} moved "
+                              f"{(cb - rb) / rb * 100:+.1f}%")
+    cs = (cur.get("scaling") or {}).get("efficiency_vs_dp")
+    rs = (ref.get("scaling") or {}).get("efficiency_vs_dp")
+    if isinstance(cs, (int, float)) and isinstance(rs, (int, float)) \
+            and rs and abs(cs - rs) / rs > 0.10:
+        out["scaling_delta_pct"] = round((cs - rs) / rs * 100, 1)
+        causes.append(f"scaling efficiency vs DP moved "
+                      f"{out['scaling_delta_pct']:+.1f}%")
+    if not causes:
+        causes.append("no stamp moved — unattributed "
+                      "(noise, or an unstamped cause)")
+    out["causes"] = causes
+    return out
+
+
+def _latest_with_section(rounds: Sequence[Round], sec: str,
+                         before: int) -> Optional[Round]:
+    best = None
+    for r in rounds:
+        if r.n < before and sec in r.sections:
+            if best is None or r.n > best.n:
+                best = r
+    return best
+
+
+def analyze(rounds: Dict[str, List[Round]],
+            z: Optional[float] = None,
+            rel_floor: Optional[float] = None,
+            min_points: Optional[int] = None) -> Dict[str, Any]:
+    """The cross-round diff: every tracked (section, metric) series,
+    the newest round judged against its trajectory by the watch.py
+    Detector, regressions attributed from the stamps. Numeric verdicts
+    are split by comparability: `regressions` (same provenance
+    fingerprint — gateable) vs `trend_breaks` (same platform, legacy
+    provenance — report-only) vs `drift` (platform changed — config,
+    not code)."""
+    z = z if z is not None else _env_float(PERFBOARD_Z_ENV, 4.0)
+    rel_floor = rel_floor if rel_floor is not None \
+        else _env_float(PERFBOARD_REL_FLOOR_ENV, 0.10)
+    min_points = min_points if min_points is not None \
+        else int(_env_float(PERFBOARD_MIN_POINTS_ENV, 2))
+    bench = rounds.get("bench") or []
+    series = build_series(bench)
+    latest = bench[-1] if bench else None
+    regressions: List[Dict[str, Any]] = []
+    trend_breaks: List[Dict[str, Any]] = []
+    improvements: List[Dict[str, Any]] = []
+    drift: List[Dict[str, Any]] = []
+    judged: Dict[str, Any] = {}
+    for (sec, met), points in sorted(series.items()):
+        key = f"{sec}.{met}"
+        if latest is None or points[-1]["round"] != latest.n:
+            # Series that stopped before the newest round still belong
+            # on the board (the resnet50 trajectory must not vanish
+            # because r05's tail truncated it away) — shown, not judged.
+            judged[key] = {"points": points,
+                           "direction": TRACKED.get(met, +1),
+                           "verdict": None, "gateable": False}
+            continue
+        last = points[-1]
+        direction = TRACKED.get(met, +1)
+        same_plat = [p for p in points[:-1]
+                     if p["platform"] == last["platform"]]
+        comparable = [p for p in same_plat
+                      if last["fingerprint"] is not None
+                      and p["fingerprint"] == last["fingerprint"]]
+        crossed = [p for p in points[:-1]
+                   if p["platform"] and last["platform"]
+                   and p["platform"] != last["platform"]]
+        verdict = judge_series(same_plat + [last], direction, z,
+                               rel_floor, min_points)
+        gateable = len(comparable) >= min_points
+        judged[key] = {
+            "points": points, "direction": direction,
+            "verdict": verdict, "gateable": gateable,
+        }
+        if verdict and verdict["regressed"]:
+            ref = _latest_with_section(bench, sec, latest.n) \
+                if sec != "headline" else None
+            entry = {
+                "section": sec, "metric": met, **verdict,
+                "attribution": attribute(sec, latest, ref)
+                if ref is not None else None,
+            }
+            (regressions if gateable else trend_breaks).append(entry)
+            _METRICS.handles()["regressions"].labels(
+                section=sec).inc()
+        elif verdict and verdict["improved"]:
+            improvements.append({"section": sec, "metric": met,
+                                 **verdict})
+        if crossed and not same_plat:
+            prev = crossed[-1]
+            d = (last["value"] - prev["value"]) / prev["value"] * 100 \
+                if prev["value"] else None
+            ref = _latest_with_section(bench, sec, latest.n) \
+                if sec != "headline" else None
+            drift.append({
+                "section": sec, "metric": met,
+                "round": last["round"], "value": last["value"],
+                "prev_round": prev["round"],
+                "prev_value": prev["value"],
+                "delta_pct": round(d, 1) if d is not None else None,
+                "attribution": attribute(sec, latest, ref)
+                if ref is not None else
+                {"causes": [f"platform changed {prev['platform']} -> "
+                            f"{last['platform']}"]},
+            })
+    return {
+        "perfboard": 1,
+        "params": {"z": z, "rel_floor": rel_floor,
+                   "min_points": min_points},
+        "rounds": {k: [r.summary() for r in v]
+                   for k, v in rounds.items()},
+        "latest": latest.n if latest else None,
+        "series": judged,
+        "regressions": regressions,
+        "trend_breaks": trend_breaks,
+        "improvements": improvements,
+        "config_drift": drift,
+    }
+
+
+# ----------------------------------------------------------------- gate
+
+def gate(analysis: Dict[str, Any], rounds: Dict[str, List[Round]],
+         dirpath: str, numeric: bool) -> Tuple[int, List[str]]:
+    """The trajectory gate. Structural always: every checked-in round
+    must validate, the newest bench round must have loaded OK and carry
+    `meta` provenance (this PR's bench stamps it — its absence on a
+    NEW round means the stamp regressed). Numeric under the
+    HOROVOD_PERF_GATE_NUMERIC convention: any Detector-confirmed
+    regression between provenance-comparable rounds fails, named with
+    its section and dominant moved phase."""
+    msgs: List[str] = []
+    rc = 0
+    for e in validate_dir(dirpath):
+        msgs.append(f"STRUCTURAL {e}")
+        rc = 1
+    bench = rounds.get("bench") or []
+    if not bench:
+        return 2, ["no BENCH_rXX.json rounds found"]
+    latest = bench[-1]
+    if latest.format == "failed":
+        msgs.append(f"STRUCTURAL {latest.label}: newest bench round "
+                    f"FAILED (rc={latest.rc}) — "
+                    f"{'; '.join(latest.notes) or 'no detail'}")
+        rc = 1
+    elif latest.meta is None:
+        msgs.append(f"STRUCTURAL {latest.label}: newest bench round "
+                    "carries no meta provenance block — bench.py "
+                    "stopped stamping it (satellite 2 contract)")
+        rc = 1
+    mcs = rounds.get("multichip") or []
+    if mcs:
+        ml = mcs[-1]
+        if ml.format == "full" and not ml.sections:
+            msgs.append(f"STRUCTURAL MULTICHIP {ml.label}: structured "
+                        "round carries no models")
+            rc = 1
+    if numeric:
+        for reg in analysis["regressions"]:
+            att = reg.get("attribution") or {}
+            dom = att.get("dominant_phase")
+            phase = (f" — dominant moved phase: {dom} "
+                     f"({att.get('dominant_delta_ms'):+.2f} ms)"
+                     if dom else "")
+            why = "; ".join(att.get("causes") or []) \
+                if not dom and att else ""
+            msgs.append(
+                f"NUMERIC r{reg['round']:02d} {reg['section']}."
+                f"{reg['metric']} = {reg['value']:g} regressed "
+                f"{reg['delta_pct']:+.1f}% vs trajectory median "
+                f"{reg['median']:g} (z={reg['z']:.1f}, "
+                f"{reg['n_prior']} comparable prior round(s))"
+                f"{phase}{('; ' + why) if why else ''}")
+            rc = 1
+    return rc, msgs
+
+
+def round_blessable(path: str, dirpath: Optional[str] = None
+                    ) -> List[str]:
+    """Why a round must NOT become a numeric baseline (perf_gate
+    --update --from-round refusal): it failed, it was regressed or
+    anomalous per its own stamps, or perfboard flags it against the
+    trajectory. Empty list = blessable."""
+    reasons: List[str] = []
+    try:
+        rnd = load_bench_round(path)
+    except (OSError, ValueError) as e:
+        return [f"unreadable round: {e}"]
+    if rnd.format == "failed":
+        return [f"round {rnd.label} FAILED (rc={rnd.rc})"]
+    if rnd.format not in ("full", "tail-json"):
+        reasons.append(f"round {rnd.label} is {rnd.format} — a "
+                       "baseline needs the complete document")
+    if rnd.meta is None:
+        reasons.append(f"round {rnd.label} carries no meta provenance")
+    for name, sec in sorted(rnd.sections.items()):
+        n = (sec.get("hvdwatch") or {}).get("anomalies_total") \
+            if isinstance(sec, dict) else None
+        if n:
+            reasons.append(f"{name}: {n} hvdwatch anomaly(ies) during "
+                           "the run — an incident, not a baseline")
+    dirpath = dirpath or os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        rounds = load_rounds(dirpath)
+    except (OSError, ValueError) as e:
+        reasons.append(f"trajectory unreadable: {e}")
+        return reasons
+    if any(r.n == rnd.n for r in rounds["bench"]):
+        analysis = analyze(rounds)
+        if analysis["latest"] == rnd.n:
+            for reg in analysis["regressions"]:
+                reasons.append(
+                    f"perfboard flags {reg['section']}.{reg['metric']} "
+                    f"regressed {reg['delta_pct']:+.1f}% vs the "
+                    "trajectory")
+    return reasons
+
+
+# --------------------------------------------------------------- render
+
+def _spark(values: List[Optional[float]]) -> str:
+    nums = [v for v in values if v is not None]
+    if not nums:
+        return "·" * len(values)
+    lo, hi = min(nums), max(nums)
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append("·")
+        elif span <= 0:
+            out.append(_SPARK_BLOCKS[3])
+        else:
+            idx = int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))
+            out.append(_SPARK_BLOCKS[idx])
+    return "".join(out)
+
+
+def _series_row(points: List[Dict[str, Any]],
+                all_rounds: List[int]) -> Tuple[str, str]:
+    by_round = {p["round"]: p["value"] for p in points}
+    vals = [by_round.get(n) for n in all_rounds]
+    spark = _spark(vals)
+    lastp = points[-1]
+    first = points[0]
+    if first["round"] == lastp["round"]:
+        return spark, f"r{lastp['round']:02d} {lastp['value']:g} (new)"
+    return spark, (f"r{first['round']:02d} {first['value']:g} -> "
+                   f"r{lastp['round']:02d} {lastp['value']:g}")
+
+
+def render_report(analysis: Dict[str, Any]) -> str:
+    out: List[str] = []
+    add = out.append
+    bench = analysis["rounds"].get("bench", [])
+    mc = analysis["rounds"].get("multichip", [])
+    add("perfboard: cross-round performance trajectory "
+        f"({len(bench)} bench round(s), {len(mc)} multichip round(s); "
+        "docs/benchmarks.md)")
+    add("")
+    add("[rounds]")
+    for r in bench + mc:
+        kind = "BENCH" if r["kind"] == "bench" else "MULTICHIP"
+        plat = r["platform"] or "?"
+        meta = "meta" if r["meta"] else "no-meta"
+        line = (f"  {kind} r{r['n']:02d}: {r['format']:9s} "
+                f"platform={plat:8s} {meta}")
+        if r["notes"]:
+            line += f" — {r['notes'][0]}"
+        add(line)
+    add("")
+    rounds_axis = sorted({p["round"]
+                          for s in analysis["series"].values()
+                          for p in s["points"]})
+    by_section: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+    for key, s in analysis["series"].items():
+        sec, _, met = key.partition(".")
+        by_section.setdefault(sec, []).append((met, s))
+    for sec in sorted(by_section):
+        add(f"[{sec}]")
+        for met, s in sorted(by_section[sec]):
+            spark, span = _series_row(s["points"], rounds_axis)
+            v = s["verdict"]
+            tag = ""
+            if v and v["regressed"]:
+                tag = " REGRESSED" if s["gateable"] else " TREND-BREAK"
+            elif v and v["improved"]:
+                tag = " improved"
+            add(f"  {met:28s} {spark}  {span}{tag}")
+        add("")
+    for title, key_ in (("regressions (provenance-comparable — these "
+                         "gate)", "regressions"),
+                        ("trend breaks (legacy provenance — "
+                         "report-only)", "trend_breaks"),
+                        ("improvements", "improvements")):
+        entries = analysis[key_]
+        if not entries:
+            continue
+        add(f"[{title}]")
+        for e in entries:
+            add(f"  r{e['round']:02d} {e['section']}.{e['metric']} = "
+                f"{e['value']:g} ({e['delta_pct']:+.1f}% vs median "
+                f"{e['median']:g}, z={e['z']:.1f})")
+            att = e.get("attribution")
+            for cause in (att or {}).get("causes", []):
+                add(f"    because: {cause}")
+        add("")
+    if analysis["config_drift"]:
+        add("[config drift] (platform changed — not code regressions; "
+            "meta provenance separates these)")
+        for d in analysis["config_drift"]:
+            delta = (f" ({d['delta_pct']:+.1f}%)"
+                     if d.get("delta_pct") is not None else "")
+            add(f"  {d['section']}.{d['metric']}: "
+                f"r{d['prev_round']:02d} {d['prev_value']:g} -> "
+                f"r{d['round']:02d} {d['value']:g}{delta}")
+            for cause in (d.get("attribution") or {}).get("causes", []):
+                add(f"    because: {cause}")
+        add("")
+    return "\n".join(out)
+
+
+def render_html(analysis: Dict[str, Any]) -> str:
+    """A self-contained sparkline dashboard (inline SVG, zero external
+    assets — openable from a CI artifact store)."""
+    def svg(points: List[Dict[str, Any]], axis: List[int],
+            regressed: bool) -> str:
+        by_round = {p["round"]: p["value"] for p in points}
+        vals = [by_round.get(n) for n in axis]
+        nums = [v for v in vals if v is not None]
+        if not nums:
+            return ""
+        lo, hi = min(nums), max(nums)
+        span = (hi - lo) or 1.0
+        w, h, pad = 220, 36, 3
+        step = (w - 2 * pad) / max(len(axis) - 1, 1)
+        pts = []
+        for i, v in enumerate(vals):
+            if v is None:
+                continue
+            x = pad + i * step
+            y = h - pad - (v - lo) / span * (h - 2 * pad)
+            pts.append(f"{x:.1f},{y:.1f}")
+        color = "#c0392b" if regressed else "#2c7fb8"
+        circles = ""
+        if pts:
+            cx, cy = pts[-1].split(",")
+            circles = (f'<circle cx="{cx}" cy="{cy}" r="2.5" '
+                       f'fill="{color}"/>')
+        return (f'<svg width="{w}" height="{h}">'
+                f'<polyline points="{" ".join(pts)}" fill="none" '
+                f'stroke="{color}" stroke-width="1.5"/>{circles}</svg>')
+
+    axis = sorted({p["round"] for s in analysis["series"].values()
+                   for p in s["points"]})
+    rows = []
+    for key in sorted(analysis["series"]):
+        s = analysis["series"][key]
+        v = s["verdict"]
+        regressed = bool(v and v["regressed"])
+        tag = ""
+        if regressed:
+            tag = "REGRESSED" if s["gateable"] else "trend break"
+        elif v and v["improved"]:
+            tag = "improved"
+        lastp = s["points"][-1]
+        rows.append(
+            "<tr><td>{}</td><td>{}</td><td>{:g}</td>"
+            "<td class='{}'>{}</td></tr>".format(
+                _html.escape(key), svg(s["points"], axis, regressed),
+                lastp["value"], "bad" if regressed else "ok",
+                _html.escape(tag)))
+    regs = []
+    for e in analysis["regressions"] + analysis["trend_breaks"]:
+        causes = "; ".join((e.get("attribution") or {})
+                           .get("causes", []))
+        regs.append("<li><b>{}.{}</b> r{:02d}: {:+.1f}% vs median "
+                    "— {}</li>".format(
+                        _html.escape(e["section"]),
+                        _html.escape(e["metric"]), e["round"],
+                        e["delta_pct"], _html.escape(causes)))
+    return ("<!doctype html><meta charset='utf-8'>"
+            "<title>perfboard</title><style>"
+            "body{font:13px system-ui,sans-serif;margin:2em}"
+            "table{border-collapse:collapse}"
+            "td{padding:2px 10px;border-bottom:1px solid #eee}"
+            ".bad{color:#c0392b;font-weight:bold}.ok{color:#2c7fb8}"
+            "</style>"
+            f"<h1>perfboard — rounds {axis[0] if axis else '?'}"
+            f"–{axis[-1] if axis else '?'}</h1>"
+            + ("<h2>flagged moves</h2><ul>" + "".join(regs) + "</ul>"
+               if regs else "<p>no flagged moves</p>")
+            + "<h2>series</h2><table>" + "".join(rows) + "</table>")
+
+
+def doctor_summary(dirpath: str) -> Optional[Dict[str, Any]]:
+    """The compact [trajectory] block hvddoctor cross-links: latest
+    round, its format/provenance, and any flagged moves — enough to
+    send the reader to the full perfboard report."""
+    try:
+        rounds = load_rounds(dirpath)
+    except (OSError, ValueError):
+        return None
+    if not rounds["bench"]:
+        return None
+    analysis = analyze(rounds)
+    latest = rounds["bench"][-1]
+    return {
+        "dir": dirpath,
+        "rounds": len(rounds["bench"]),
+        "latest": latest.summary(),
+        "regressions": [
+            {"section": e["section"], "metric": e["metric"],
+             "delta_pct": e["delta_pct"],
+             "dominant_phase": (e.get("attribution") or {}
+                                ).get("dominant_phase")}
+            for e in analysis["regressions"]
+            + analysis["trend_breaks"]],
+        "config_drift": len(analysis["config_drift"]),
+    }
+
+
+# -------------------------------------------------------------- metrics
+
+class _Metrics:
+    """Pre-registered perfboard instruments (the PR 2 convention:
+    create every family up front so an idle scrape shows zeros, not
+    missing series). Cached per registry identity so
+    `reset_for_tests()` refreshes the handles automatically."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._reg = None    # guarded-by: _lock
+        self._mx = None     # guarded-by: _lock
+
+    def handles(self) -> Dict[str, Any]:
+        from horovod_tpu.observability import metrics as m
+        reg = m.registry()
+        with self._lock:
+            if self._mx is None or self._reg is not reg:
+                self._reg = reg
+                self._mx = {
+                    "rounds_loaded": reg.counter(
+                        "hvdperfboard_rounds_loaded_total",
+                        "Round artifacts (BENCH/MULTICHIP) parsed by "
+                        "the perfboard loader"),
+                    "regressions": reg.counter(
+                        "hvdperfboard_regressions_total",
+                        "Detector-confirmed trajectory regressions "
+                        "by bench section",
+                        labelnames=("section",)),
+                }
+            return self._mx
+
+
+_METRICS = _Metrics()
+
+
+def preregister_metrics() -> None:
+    """Create the hvdperfboard_* families up front. Idempotent."""
+    _METRICS.handles()
+
+
+# ------------------------------------------------------------------ cli
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.observability.perfboard",
+        description="Cross-round performance trajectory, regression "
+                    "attribution and gate over the checked-in "
+                    "BENCH_rXX.json / MULTICHIP_rXX.json rounds "
+                    "(docs/benchmarks.md).")
+    p.add_argument("--dir",
+                   default=os.environ.get(PERFBOARD_DIR_ENV, "."),
+                   help="directory holding the round artifacts "
+                        "(default: $HOROVOD_PERFBOARD_DIR or .)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable analysis")
+    p.add_argument("--html", default="", metavar="PATH",
+                   help="write the self-contained sparkline dashboard")
+    p.add_argument("--gate", action="store_true",
+                   help="CI mode: structural checks always, numeric "
+                        "trajectory checks under --numeric / "
+                        "HOROVOD_PERF_GATE_NUMERIC=1; exit 1 on "
+                        "failure")
+    p.add_argument("--numeric", action="store_true",
+                   help="arm the numeric trajectory checks "
+                        "(HOROVOD_PERF_GATE_NUMERIC=1 equivalent)")
+    p.add_argument("--validate", action="store_true",
+                   help="only run the round schema validator")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    preregister_metrics()
+    if args.validate:
+        errs = validate_dir(args.dir)
+        for e in errs:
+            print(f"perfboard: INVALID {e}", file=sys.stderr)
+        print(f"perfboard: {len(errs) or 'no'} validation problem(s)",
+              file=sys.stderr)
+        return 1 if errs else 0
+    try:
+        rounds = load_rounds(args.dir)
+    except (OSError, ValueError) as e:
+        print(f"perfboard: cannot load rounds from {args.dir}: {e}",
+              file=sys.stderr)
+        return 2
+    if not rounds["bench"] and not rounds["multichip"]:
+        print(f"perfboard: no round artifacts in {args.dir} "
+              f"(expected {BENCH_GLOB} / {MULTICHIP_GLOB})",
+              file=sys.stderr)
+        return 2
+    analysis = analyze(rounds)
+    if args.html:
+        tmp = f"{args.html}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(render_html(analysis))
+        os.replace(tmp, args.html)
+        print(f"perfboard: wrote dashboard to {args.html}",
+              file=sys.stderr)
+    if args.gate:
+        from horovod_tpu.common.config import _env_bool
+        numeric = args.numeric \
+            or _env_bool("HOROVOD_PERF_GATE_NUMERIC")
+        rc, msgs = gate(analysis, rounds, args.dir, numeric)
+        for msg in msgs:
+            print(f"perfboard: FAIL {msg}", file=sys.stderr)
+        mode = "structural+numeric" if numeric else "structural-only"
+        print(f"perfboard: gate "
+              f"{'FAILED (%d)' % len(msgs) if rc else 'OK'} ({mode}, "
+              f"latest round r{analysis['latest']:02d})",
+              file=sys.stderr)
+        if args.json:
+            json.dump({"gate_rc": rc, "messages": msgs,
+                       **analysis}, sys.stdout, indent=2, default=str)
+            print()
+        return rc
+    if args.json:
+        json.dump(analysis, sys.stdout, indent=2, default=str)
+        print()
+    else:
+        print(render_report(analysis))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

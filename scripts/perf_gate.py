@@ -455,6 +455,29 @@ def update_errors(current: dict) -> list:
     return errs
 
 
+def round_profiles(path: str):
+    """(current-profiles doc, refusal reasons) from a checked-in
+    BENCH_rXX.json trajectory round — the `--update --from-round`
+    source. The baseline regenerates from a *blessed* round the whole
+    team can see in the trajectory, not from whatever the last local
+    run produced; perfboard refuses rounds it flags as regressed,
+    anomalous (hvdwatch fired during the run), failed, or truncated."""
+    from horovod_tpu.observability.perfboard import (load_bench_round,
+                                                     round_blessable)
+    reasons = round_blessable(path)
+    if reasons:
+        return None, reasons
+    rnd = load_bench_round(path)
+    sections = {}
+    for name, sec in sorted(rnd.sections.items()):
+        prof = sec.get("perfscope") if isinstance(sec, dict) else None
+        if isinstance(prof, dict) and prof.get("phases_s"):
+            sections[name] = prof
+    if not sections:
+        return None, [f"round {rnd.label} carries no perfscope stamps"]
+    return {"platform": rnd.platform(), "sections": sections}, []
+
+
 def baseline_from(current: dict) -> dict:
     """Derive a fresh baseline doc from a current-profiles doc
     (numeric gating stays opt-in; reference numbers are informational
@@ -504,12 +527,30 @@ def main(argv=None) -> int:
     p.add_argument("--update", action="store_true",
                    help="write --baseline from the current profiles "
                         "instead of gating")
+    p.add_argument("--from-round", default="", metavar="BENCH_rXX.json",
+                   help="with --update: regenerate the baseline from a "
+                        "blessed trajectory round's perfscope stamps; "
+                        "refuses rounds perfboard flags as regressed "
+                        "or anomalous")
     args = p.parse_args(argv)
     from horovod_tpu.common.config import _env_bool
     numeric = args.numeric or _env_bool("HOROVOD_PERF_GATE_NUMERIC")
 
     temp_out = ""
-    if args.emit or args.run:
+    if args.from_round:
+        if not args.update:
+            print("perf_gate: --from-round only makes sense with "
+                  "--update", file=sys.stderr)
+            return 2
+        current, reasons = round_profiles(args.from_round)
+        if current is None:
+            for r in reasons:
+                print(f"perf_gate: FAIL {r}", file=sys.stderr)
+            print(f"perf_gate: refusing to bless {args.from_round} as "
+                  f"the numeric baseline ({len(reasons)} reason(s)); "
+                  "land a clean round first", file=sys.stderr)
+            return 1
+    elif args.emit or args.run:
         current = emit_profiles()
         out = args.emit
         if not out:
