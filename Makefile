@@ -83,9 +83,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline sched-lint sched-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke
 
-test: lint hlo-lint shard-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke entry
+test: lint hlo-lint shard-lint sched-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -191,7 +191,8 @@ fusion-smoke:
 # scripts/ and the training-shaped test workers issue collectives too —
 # they carry the same stall risks the HVD0xx rules exist to catch.
 LINT_PATHS = horovod_tpu/ examples/ scripts/ \
-    tests/mp_worker.py tests/elastic_worker.py
+    tests/mp_worker.py tests/elastic_worker.py \
+    tests/serve_replica.py tests/ckpt_writer.py
 
 lint:
 	$(PYTHON) -m horovod_tpu.analysis $(LINT_PATHS) \
@@ -239,6 +240,34 @@ shard-lint:
 	    HOROVOD_HLO_LINT_HBM_BUDGET=1G \
 	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_runtime \
 	    --baseline scripts/hvdshard_baseline.json
+
+# hvdsched static collective-schedule lint (docs/static_analysis.md):
+# the HVD4xx fixture suite pins every rule both ways (the misordered
+# two-program pair trips HVD401, the broken permute ring HVD402, the
+# hierarchical twin HVD404 under a declared slice boundary) plus the
+# cost-model unit suite, then the canonical step programs' post-SPMD
+# schedules are gated against the checked-in EMPTY baseline. --select
+# keeps this gate on the HVD4xx family; the same programs' HVD2xx/3xx
+# coverage lives in `make shard-lint`.
+sched-lint:
+	$(PYTEST) tests/test_hvdsched.py tests/test_sched_cost.py
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_sharded \
+	    --select HVD401,HVD402,HVD403,HVD404,HVD405 \
+	    --baseline scripts/hvdsched_baseline.json
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_runtime \
+	    --select HVD401,HVD402,HVD403,HVD404,HVD405 \
+	    --baseline scripts/hvdsched_baseline.json
+
+sched-lint-baseline:
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_sharded \
+	    --select HVD401,HVD402,HVD403,HVD404,HVD405 \
+	    --format json > scripts/hvdsched_baseline.json || true
 
 shard-lint-baseline:
 	env JAX_PLATFORMS=cpu \

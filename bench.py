@@ -1359,6 +1359,25 @@ def _gspmd_cpu_mesh_child():
                 text, list(zip(AXIS_ORDER, spec.sizes())))
         except Exception as e:
             result["comms_by_axis_error"] = _err_str(e)
+        # The analytic hvdsched cost model, off the SAME compiled text
+        # the measured comms_by_axis reads (docs/perf.md). The ratio
+        # compares predicted wire bytes (payload x ring wire factor,
+        # factors all in [0.5, 2.0)) against the measured payload
+        # accounting — tracked across rounds by perfboard and
+        # structurally required by scripts/perf_gate.py.
+        try:
+            from horovod_tpu.analysis import schedule as sched_mod
+            cm = sched_mod.comms_model(
+                text, list(zip(AXIS_ORDER, spec.sizes())))
+            measured = sum(
+                int(v.get("bytes_per_step", 0))
+                for v in result.get("comms_by_axis", {}).values())
+            if measured > 0:
+                cm["predicted_vs_measured"] = round(
+                    cm["predicted_bytes_per_step"] / measured, 4)
+            result["comms_model"] = cm
+        except Exception as e:
+            result["comms_model_error"] = _err_str(e)
         result["memory"] = _memory_stamp(compiled)
         try:
             result["shard_lint"] = {

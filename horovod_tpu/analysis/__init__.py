@@ -40,6 +40,20 @@ package moves that detection LEFT of the job launch:
   all-reduce-that-should-be-reduce-scatter — the static gate in front
   of the GSPMD backend (ROADMAP item 3).
 
+* ``schedule`` / ``sched_rules`` (**hvdsched**, ``--sched`` /
+  ``--hlo-step lm_sharded`` / ``make sched-lint``) reconstruct the
+  per-device *collective schedule* from the same lowered forms —
+  every collective with its replica groups (explicit, V2 iota,
+  permute source-target pairs), channel id and payload bytes, in
+  scheduled order — and verify cross-device matching (HVD4xx):
+  group members reaching different collectives or positions (the
+  static deadlock the runtime verifier only catches live), permute
+  chains that are not unions of disjoint cycles (the 1F1B hazard),
+  inconsistently-ordered overlapping subset collectives, flat
+  cross-slice all-reduces where ICI/DCN staging is available, and
+  predicted exposed comms from the analytic per-axis cost model that
+  bench.py stamps beside the measured ``comms_by_axis``.
+
 * ``verifier`` is the runtime companion (``HOROVOD_CHECK_COLLECTIVES=1``):
   each rank hashes its rolling sequence of
   ``(op, name, shape, dtype, process_set)`` tuples at the dispatch choke

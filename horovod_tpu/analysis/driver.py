@@ -326,6 +326,16 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                              "the HVD3xx sharding/memory rules; "
                              "combine with --hlo to run both families "
                              "over the same dumps")
+    parser.add_argument("--sched", action="store_true",
+                        help="hvdsched mode: treat paths as lowered "
+                             "StableHLO/post-SPMD HLO dumps, "
+                             "reconstruct the per-device collective "
+                             "schedule and run the HVD4xx cross-device "
+                             "matching + comms cost-model rules; ALL "
+                             "paths are linted as one set so the "
+                             "cross-program rules (HVD401/HVD403) see "
+                             "every pairing; composes with --hlo and "
+                             "--shard over the same dumps")
     parser.add_argument("--hlo-step", default=None, metavar="PROGRAM",
                         choices=("lm", "resnet_block", "lm_sharded",
                                  "lm_runtime"),
@@ -362,7 +372,7 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.list_rules:
         from horovod_tpu.analysis import env_rule as env_mod
-        from horovod_tpu.analysis import hlo_rules, shard_rules
+        from horovod_tpu.analysis import hlo_rules, sched_rules, shard_rules
         reg = dict(registry())
         reg[env_mod.RULE_ID] = (env_mod.DESCRIPTION, None)
         reg[HVD000] = ("suppression comment lacks a rationale", None)
@@ -370,11 +380,14 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
             reg[rule_id] = (f"[--hlo] {desc}", None)
         for rule_id, (desc, _check) in shard_rules.RULES.items():
             reg[rule_id] = (f"[--shard] {desc}", None)
+        for rule_id, (desc, _check) in sched_rules.RULES.items():
+            reg[rule_id] = (f"[--sched] {desc}", None)
         for rule_id in sorted(reg):
             print(f"{rule_id}  {reg[rule_id][0]}")
         return 0
 
-    hlo_mode = args.hlo or args.shard or args.hlo_step is not None
+    hlo_mode = (args.hlo or args.shard or args.sched
+                or args.hlo_step is not None)
     if not args.paths and not args.hlo_step:
         parser.error("no paths given (try: horovod_tpu/ examples/)")
 
@@ -388,19 +401,25 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
     ignore = [s.strip() for s in args.ignore.split(",") if s.strip()]
     if hlo_mode:
         from horovod_tpu.analysis import hlo as hlo_mod
+        from horovod_tpu.analysis import schedule as sched_mod
         from horovod_tpu.analysis import shard as shard_mod
         findings = []
         try:
-            # File mode: --hlo runs HVD2xx, --shard runs HVD3xx, both
-            # flags run both families over the same dumps. A bare
-            # --hlo-step adds no file findings (paths empty).
-            if args.hlo or (args.paths and not args.shard):
+            # File mode: --hlo runs HVD2xx, --shard runs HVD3xx,
+            # --sched runs HVD4xx; the flags compose over the same
+            # dumps. A bare --hlo-step adds no file findings (paths
+            # empty).
+            if args.hlo or (args.paths and not args.shard
+                            and not args.sched):
                 findings.extend(hlo_mod.lint_files(
                     args.paths, select=select, ignore=ignore))
             if args.shard:
                 findings.extend(shard_mod.lint_files(
                     args.paths, select=select, ignore=ignore))
-            if args.hlo and args.shard:
+            if args.sched:
+                findings.extend(sched_mod.lint_files(
+                    args.paths, select=select, ignore=ignore))
+            if (args.hlo + args.shard + args.sched) > 1:
                 findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
             if args.hlo_step in ("lm_sharded", "lm_runtime"):
                 # The 2-D-mesh gates lint BOTH textual forms: the
@@ -428,6 +447,14 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                     findings.extend(shard_mod.lint_text(
                         texts[fmt], path=base[:-1] + suffix + ">",
                         select=select, ignore=ignore))
+                # The HVD4xx schedule rules read the post-SPMD form
+                # (scheduled order, per-device groups). Safe on the
+                # default programs: single-program SPMD is internally
+                # consistent (HVD401/403 vacuous) and HVD404/405 are
+                # unarmed without their env knobs.
+                findings.extend(sched_mod.lint_text(
+                    texts["hlo"], path=base[:-1] + ":spmd>",
+                    select=select, ignore=ignore))
                 findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
             elif args.hlo_step is not None:
                 # Lowering failures must fail the gate loudly — a CI
@@ -449,7 +476,8 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
             # the driver's error convention is one line + exit 2
             # (lowering failures, unreadable baselines), never a
             # traceback that exits 1 as if findings were found.
-            name = ("hvdshard" if args.shard or args.hlo_step
+            name = ("hvdsched" if args.sched and not args.shard
+                    else "hvdshard" if args.shard or args.hlo_step
                     in ("lm_sharded", "lm_runtime") else "hvdhlo")
             print(f"{name}: {e}", file=sys.stderr)
             return 2
@@ -457,9 +485,17 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
         findings = lint_paths(args.paths, select=select, ignore=ignore,
                               root=root, env_rule=not args.no_env)
     matched = 0
+    # A step-mode run narrowed to the HVD4xx family (make sched-lint)
+    # reports as hvdsched too, so the gate's clean line names the tool
+    # that actually judged the program.
+    sel_all_sched = bool(select) and all(
+        re.fullmatch(r"HVD4\d\d", r.strip().upper()) for r in select)
+    sched_only = ((args.sched or sel_all_sched)
+                  and not (args.hlo or args.shard))
     shard_mode = args.shard or args.hlo_step in ("lm_sharded",
                                                  "lm_runtime")
-    name = ("hvdshard" if shard_mode
+    name = ("hvdsched" if sched_only
+            else "hvdshard" if shard_mode
             else "hvdhlo" if hlo_mode else "hvdlint")
     if args.baseline is not None:
         try:
@@ -472,14 +508,20 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
         findings, matched = apply_baseline(findings, baseline)
     if hlo_mode:
         from horovod_tpu.analysis import hlo as hlo_mod
+        from horovod_tpu.analysis import schedule as sched_mod
         from horovod_tpu.analysis import shard as shard_mod
         # Each family owns its metric: HVD3xx ->
-        # hvdshard_findings_total, the rest -> hvdhlo_findings_total.
+        # hvdshard_findings_total, HVD4xx -> hvdsched_findings_total,
+        # the rest -> hvdhlo_findings_total.
         shard_f = [f for f in findings
                    if re.fullmatch(r"HVD3\d\d", f.rule_id)]
+        sched_f = [f for f in findings
+                   if re.fullmatch(r"HVD4\d\d", f.rule_id)]
         hlo_mod.record_metrics([f for f in findings
-                                if f not in shard_f])
+                                if f not in shard_f
+                                and f not in sched_f])
         shard_mod.record_metrics(shard_f)
+        sched_mod.record_metrics(sched_f)
     else:
         _record_metrics(findings)
     if args.fmt == "json":

@@ -327,8 +327,10 @@ def _parse_stablehlo(text: str, path: str) -> HloProgram:
 
 # HLO text: `  %all-reduce.2 = f32[256,256]{1,0} all-reduce(f32[...] %x),
 # channel_id=1, ...` inside `ENTRY %main ... {` ... `}` computations.
+# XLA prints the `%` name sigil in some modes and omits it in others;
+# both spellings are accepted.
 _HLO_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?(%[\w.-]+)\s*=\s*(.+?)\s([a-z][a-z0-9-]*)\((.*)$")
+    r"^\s*(?:ROOT\s+)?(%?[\w.-]+)\s*=\s*(.+?)\s([a-z][a-z0-9-]*)\((.*)$")
 _HLO_COMP_RE = re.compile(
     r"^\s*(ENTRY\s+)?(%?[\w.-]+)\s.*->\s.*\{\s*$")
 _HLO_ALIAS_RE = re.compile(

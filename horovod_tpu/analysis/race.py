@@ -76,6 +76,7 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.serve.pool",
     "horovod_tpu.ckpt.async_ckpt",
     "horovod_tpu.observability.perfboard",
+    "horovod_tpu.analysis.schedule",
 )
 
 _LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))

@@ -411,6 +411,28 @@ def _axis_partitions(axis_sizes: Sequence[Tuple[str, int]]
     return out
 
 
+def group_axis_label(groups: Optional[List[List[int]]],
+                     partitions: Dict[frozenset, str]) -> Optional[str]:
+    """Mesh-axis label one collective's parsed replica groups span — the
+    ONE group-classification helper shared by :func:`comms_by_axis` and
+    the hvdsched cost model (analysis/schedule.comms_model), so the two
+    attributions can never disagree on what a group means.
+
+    ``None`` means every group is a *degenerate single-device set*
+    (size-1 groups from a size-1 mesh axis): no wire traffic moves, the
+    caller must skip the op — distinct from ``replica_groups={}``,
+    which parses to one full-mesh group upstream. Unparseable groups
+    (``groups is None``) and real groups matching no axis partition
+    land under ``"other"``.
+    """
+    if groups is None:
+        return "other"
+    norm = frozenset(frozenset(g) for g in groups if len(g) > 1)
+    if not norm:
+        return None  # degenerate single-device groups: no wire
+    return partitions.get(norm, "other")
+
+
 def comms_by_axis(text: str, axis_sizes: Sequence[Tuple[str, int]],
                   path: str = "<compiled>") -> Dict[str, Dict[str, object]]:
     """Attribute every collective's payload bytes in a post-SPMD module
@@ -436,13 +458,9 @@ def comms_by_axis(text: str, axis_sizes: Sequence[Tuple[str, int]],
         if op.opcode not in _COMMS_OPCODES:
             continue
         groups = _parse_replica_groups(op.attrs, ndev)
-        if groups is None:
-            label = "other"
-        else:
-            norm = frozenset(frozenset(g) for g in groups if len(g) > 1)
-            if not norm:
-                continue  # degenerate single-device groups: no wire
-            label = partitions.get(norm, "other")
+        label = group_axis_label(groups, partitions)
+        if label is None:
+            continue  # degenerate single-device groups: no wire
         nb = hlo_rules._collective_payload(op)
         if nb is None:
             nb = _result_bytes(op)

@@ -118,6 +118,11 @@ TRACKED: Dict[str, int] = {
     "p50_ms": +1,
     "p99_ms": +1,
     "scaling.efficiency_vs_dp": -1,
+    # Direction is a judgment call for a ratio whose ideal is 1.0; +1
+    # (higher is worse) catches the common regression — predicted wire
+    # bytes creeping above measurement when the cost model and the
+    # comms_by_axis classifier drift apart.
+    "comms_model.predicted_vs_measured": +1,
 }
 
 #: The conv sections — the ROADMAP item 2 MFU campaign rides these.

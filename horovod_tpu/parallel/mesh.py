@@ -224,3 +224,22 @@ def build_mesh(spec: MeshSpec,
 
 def mesh_axis_sizes(mesh: Mesh) -> dict:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def slice_groups(n_devices: int, slices: int) -> List[List[int]]:
+    """Partition of the flat rank space into `slices` equal contiguous
+    slices — the declared ICI domain boundary of a hierarchical mesh
+    (``HOROVOD_MESH_SLICES``; docs/parallelism.md). Ranks inside one
+    slice talk over ICI; crossing a boundary rides the slow DCN tier.
+    Contiguity in the flat C-order space keeps slices aligned with the
+    outermost (dp) axis, matching how multi-slice deployments lay pods
+    out. The hvdsched staging lint (HVD404, analysis/sched_rules.py)
+    and the ICI/DCN cost model consume the same ``rank // per_slice``
+    arithmetic on the analysis side.
+    """
+    if slices <= 0 or n_devices % slices:
+        raise HorovodTpuError(
+            f"HOROVOD_MESH_SLICES={slices} does not divide the "
+            f"{n_devices}-device world into equal slices")
+    per = n_devices // slices
+    return [list(range(s * per, (s + 1) * per)) for s in range(slices)]

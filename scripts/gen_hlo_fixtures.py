@@ -337,6 +337,154 @@ def hvd305_psum_scatter():
     return _reduce_keep_shard_text(scatter=True)
 
 
+# --------------------------------------------------- HVD4xx (hvdsched)
+
+def _hvd401_pair_text(big_first):
+    """One half of the deliberately misordered MPMD-style pair: the
+    same two gradient all-reduces (4 MB and 16 KB over all 8 devices),
+    issued in OPPOSITE order in the two programs. Scalar data
+    dependencies pin the order through compilation, so the divergence
+    survives into the post-SPMD schedule. Each program alone is clean;
+    linted together they are the HVD401 static deadlock."""
+    mesh, n = _mesh()
+
+    def local(a, b):
+        if big_first:
+            ga = lax.psum(a, "hvd")
+            gb = lax.psum(b + ga[0, 0] * 0.0, "hvd")
+        else:
+            gb = lax.psum(b, "hvd")
+            ga = lax.psum(a + gb[0, 0] * 0.0, "hvd")
+        return ga, gb
+
+    f = jax.shard_map(local, mesh=mesh, in_specs=(P(), P()),
+                      out_specs=(P(), P()), check_vma=False)
+    a = jnp.ones((1024, 1024), jnp.float32)  # 4 MB
+    b = jnp.ones((64, 64), jnp.float32)      # 16 KB
+    return jax.jit(f).lower(a, b).compile().as_text()
+
+
+def hvd401_pair_a():
+    return _hvd401_pair_text(big_first=True)
+
+
+def hvd401_pair_b():
+    return _hvd401_pair_text(big_first=False)
+
+
+def hvd402_pp_1f1b():
+    """Two-stage-style 1F1B skeleton on the pp ring: the forward
+    activation shift and the reverse gradient shift are both FULL
+    rings (every rank sends and receives) — the clean HVD402 twin."""
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(n), ("pp",))
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [((i + 1) % n, i) for i in range(n)]
+
+    def stage(x):
+        act = lax.ppermute(jnp.tanh(x), "pp", fwd)
+        grad = lax.ppermute(act * 2.0, "pp", bwd)
+        return grad
+
+    f = jax.shard_map(stage, mesh=mesh, in_specs=P("pp"),
+                      out_specs=P("pp"), check_vma=False)
+    return jax.jit(f).lower(
+        jnp.ones((8 * n, 128), jnp.float32)).as_text()
+
+
+def _sp_ring_text(broken):
+    """Ring-attention-style sp rotation: each step shifts the block
+    one hop around the ring and accumulates. The clean twin closes the
+    ring with the (n-1, 0) wraparound; the broken twin drops it — rank
+    0 only sends and rank n-1 only receives, the HVD402 open chain."""
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(n), ("sp",))
+    pairs = [(i, (i + 1) % n) for i in range(n)]
+    if broken:
+        pairs = pairs[:-1]  # no wraparound: an open chain
+
+    def ring(x):
+        blk = x
+        acc = x
+        for _ in range(2):
+            blk = lax.ppermute(blk, "sp", pairs)
+            acc = acc + blk
+        return acc
+
+    f = jax.shard_map(ring, mesh=mesh, in_specs=P("sp"),
+                      out_specs=P("sp"), check_vma=False)
+    return jax.jit(f).lower(
+        jnp.ones((8 * n, 256), jnp.float32)).as_text()
+
+
+def hvd402_sp_ring():
+    return _sp_ring_text(broken=False)
+
+
+def hvd402_sp_broken_ring():
+    return _sp_ring_text(broken=True)
+
+
+def hvd404_flat_allreduce():
+    """A 2.25 MB gradient all-reduce over all 8 devices as ONE flat
+    collective. Clean on a flat mesh; under HOROVOD_MESH_SLICES=2 the
+    group spans the slice boundary with 4 members per slice, so the
+    staged form is available and HVD404 fires."""
+    mesh, n = _mesh()
+
+    def local(g):
+        return lax.psum(g, "hvd")
+
+    f = jax.shard_map(local, mesh=mesh, in_specs=P(),
+                      out_specs=P(), check_vma=False)
+    return jax.jit(f).lower(
+        jnp.ones((768, 768), jnp.float32)).as_text()
+
+
+def hvd404_staged_allreduce():
+    """The staged twin on the 2 x 4 (outer x inner) mesh: intra-slice
+    reduce-scatter, inter-slice all-reduce over one-rank-per-slice
+    groups, intra-slice all-gather. Under HOROVOD_MESH_SLICES=2 every
+    cross-slice group has exactly one member per slice — the shape
+    HVD404 asks for — so the twin lints clean."""
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("outer", "inner"))
+
+    def local(g):
+        piece = lax.psum_scatter(g, "inner", scatter_dimension=0,
+                                 tiled=True)
+        piece = lax.psum(piece, "outer")
+        return lax.all_gather(piece, "inner", axis=0, tiled=True)
+
+    f = jax.shard_map(local, mesh=mesh, in_specs=P(),
+                      out_specs=P(), check_vma=False)
+    return jax.jit(f).lower(
+        jnp.ones((768, 768), jnp.float32)).as_text()
+
+
+def comms_degenerate_group():
+    """Hand-authored post-SPMD text (deterministic, no lowering): an
+    all-reduce whose replica groups are ALL size-1 — the degenerate
+    single-device-group shape a size-1 mesh axis produces. No wire
+    traffic moves, so comms_by_axis / comms_model must skip it
+    (shard.group_axis_label returns None), not file it under an axis
+    or 'other'."""
+    return """HloModule degenerate_single_device_groups, num_partitions=8
+
+add {
+  x = f32[] parameter(0)
+  y = f32[] parameter(1)
+  ROOT s = f32[] add(x, y)
+}
+
+ENTRY main {
+  p0 = f32[256,256]{1,0} parameter(0)
+  ar = f32[256,256]{1,0} all-reduce(p0), replica_groups={{0},{1},{2},{3},{4},{5},{6},{7}}, use_global_device_ids=true, channel_id=1, to_apply=add
+  ROOT out = f32[256,256]{1,0} add(ar, ar)
+}
+"""
+
+
 FIXTURES = {
     "hvd201_giant_allreduce": hvd201_giant_allreduce,
     "hvd201_bucketed": hvd201_bucketed,
@@ -358,6 +506,14 @@ FIXTURES = {
     "hvd304_used_axes": hvd304_used_axes,
     "hvd305_allreduce_slice": hvd305_allreduce_slice,
     "hvd305_psum_scatter": hvd305_psum_scatter,
+    "hvd401_pair_a": hvd401_pair_a,
+    "hvd401_pair_b": hvd401_pair_b,
+    "hvd402_pp_1f1b": hvd402_pp_1f1b,
+    "hvd402_sp_ring": hvd402_sp_ring,
+    "hvd402_sp_broken_ring": hvd402_sp_broken_ring,
+    "hvd404_flat_allreduce": hvd404_flat_allreduce,
+    "hvd404_staged_allreduce": hvd404_staged_allreduce,
+    "comms_degenerate_group": comms_degenerate_group,
 }
 
 

@@ -347,6 +347,36 @@ def _check_sharded_section(name: str, val: dict) -> list:
                                    (int, float)):
                 errs.append(f"{name}: comms_by_axis[{label!r}] carries "
                             "no bytes_per_step")
+    cm = val.get("comms_model")
+    if not isinstance(cm, dict):
+        errs.append(f"{name}: comms_model stamp missing — the analytic "
+                    "ICI/DCN prediction no longer rides beside the "
+                    "measured comms_by_axis "
+                    "(analysis/schedule.comms_model)")
+    else:
+        per = cm.get("per_axis")
+        if not isinstance(per, dict) or not per:
+            errs.append(f"{name}: comms_model.per_axis missing/empty — "
+                        "no per-axis predicted bytes/time")
+        else:
+            for label, ent in per.items():
+                if not isinstance(ent, dict) or not isinstance(
+                        ent.get("wire_bytes_per_step"), (int, float)):
+                    errs.append(f"{name}: comms_model.per_axis"
+                                f"[{label!r}] carries no "
+                                "wire_bytes_per_step")
+        ratio = cm.get("predicted_vs_measured")
+        if not isinstance(ratio, (int, float)):
+            errs.append(f"{name}: comms_model.predicted_vs_measured "
+                        "missing/non-numeric — the model can no "
+                        "longer be tracked against measurement")
+        elif not (0.5 <= ratio <= 2.0):
+            errs.append(
+                f"{name}: comms_model predicted-vs-measured bytes "
+                f"ratio {ratio} outside [0.5, 2.0] — the analytic "
+                "model and the measured comms_by_axis disagree on "
+                "what the program moves (wire-factor regression or a "
+                "group-classification split)")
     return errs
 
 

@@ -2,8 +2,8 @@ module @jit_step attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 
   func.func public @main(%arg0: tensor<128xf32>) -> (tensor<128xf32> {jax.result_info = ""}) {
     %cst = stablehlo.constant dense<0.000000e+00> : tensor<f32>
     %0 = stablehlo.reduce(%arg0 init: %cst) applies stablehlo.add across dimensions = [0] : (tensor<128xf32>, tensor<f32>) -> tensor<f32>
-    %c = stablehlo.constant dense<94845806801376> : tensor<i64>
-    %1 = stablehlo.custom_call @xla_python_cpu_callback(%c, %0) {api_version = 2 : i32, backend_config = "94845806801376", has_side_effect = true, mhlo.sharding = "{maximal device=0}", operand_layouts = [dense<> : tensor<0xindex>, dense<> : tensor<0xindex>], result_layouts = []} : (tensor<i64>, tensor<f32>) -> tuple<>
+    %c = stablehlo.constant dense<94507860256592> : tensor<i64>
+    %1 = stablehlo.custom_call @xla_python_cpu_callback(%c, %0) {api_version = 2 : i32, backend_config = "94507860256592", has_side_effect = true, mhlo.sharding = "{maximal device=0}", operand_layouts = [dense<> : tensor<0xindex>, dense<> : tensor<0xindex>], result_layouts = []} : (tensor<i64>, tensor<f32>) -> tuple<>
     %cst_0 = stablehlo.constant dense<2.000000e+00> : tensor<f32>
     %2 = stablehlo.broadcast_in_dim %cst_0, dims = [] : (tensor<f32>) -> tensor<128xf32>
     %3 = stablehlo.multiply %arg0, %2 : tensor<128xf32>

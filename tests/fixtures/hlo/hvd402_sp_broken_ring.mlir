@@ -1,0 +1,17 @@
+module @jit_ring attributes {mhlo.num_partitions = 8 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<64x256xf32>) -> (tensor<64x256xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.custom_call @Sharding(%arg0) {backend_config = "", mhlo.sharding = "{devices=[8,1]<=[8]}"} : (tensor<64x256xf32>) -> tensor<64x256xf32>
+    %1 = stablehlo.custom_call @SPMDFullToShardShape(%0) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<64x256xf32>) -> tensor<8x256xf32>
+    %2 = call @shmap_body(%1) : (tensor<8x256xf32>) -> tensor<8x256xf32>
+    %3 = stablehlo.custom_call @Sharding(%2) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<8x256xf32>) -> tensor<8x256xf32>
+    %4 = stablehlo.custom_call @SPMDShardToFullShape(%3) {backend_config = "", mhlo.sharding = "{devices=[8,1]<=[8]}"} : (tensor<8x256xf32>) -> tensor<64x256xf32>
+    return %4 : tensor<64x256xf32>
+  }
+  func.func private @shmap_body(%arg0: tensor<8x256xf32>) -> (tensor<8x256xf32> {jax.result_info = "[('sp',), None]"}) {
+    %0 = "stablehlo.collective_permute"(%arg0) <{channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>, source_target_pairs = dense<[[0, 1], [1, 2], [2, 3], [3, 4], [4, 5], [5, 6], [6, 7]]> : tensor<7x2xi64>}> : (tensor<8x256xf32>) -> tensor<8x256xf32>
+    %1 = stablehlo.add %arg0, %0 : tensor<8x256xf32>
+    %2 = "stablehlo.collective_permute"(%0) <{channel_handle = #stablehlo.channel_handle<handle = 2, type = 1>, source_target_pairs = dense<[[0, 1], [1, 2], [2, 3], [3, 4], [4, 5], [5, 6], [6, 7]]> : tensor<7x2xi64>}> : (tensor<8x256xf32>) -> tensor<8x256xf32>
+    %3 = stablehlo.add %1, %2 : tensor<8x256xf32>
+    return %3 : tensor<8x256xf32>
+  }
+}

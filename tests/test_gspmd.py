@@ -483,15 +483,40 @@ def test_perf_gate_sharded_section_checks():
                     "hybrid_tokens_per_sec": 8400.0},
         "comms_by_axis": {"dp": {"bytes_per_step": 8 << 20},
                           "tp": {"bytes_per_step": 25 << 20}},
+        "comms_model": {
+            "link_gbps": {"ici": 90.0, "dcn": 12.5},
+            "per_axis": {"dp": {"bytes_per_step": 8 << 20,
+                                "wire_bytes_per_step": 14 << 20,
+                                "predicted_s": 1.6e-4, "ops": 3,
+                                "tier": "ici"}},
+            "predicted_vs_measured": 1.37,
+        },
     }
     assert pg._check_sharded_section("gspmd_hybrid", good) == []
-    for missing in ("mesh", "scaling", "comms_by_axis"):
+    for missing in ("mesh", "scaling", "comms_by_axis", "comms_model"):
         bad = {k: v for k, v in good.items() if k != missing}
         errs = pg._check_sharded_section("gspmd_hybrid", bad)
         assert errs and missing in " ".join(errs)
     bad = dict(good)
     bad["scaling"] = {"efficiency_vs_dp": 0}
     assert pg._check_sharded_section("gspmd_hybrid", bad)
+    # ISSUE 18: the analytic stamp is STRUCTURALLY required, and its
+    # predicted-vs-measured ratio is gated to [0.5, 2.0]
+    bad = dict(good)
+    bad["comms_model"] = {"per_axis": {}, "predicted_vs_measured": 1.0}
+    errs = pg._check_sharded_section("gspmd_hybrid", bad)
+    assert any("per_axis missing/empty" in e for e in errs)
+    bad = dict(good)
+    bad["comms_model"] = dict(good["comms_model"],
+                              predicted_vs_measured=3.1)
+    errs = pg._check_sharded_section("gspmd_hybrid", bad)
+    assert any("outside [0.5, 2.0]" in e for e in errs)
+    bad = dict(good)
+    bad["comms_model"] = {
+        "per_axis": {"dp": {"bytes_per_step": 1}},
+        "predicted_vs_measured": 1.0}
+    errs = pg._check_sharded_section("gspmd_hybrid", bad)
+    assert any("wire_bytes_per_step" in e for e in errs)
     # check_bench routes gspmd sections through the sharded checks
     doc = {"extra": {"gspmd_hybrid": {k: v for k, v in good.items()
                                       if k != "scaling"}}}

@@ -1,0 +1,419 @@
+"""hvdsched suite (ISSUE 18 tentpole): static cross-device
+collective-schedule verification (HVD4xx).
+
+The golden fixtures under ``tests/fixtures/hlo/`` (regenerate with
+``scripts/gen_hlo_fixtures.py``) pin every rule both ways hermetically:
+the deliberately misordered two-program pair trips HVD401 naming both
+devices and sequence positions, the broken sp permute ring trips
+HVD402, and the flat 2.25 MB all-reduce trips HVD404 under a declared
+slice boundary while its staged (reduce-scatter + inter-slice
+all-reduce) twin lints clean. Cross-program rules are fed through one
+ScheduleSet, matching ``--sched``'s all-paths-together contract.
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.analysis import schedule, sched_rules, shard
+from horovod_tpu.analysis.driver import run_cli
+from horovod_tpu.analysis.schedule import CollectiveEvent
+
+HERE = os.path.dirname(__file__)
+FIXDIR = os.path.join(HERE, "fixtures", "hlo")
+
+_MB = 1024 * 1024
+
+AXES_1D = [("dp", 1), ("pp", 1), ("ep", 1), ("sp", 1), ("tp", 1),
+           ("hvd", 8)]
+
+
+def fixture_text(name):
+    for ext in ("mlir", "hlo"):
+        p = os.path.join(FIXDIR, f"{name}.{ext}")
+        if os.path.exists(p):
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+    raise FileNotFoundError(name)
+
+
+def fixture_path(name):
+    for ext in ("mlir", "hlo"):
+        p = os.path.join(FIXDIR, f"{name}.{ext}")
+        if os.path.exists(p):
+            return p
+    raise FileNotFoundError(name)
+
+
+def rules_of(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+def _mpmd_text(name, row_sizes, groups="{{0,1}}"):
+    """A tiny hand-authored post-SPMD module issuing one 2-device
+    all-reduce per entry of `row_sizes`, in order — the building block
+    for cross-program divergence sets (signature = payload bytes)."""
+    lines = [f"HloModule {name}, num_partitions=2", "",
+             "add {",
+             "  x = f32[] parameter(0)",
+             "  y = f32[] parameter(1)",
+             "  ROOT s = f32[] add(x, y)",
+             "}", "", "ENTRY main {"]
+    prev = None
+    for i, rows in enumerate(row_sizes):
+        operand = f"p{i}"
+        lines.append(f"  p{i} = f32[{rows},256]{{1,0}} parameter({i})")
+    for i, rows in enumerate(row_sizes):
+        lines.append(
+            f"  ar{i} = f32[{rows},256]{{1,0}} all-reduce(p{i}), "
+            f"replica_groups={groups}, use_global_device_ids=true, "
+            f"channel_id={i + 1}, to_apply=add")
+    lines.append(f"  ROOT out = f32[{row_sizes[-1]},256]{{1,0}} "
+                 f"add(ar{len(row_sizes) - 1}, "
+                 f"ar{len(row_sizes) - 1})")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------- schedule parsing
+
+def test_parse_schedule_post_spmd_pair():
+    ps = schedule.parse_schedule(fixture_text("hvd401_pair_a"),
+                                 "pair_a")
+    assert ps.num_devices == 8
+    ars = [e for e in ps.events if e.opcode == "all_reduce"]
+    assert len(ars) >= 2
+    # trace order pinned by the scalar dependency: 4 MB before 16 KB
+    big, small = ars[0], ars[1]
+    assert big.nbytes == 4 * _MB
+    assert small.nbytes == 64 * 64 * 4
+    assert big.groups == ((0, 1, 2, 3, 4, 5, 6, 7),)
+    assert big.channel_id is not None
+    assert big.involves(0) and big.involves(7)
+    assert ps.devices == list(range(8))
+
+
+def test_parse_schedule_stablehlo_permute_pairs():
+    ps = schedule.parse_schedule(fixture_text("hvd402_sp_ring"),
+                                 "ring")
+    perms = [e for e in ps.events if e.opcode == "collective_permute"]
+    assert len(perms) == 2
+    assert perms[0].pairs == tuple(
+        (i, (i + 1) % 8) for i in range(8))
+    # connected components of the full ring: one group of all 8
+    assert perms[0].groups == (tuple(range(8)),)
+
+
+def test_parse_schedule_folds_async_halves():
+    text = _mpmd_text("async", [64]).replace(
+        "all-reduce(p0)", "all-reduce-start(p0)")
+    text += ""  # -done half absent: start alone still counts once
+    ps = schedule.parse_schedule(text, "async")
+    assert [e.opcode for e in ps.events] == ["all_reduce"]
+    done_only = _mpmd_text("done", [64]).replace(
+        "all-reduce(p0)", "all-reduce-done(p0)")
+    assert schedule.parse_schedule(done_only, "done").events == []
+
+
+def test_schedule_set_device_projection():
+    ps = schedule.parse_schedule(
+        _mpmd_text("proj", [64, 128]), "proj")
+    assert len(ps.device_events(0)) == 2
+    assert ps.device_events(5) == []
+
+
+# ------------------------------------------------------------- HVD401
+
+def test_hvd401_each_program_alone_clean():
+    for name in ("hvd401_pair_a", "hvd401_pair_b"):
+        fs = schedule.lint_text(fixture_text(name), name,
+                                select=["HVD401"])
+        assert fs == [], name
+
+
+def test_hvd401_misordered_pair_trips_with_devices_and_positions():
+    pair = [schedule.parse_schedule(fixture_text(n), n)
+            for n in ("hvd401_pair_a", "hvd401_pair_b")]
+    fs = schedule.lint_schedules(pair, select=["HVD401"])
+    assert rules_of(fs) == ["HVD401"]
+    msg = fs[0].message
+    # names both devices, both programs, and the sequence positions
+    assert "device 0 (hvd401_pair_a)" in msg
+    assert "device 0 (hvd401_pair_b)" in msg
+    assert "position 0" in msg
+    assert "position 1" in msg
+    assert "misordered" in msg
+    assert "4.00 MB" in msg and "0.02 MB" in msg
+
+
+def test_hvd401_orphan_tail_collective():
+    a = schedule.parse_schedule(_mpmd_text("a", [64, 128]), "a")
+    b = schedule.parse_schedule(_mpmd_text("b", [64]), "b")
+    fs = schedule.lint_schedules([a, b], select=["HVD401"])
+    assert rules_of(fs) == ["HVD401"]
+    assert "no counterpart" in fs[0].message
+
+
+def test_hvd401_matching_programs_clean():
+    a = schedule.parse_schedule(_mpmd_text("a", [64, 128]), "a")
+    b = schedule.parse_schedule(_mpmd_text("b", [64, 128]), "b")
+    assert schedule.lint_schedules([a, b], select=["HVD401"]) == []
+
+
+# ------------------------------------------------------------- HVD402
+
+def test_hvd402_full_rings_clean():
+    for name in ("hvd402_pp_1f1b", "hvd402_sp_ring"):
+        fs = schedule.lint_text(fixture_text(name), name,
+                                select=["HVD402"])
+        assert fs == [], name
+
+
+def test_hvd402_broken_ring_names_orphans():
+    fs = schedule.lint_text(fixture_text("hvd402_sp_broken_ring"),
+                            "broken", select=["HVD402"])
+    assert fs and rules_of(fs) == ["HVD402"]
+    msg = fs[0].message
+    assert "open chain" in msg
+    assert "[0]" in msg      # rank 0 sends but never receives
+    assert "[7]" in msg      # rank 7 receives but never sends
+    assert "1F1B" in msg
+
+
+def test_hvd402_duplicate_target_not_a_permutation():
+    text = ("""HloModule dup, num_partitions=4
+
+ENTRY main {
+  p0 = f32[128,128]{1,0} parameter(0)
+  ROOT cp = f32[128,128]{1,0} collective-permute(p0), source_target_pairs={{0,1},{2,1}}, channel_id=1
+}
+""")
+    fs = schedule.lint_text(text, "dup", select=["HVD402"])
+    assert fs and "not a permutation" in fs[0].message
+    assert "[1]" in fs[0].message  # the duplicated target
+
+
+def _event(opcode, line=1, groups=((0, 1),), pairs=None, ch=None,
+           nbytes=1024, path="<t>"):
+    return CollectiveEvent(line=line, opcode=opcode, groups=groups,
+                           pairs=pairs, channel_id=ch, nbytes=nbytes,
+                           path=path)
+
+
+def test_hvd402_orphan_send_recv_channels():
+    ps = schedule.parse_schedule(_mpmd_text("x", [64]), "x")
+    ps.events = [_event("send", line=3, ch=7),
+                 _event("recv", line=4, ch=9)]
+    fs = list(sched_rules.check_hvd402(schedule.ScheduleSet([ps])))
+    msgs = " | ".join(f.message for f in fs)
+    assert "send on channel 7 has no matching recv" in msgs
+    assert "recv on channel 9 has no matching send" in msgs
+
+
+def test_hvd402_matched_send_recv_clean():
+    ps = schedule.parse_schedule(_mpmd_text("x", [64]), "x")
+    ps.events = [_event("send", line=3, ch=7),
+                 _event("recv", line=4, ch=7)]
+    assert list(sched_rules.check_hvd402(
+        schedule.ScheduleSet([ps]))) == []
+
+
+# ------------------------------------------------------------- HVD403
+
+def test_hvd403_three_program_cycle():
+    # A<B, B<C, C<A across three stage programs: no global order.
+    a = schedule.parse_schedule(_mpmd_text("s1", [64, 128]), "s1")
+    b = schedule.parse_schedule(_mpmd_text("s2", [128, 192]), "s2")
+    c = schedule.parse_schedule(_mpmd_text("s3", [192, 64]), "s3")
+    fs = schedule.lint_schedules([a, b, c], select=["HVD403"])
+    assert rules_of(fs) == ["HVD403"]
+    assert "3-cycle" in fs[0].message
+    assert "happens-before" in fs[0].message
+
+
+def test_hvd403_two_cycle_left_to_hvd401():
+    # opposite order between two programs is HVD401's pairwise
+    # mismatch, not an HVD403 cycle
+    a = schedule.parse_schedule(_mpmd_text("s1", [64, 128]), "s1")
+    b = schedule.parse_schedule(_mpmd_text("s2", [128, 64]), "s2")
+    assert schedule.lint_schedules([a, b], select=["HVD403"]) == []
+    assert schedule.lint_schedules([a, b], select=["HVD401"]) != []
+
+
+def test_hvd403_interleaved_repeats_within_one_device_clean():
+    # repeated signatures interleaved in ONE schedule assert no order
+    ps = schedule.parse_schedule(
+        _mpmd_text("x", [64, 128, 64, 192, 128, 192]), "x")
+    assert schedule.lint_schedules([ps], select=["HVD403"]) == []
+
+
+# ------------------------------------------------------------- HVD404
+
+def test_hvd404_flat_allreduce_trips_under_declared_slices(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+    fs = schedule.lint_text(fixture_text("hvd404_flat_allreduce"),
+                            "flat", select=["HVD404"])
+    assert rules_of(fs) == ["HVD404"]
+    msg = fs[0].message
+    assert "HOROVOD_MESH_SLICES=2" in msg
+    assert "reduce-scatter" in msg
+    assert "2.2 MB" in msg
+
+
+def test_hvd404_staged_twin_clean(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+    assert schedule.lint_text(
+        fixture_text("hvd404_staged_allreduce"), "staged",
+        select=["HVD404"]) == []
+
+
+def test_hvd404_silent_without_declared_slices(monkeypatch):
+    monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+    assert schedule.lint_text(
+        fixture_text("hvd404_flat_allreduce"), "flat",
+        select=["HVD404"]) == []
+
+
+def test_hvd404_payload_floor(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+    monkeypatch.setenv("HOROVOD_SCHED_MIN_STAGED_BYTES", "1G")
+    assert schedule.lint_text(
+        fixture_text("hvd404_flat_allreduce"), "flat",
+        select=["HVD404"]) == []
+
+
+def test_hvd404_malformed_slices_raises(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "two")
+    with pytest.raises(ValueError, match="HOROVOD_MESH_SLICES"):
+        schedule.lint_text(fixture_text("hvd404_flat_allreduce"),
+                           "flat", select=["HVD404"])
+
+
+# ------------------------------------------------------------- HVD405
+
+def test_hvd405_explicit_window_gates_both_ways(monkeypatch):
+    text = fixture_text("hvd404_flat_allreduce")
+    monkeypatch.setenv("HOROVOD_SCHED_OVERLAP_WINDOW_MS", "0.001")
+    fs = schedule.lint_text(text, "flat", select=["HVD405"])
+    assert rules_of(fs) == ["HVD405"]
+    msg = fs[0].message
+    assert "exposed" in msg and "comms-bound" in msg
+    assert "all_reduce" in msg
+    monkeypatch.setenv("HOROVOD_SCHED_OVERLAP_WINDOW_MS", "1000")
+    assert schedule.lint_text(text, "flat", select=["HVD405"]) == []
+
+
+def test_hvd405_silent_without_window_config(monkeypatch):
+    for k in ("HOROVOD_SCHED_OVERLAP_WINDOW_MS",
+              "HOROVOD_SCHED_PEAK_TFLOPS"):
+        monkeypatch.delenv(k, raising=False)
+    assert schedule.lint_text(
+        fixture_text("hvd404_flat_allreduce"), "flat",
+        select=["HVD405"]) == []
+
+
+def test_hvd405_peak_tflops_arms_dot_free_program(monkeypatch):
+    # no dots -> zero-FLOP window: ANY predicted comms are exposed
+    monkeypatch.setenv("HOROVOD_SCHED_PEAK_TFLOPS", "100")
+    fs = schedule.lint_text(fixture_text("hvd404_flat_allreduce"),
+                            "flat", select=["HVD405"])
+    assert rules_of(fs) == ["HVD405"]
+
+
+# --------------------------------------- degenerate-group shared pin
+
+def test_degenerate_single_device_groups_carry_no_wire():
+    text = fixture_text("comms_degenerate_group")
+    ps = schedule.parse_schedule(text, "degenerate")
+    # the pin is non-vacuous: the all-reduce IS parsed, with its eight
+    # singleton groups — and still carries no wire in either attribution
+    assert [e.opcode for e in ps.events] == ["all_reduce"]
+    assert ps.events[0].groups == tuple((d,) for d in range(8))
+    assert shard.comms_by_axis(text, AXES_1D) == {}
+    cm = schedule.comms_model(text, AXES_1D)
+    assert cm["per_axis"] == {}
+    assert cm["predicted_bytes_per_step"] == 0
+
+
+def test_group_axis_label_is_the_shared_classifier():
+    partitions = shard._axis_partitions(AXES_1D)
+    full = frozenset([frozenset(range(8))])
+    assert partitions[full] == "hvd"
+    assert shard.group_axis_label([list(range(8))], partitions) == "hvd"
+    # all size-1 groups: degenerate, no wire
+    assert shard.group_axis_label([[d] for d in range(8)],
+                                  partitions) is None
+    # unparseable and unmatched land in "other"
+    assert shard.group_axis_label(None, partitions) == "other"
+    assert shard.group_axis_label([[0, 2], [1, 3]],
+                                  partitions) == "other"
+
+
+# --------------------------------------------------------- driver CLI
+
+def test_cli_sched_pair_trips_and_single_file_clean(capsys):
+    rc = run_cli(["--sched", fixture_path("hvd401_pair_a")])
+    assert rc == 0
+    assert "hvdsched: clean" in capsys.readouterr().out
+    rc = run_cli(["--sched", fixture_path("hvd401_pair_a"),
+                  fixture_path("hvd401_pair_b")])
+    assert rc == 1
+    assert "HVD401" in capsys.readouterr().out
+
+
+def test_cli_sched_select_filters_family(capsys):
+    broken = fixture_path("hvd402_sp_broken_ring")
+    assert run_cli(["--sched", broken, "--select", "HVD401"]) == 0
+    capsys.readouterr()
+    assert run_cli(["--sched", broken, "--select", "HVD402"]) == 1
+    assert "HVD402" in capsys.readouterr().out
+
+
+def test_cli_sched_json_and_empty_baseline(tmp_path, capsys):
+    rc = run_cli(["--sched", fixture_path("hvd402_sp_broken_ring"),
+                  "--format", "json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["count"] >= 1
+    assert all(f["rule"] == "HVD402" for f in doc["findings"])
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps(doc))
+    assert run_cli(["--sched",
+                    fixture_path("hvd402_sp_broken_ring"),
+                    "--baseline", str(base)]) == 0
+    assert run_cli(["--sched",
+                    fixture_path("hvd402_sp_broken_ring"),
+                    "--baseline",
+                    os.path.join(HERE, "..", "scripts",
+                                 "hvdsched_baseline.json")]) == 1
+    capsys.readouterr()
+
+
+def test_cli_list_rules_covers_hvd4xx(capsys):
+    assert run_cli(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("HVD401", "HVD402", "HVD403", "HVD404", "HVD405"):
+        assert rid in out
+        line = next(ln for ln in out.splitlines() if ln.startswith(rid))
+        assert "[--sched]" in line
+
+
+def test_cli_malformed_link_env_exits_2(monkeypatch, capsys):
+    monkeypatch.setenv("HOROVOD_SCHED_LINK_GBPS", "warp=9")
+    monkeypatch.setenv("HOROVOD_SCHED_OVERLAP_WINDOW_MS", "1")
+    rc = run_cli(["--sched", fixture_path("hvd404_flat_allreduce")])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "hvdsched" in err and "HOROVOD_SCHED_LINK_GBPS" in err
+
+
+def test_record_metrics_counts_by_rule():
+    from horovod_tpu.analysis.driver import Finding
+    from horovod_tpu.observability import metrics as m
+    schedule.record_metrics([])  # clean run still registers the family
+    fam = m.registry().peek("hvdsched_findings_total")
+    assert fam is not None and fam.kind == "counter"
+    schedule.record_metrics([Finding("p", 1, "HVD401", "x"),
+                             Finding("p", 2, "HVD401", "y")])
+    assert fam.labels(rule="HVD401").value >= 2

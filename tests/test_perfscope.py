@@ -577,6 +577,14 @@ def _gspmd_section():
                     "dp_tokens_per_sec": 1.0,
                     "hybrid_tokens_per_sec": 1.0},
         "comms_by_axis": {"dp": {"bytes_per_step": 1}},
+        "comms_model": {
+            "link_gbps": {"ici": 90.0, "dcn": 12.5},
+            "per_axis": {"dp": {"bytes_per_step": 1,
+                                "wire_bytes_per_step": 1,
+                                "predicted_s": 1e-9, "ops": 1,
+                                "tier": "ici"}},
+            "predicted_vs_measured": 1.0,
+        },
     }, **_ckpt_section()}
 
 

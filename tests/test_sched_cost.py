@@ -1,0 +1,304 @@
+"""Analytic ICI/DCN comms cost model unit suite (ISSUE 18 satellite 4
++ tentpole HVD405 math; docs/static_analysis.md, docs/perf.md).
+
+Pins the planning constants and the exact ring arithmetic the bench
+``comms_model`` stamp and HVD404/HVD405 rest on, the loud-ValueError
+contract of every HOROVOD_SCHED_* knob (the `_bytes_env` lesson: a
+mistyped knob must never silently revert to defaults), and the
+agreement guarantee between predicted payload bytes and the measured
+``comms_by_axis`` — both read the same parser and the same
+shard.group_axis_label classifier, so predicted_vs_measured is the
+wire factor alone, deterministically inside [0.5, 2.0).
+"""
+
+import math
+import os
+
+import pytest
+
+from horovod_tpu.analysis import schedule, shard
+from horovod_tpu.analysis.hlo import parse
+from horovod_tpu.analysis.schedule import CollectiveEvent
+
+HERE = os.path.dirname(__file__)
+FIXDIR = os.path.join(HERE, "fixtures", "hlo")
+
+_MB = 1024 * 1024
+
+
+def fixture_text(name):
+    for ext in ("mlir", "hlo"):
+        p = os.path.join(FIXDIR, f"{name}.{ext}")
+        if os.path.exists(p):
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+    raise FileNotFoundError(name)
+
+
+def _event(opcode, nbytes, groups):
+    return CollectiveEvent(line=1, opcode=opcode,
+                           groups=tuple(tuple(g) for g in groups),
+                           pairs=None, channel_id=None,
+                           nbytes=nbytes, path="<t>")
+
+
+# ------------------------------------------------------- link table
+
+def test_link_gbps_documented_fallbacks(monkeypatch):
+    monkeypatch.delenv("HOROVOD_SCHED_LINK_GBPS", raising=False)
+    assert schedule.link_gbps() == {"ici": 90.0, "dcn": 12.5}
+
+
+def test_link_gbps_full_and_partial_override(monkeypatch):
+    monkeypatch.setenv("HOROVOD_SCHED_LINK_GBPS", "ici=45, dcn=6.25")
+    assert schedule.link_gbps() == {"ici": 45.0, "dcn": 6.25}
+    # either tier alone: the other keeps its documented fallback
+    monkeypatch.setenv("HOROVOD_SCHED_LINK_GBPS", "dcn=25")
+    assert schedule.link_gbps() == {"ici": 90.0, "dcn": 25.0}
+    monkeypatch.setenv("HOROVOD_SCHED_LINK_GBPS", "ici=120")
+    assert schedule.link_gbps() == {"ici": 120.0, "dcn": 12.5}
+
+
+@pytest.mark.parametrize("raw", [
+    "warp=9",          # unknown tier
+    "ici=fast",        # non-numeric value
+    "ici",             # no value at all
+    "ici=-5",          # negative
+    "ici=0",           # zero is not a bandwidth
+    "ici=90;dcn=12",   # wrong separator
+])
+def test_link_gbps_garbage_raises_loud(monkeypatch, raw):
+    monkeypatch.setenv("HOROVOD_SCHED_LINK_GBPS", raw)
+    with pytest.raises(ValueError, match="HOROVOD_SCHED_LINK_GBPS"):
+        schedule.link_gbps()
+
+
+def test_link_gbps_cache_keyed_by_raw_value(monkeypatch):
+    monkeypatch.setenv("HOROVOD_SCHED_LINK_GBPS", "dcn=25")
+    assert schedule.link_gbps()["dcn"] == 25.0
+    monkeypatch.setenv("HOROVOD_SCHED_LINK_GBPS", "dcn=50")
+    assert schedule.link_gbps()["dcn"] == 50.0  # no stale cache hit
+    # callers mutating the returned table must not poison the cache
+    schedule.link_gbps()["dcn"] = -1.0
+    assert schedule.link_gbps()["dcn"] == 50.0
+
+
+# ------------------------------------------------- ring arithmetic
+
+def test_wire_factors():
+    assert schedule.wire_factor("all_reduce", 8) == 2 * 7 / 8
+    assert schedule.wire_factor("all_gather", 8) == 7 / 8
+    assert schedule.wire_factor("reduce_scatter", 4) == 3 / 4
+    assert schedule.wire_factor("all_to_all", 4) == 3 / 4
+    assert schedule.wire_factor("collective_permute", 8) == 1.0
+    assert schedule.wire_factor("send", 2) == 1.0
+    # a 1-member "collective" moves nothing
+    assert schedule.wire_factor("all_reduce", 1) == 0.0
+
+
+def test_group_tier_matches_mesh_slice_groups():
+    """The cost model's `rank // per_slice` arithmetic and the mesh
+    layer's slice_groups are the SAME partition — the analysis side
+    deliberately re-derives it (lint must import without jax), so this
+    pin is what keeps the two from drifting."""
+    from horovod_tpu.parallel.mesh import slice_groups
+    for ndev, slices in ((8, 2), (8, 4), (12, 3)):
+        groups = slice_groups(ndev, slices)
+        assert [d for g in groups for d in g] == list(range(ndev))
+        for g in groups:  # intra-slice groups ride ICI...
+            assert schedule.group_tier(g, slices, ndev) == "ici"
+        for a, b in zip(groups, groups[1:]):  # ...boundary-crossers DCN
+            assert schedule.group_tier([a[-1], b[0]], slices,
+                                       ndev) == "dcn"
+
+
+def test_slice_groups_rejects_non_dividing():
+    from horovod_tpu.common.exceptions import HorovodTpuError
+    from horovod_tpu.parallel.mesh import slice_groups
+    with pytest.raises(HorovodTpuError):
+        slice_groups(8, 3)
+
+
+def test_group_tier_slice_assignment():
+    # 8 devices, 2 slices: ranks 0-3 | 4-7
+    assert schedule.group_tier([0, 1, 2, 3], 2, 8) == "ici"
+    assert schedule.group_tier([4, 5, 6, 7], 2, 8) == "ici"
+    assert schedule.group_tier([3, 4], 2, 8) == "dcn"
+    assert schedule.group_tier(list(range(8)), 2, 8) == "dcn"
+    # flat mesh or non-dividing slice count: everything is ICI
+    assert schedule.group_tier(list(range(8)), None, 8) == "ici"
+    assert schedule.group_tier(list(range(8)), 1, 8) == "ici"
+    assert schedule.group_tier(list(range(8)), 3, 8) == "ici"
+
+
+def test_event_cost_exact_math():
+    ev = _event("all_reduce", _MB, [list(range(8))])
+    cost = schedule.event_cost(
+        ev, 8, slices=None, table={"ici": 90.0, "dcn": 12.5})
+    assert cost.tier == "ici"
+    assert cost.wire_bytes == int(_MB * 2 * 7 / 8)
+    assert math.isclose(cost.seconds, cost.wire_bytes / 90e9)
+    # the same collective across a slice boundary rides the DCN tier
+    dcn = schedule.event_cost(
+        ev, 8, slices=2, table={"ici": 90.0, "dcn": 12.5})
+    assert dcn.tier == "dcn"
+    assert math.isclose(dcn.seconds, dcn.wire_bytes / 12.5e9)
+    assert dcn.seconds > cost.seconds
+
+
+def test_event_cost_degenerate_group_is_free():
+    ev = _event("all_reduce", _MB, [[d] for d in range(8)])
+    cost = schedule.event_cost(ev, 8, table={"ici": 90.0, "dcn": 12.5})
+    assert cost.wire_bytes == 0 and cost.seconds == 0.0
+
+
+# ----------------------------------------------------- comms_model
+
+AXES = [("dp", 1), ("pp", 1), ("ep", 1), ("sp", 8), ("tp", 1)]
+
+
+def test_comms_model_agrees_with_measured_payload(monkeypatch):
+    monkeypatch.delenv("HOROVOD_SCHED_LINK_GBPS", raising=False)
+    monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+    text = fixture_text("hvd402_sp_ring")
+    measured = shard.comms_by_axis(text, AXES)
+    cm = schedule.comms_model(text, AXES)
+    assert set(cm["per_axis"]) == set(measured)
+    for label, ent in cm["per_axis"].items():
+        # identical payload accounting: same parser, same classifier
+        assert ent["bytes_per_step"] == measured[label]["bytes_per_step"]
+        assert ent["ops"] == measured[label]["ops"]
+        assert ent["tier"] == "ici"
+        assert ent["predicted_s"] > 0
+    assert cm["payload_bytes_per_step"] == sum(
+        v["bytes_per_step"] for v in measured.values())
+    # wire factors live in [0.5, 2.0) -> so does predicted vs payload
+    ratio = (cm["predicted_bytes_per_step"] /
+             cm["payload_bytes_per_step"])
+    assert 0.5 <= ratio < 2.0
+
+
+def test_comms_model_slices_move_axis_to_dcn(monkeypatch):
+    monkeypatch.delenv("HOROVOD_SCHED_LINK_GBPS", raising=False)
+    text = fixture_text("hvd404_flat_allreduce")
+    axes = [("dp", 1), ("pp", 1), ("ep", 1), ("sp", 1), ("tp", 1),
+            ("hvd", 8)]
+    flat = schedule.comms_model(text, axes)
+    sliced = schedule.comms_model(text, axes, slices=2)
+    assert flat["per_axis"]["hvd"]["tier"] == "ici"
+    assert sliced["per_axis"]["hvd"]["tier"] == "dcn"
+    assert (sliced["per_axis"]["hvd"]["predicted_s"] >
+            flat["per_axis"]["hvd"]["predicted_s"])
+    assert sliced["slices"] == 2
+
+
+def test_comms_model_reads_declared_slices_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+    axes = [("dp", 1), ("pp", 1), ("ep", 1), ("sp", 1), ("tp", 1),
+            ("hvd", 8)]
+    cm = schedule.comms_model(fixture_text("hvd404_flat_allreduce"),
+                              axes)
+    assert cm["slices"] == 2
+    assert cm["per_axis"]["hvd"]["tier"] == "dcn"
+
+
+def test_declared_slices_parsing(monkeypatch):
+    monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+    assert schedule.declared_slices() is None
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "4")
+    assert schedule.declared_slices() == 4
+    for raw in ("two", "0", "-1", "2.5"):
+        monkeypatch.setenv("HOROVOD_MESH_SLICES", raw)
+        with pytest.raises(ValueError, match="HOROVOD_MESH_SLICES"):
+            schedule.declared_slices()
+
+
+def test_min_staged_bytes(monkeypatch):
+    monkeypatch.delenv("HOROVOD_SCHED_MIN_STAGED_BYTES", raising=False)
+    assert schedule.min_staged_bytes() == _MB
+    monkeypatch.setenv("HOROVOD_SCHED_MIN_STAGED_BYTES", "4M")
+    assert schedule.min_staged_bytes() == 4 * _MB
+    monkeypatch.setenv("HOROVOD_SCHED_MIN_STAGED_BYTES", "lots")
+    with pytest.raises(ValueError,
+                       match="HOROVOD_SCHED_MIN_STAGED_BYTES"):
+        schedule.min_staged_bytes()
+
+
+# --------------------------------------- the overlappable window
+
+def _clear_window_env(monkeypatch):
+    for k in ("HOROVOD_SCHED_OVERLAP_WINDOW_MS",
+              "HOROVOD_SCHED_PEAK_TFLOPS",
+              "HOROVOD_SCHED_OVERLAP_FRACTION"):
+        monkeypatch.delenv(k, raising=False)
+
+
+def test_overlap_window_explicit_env_wins(monkeypatch):
+    _clear_window_env(monkeypatch)
+    monkeypatch.setenv("HOROVOD_SCHED_OVERLAP_WINDOW_MS", "12.5")
+    # explicit window beats phases AND the analytic estimate
+    assert schedule.overlap_window_s(
+        phases_s={"device_compute": 99.0}) == pytest.approx(0.0125)
+
+
+def test_overlap_window_from_perfscope_phase_split(monkeypatch):
+    _clear_window_env(monkeypatch)
+    # perfscope-style phases (seconds): device_compute is the window
+    phases = {"device_compute": 0.010, "host_input": 0.004,
+              "comms": 0.002}
+    win = schedule.overlap_window_s(phases_s=phases)
+    assert win == pytest.approx(
+        0.010 * schedule.DEFAULT_OVERLAP_FRACTION)
+    # no device_compute phase: conservative sum of what was measured
+    win = schedule.overlap_window_s(
+        phases_s={"fwd": 0.006, "bwd": 0.004})
+    assert win == pytest.approx(
+        0.010 * schedule.DEFAULT_OVERLAP_FRACTION)
+    # fraction override rescales the same split
+    monkeypatch.setenv("HOROVOD_SCHED_OVERLAP_FRACTION", "0.5")
+    win = schedule.overlap_window_s(phases_s=phases)
+    assert win == pytest.approx(0.005)
+
+
+def test_overlap_window_from_dot_flops_and_peak(monkeypatch):
+    _clear_window_env(monkeypatch)
+    # lm fixture has real dots; without a declared peak -> unarmed
+    prog = parse(fixture_text("hvd302_reshard_free"), "lm")
+    assert schedule.overlap_window_s(prog) is None
+    flops = schedule.dot_flops(prog)
+    assert flops > 0
+    monkeypatch.setenv("HOROVOD_SCHED_PEAK_TFLOPS", "100")
+    win = schedule.overlap_window_s(prog)
+    assert win == pytest.approx(
+        flops / 100e12 * schedule.DEFAULT_OVERLAP_FRACTION)
+
+
+def test_dot_free_program_has_zero_flop_floor():
+    prog = parse(fixture_text("hvd404_flat_allreduce"), "flat")
+    assert schedule.dot_flops(prog) == 0
+
+
+@pytest.mark.parametrize("env", [
+    "HOROVOD_SCHED_OVERLAP_WINDOW_MS",
+    "HOROVOD_SCHED_PEAK_TFLOPS",
+    "HOROVOD_SCHED_OVERLAP_FRACTION",
+])
+@pytest.mark.parametrize("raw", ["soon", "-3", "0"])
+def test_window_knob_garbage_raises_loud(monkeypatch, env, raw):
+    _clear_window_env(monkeypatch)
+    monkeypatch.setenv(env, raw)
+    with pytest.raises(ValueError, match=env):
+        if env == "HOROVOD_SCHED_PEAK_TFLOPS":
+            # the peak only matters on the analytic dot-FLOPs path
+            schedule.overlap_window_s(
+                parse(fixture_text("hvd302_reshard_free"), "lm"))
+        else:
+            schedule.overlap_window_s(
+                phases_s={"device_compute": 0.010})
+
+
+def test_overlap_window_unarmed_returns_none(monkeypatch):
+    _clear_window_env(monkeypatch)
+    assert schedule.overlap_window_s() is None
+    assert schedule.overlap_window_s(
+        parse(fixture_text("hvd404_flat_allreduce"), "flat")) is None
