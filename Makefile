@@ -83,9 +83,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline sched-lint sched-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline sched-lint sched-lint-baseline num-lint num-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke
 
-test: lint hlo-lint shard-lint sched-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke entry
+test: lint hlo-lint shard-lint sched-lint num-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -269,6 +269,35 @@ sched-lint-baseline:
 	    --select HVD401,HVD402,HVD403,HVD404,HVD405 \
 	    --format json > scripts/hvdsched_baseline.json || true
 
+# hvdnum (HVD5xx): the numerics & reduction-semantics wall. The fixture
+# suite pins every rule both ways (bf16-accumulating dot vs the
+# preferred_element_type=f32 twin, downcast-then-reduce vs
+# reduce-then-downcast, the baked world-size divisor vs the true group
+# mean, the determinism-hazard trio vs the keyed twin, the
+# different-mesh sum pair vs the mean pair) plus the group_axis_label
+# edge-case suite the scale table's axis attribution rides on, then
+# the canonical step programs' post-SPMD dtype-flow and gradient-scale
+# invariants are gated against the checked-in EMPTY baseline.
+num-lint:
+	$(PYTEST) tests/test_hvdnum.py tests/test_group_axis_label.py
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_sharded \
+	    --select HVD501,HVD502,HVD503,HVD504,HVD505 \
+	    --baseline scripts/hvdnum_baseline.json
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_runtime \
+	    --select HVD501,HVD502,HVD503,HVD504,HVD505 \
+	    --baseline scripts/hvdnum_baseline.json
+
+num-lint-baseline:
+	env JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -m horovod_tpu.analysis --hlo-step lm_sharded \
+	    --select HVD501,HVD502,HVD503,HVD504,HVD505 \
+	    --format json > scripts/hvdnum_baseline.json || true
+
 shard-lint-baseline:
 	env JAX_PLATFORMS=cpu \
 	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
@@ -297,7 +326,9 @@ race:
 	    tests/test_flight.py tests/test_perfscope.py \
 	    tests/test_watch.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
-	    tests/test_hvdlint.py tests/test_serve.py tests/test_ckpt.py \
+	    tests/test_hvdlint.py tests/test_hvdnum.py \
+	    tests/test_group_axis_label.py \
+	    tests/test_serve.py tests/test_ckpt.py \
 	    tests/test_kv_ha.py tests/test_perfboard.py \
 	    --deselect tests/test_elastic.py::test_elastic_reset_warm_compile_cache
 

@@ -1378,6 +1378,19 @@ def _gspmd_cpu_mesh_child():
             result["comms_model"] = cm
         except Exception as e:
             result["comms_model_error"] = _err_str(e)
+        # The hvdnum stamp, off the SAME compiled text: accumulation
+        # dtypes seen plus the gradient-scale table (group size,
+        # divisor, effective multiplier, axis attribution via the
+        # shared shard.group_axis_label classifier). Structurally
+        # required by scripts/perf_gate.py; perfboard carries the
+        # finding count across rounds.
+        try:
+            from horovod_tpu.analysis import numerics as num_mod
+            result["numerics"] = num_mod.stamp(
+                text, list(zip(AXIS_ORDER, spec.sizes())),
+                path="<gspmd>")
+        except Exception as e:
+            result["numerics_error"] = _err_str(e)
         result["memory"] = _memory_stamp(compiled)
         try:
             result["shard_lint"] = {

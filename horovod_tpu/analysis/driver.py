@@ -131,6 +131,30 @@ def registry() -> Dict[str, Tuple[str, object]]:
     return reg
 
 
+#: Lowered-program rule families: (CLI flag, analysis module exposing a
+#: ``registry()`` hook). ``--list-rules`` derives its listing from this
+#: table, so a new family appears by registering here ONCE — the
+#: hand-maintained per-family import list this replaces silently
+#: dropped new families (tests assert every HVD rule documented in
+#: docs/static_analysis.md is reachable through it).
+HLO_RULE_FAMILIES: Tuple[Tuple[str, str], ...] = (
+    ("--hlo", "horovod_tpu.analysis.hlo"),
+    ("--shard", "horovod_tpu.analysis.shard"),
+    ("--sched", "horovod_tpu.analysis.schedule"),
+    ("--num", "horovod_tpu.analysis.numerics"),
+)
+
+
+def family_registries() -> Dict[str, Dict[str, Tuple[str, object]]]:
+    """CLI flag -> that family's rule registry, one entry per
+    HLO_RULE_FAMILIES row (imported lazily, like registry())."""
+    import importlib
+    out: Dict[str, Dict[str, Tuple[str, object]]] = {}
+    for flag, modname in HLO_RULE_FAMILIES:
+        out[flag] = importlib.import_module(modname).registry()
+    return out
+
+
 def lint_source(text: str, path: str = "<string>",
                 select: Optional[Sequence[str]] = None,
                 ignore: Sequence[str] = (),
@@ -336,6 +360,18 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                              "cross-program rules (HVD401/HVD403) see "
                              "every pairing; composes with --hlo and "
                              "--shard over the same dumps")
+    parser.add_argument("--num", action="store_true",
+                        help="hvdnum mode: treat paths as lowered "
+                             "StableHLO/post-SPMD HLO dumps and run "
+                             "the HVD5xx numerics rules — dtype-flow "
+                             "(low-precision accumulation, downcast-"
+                             "before-reduce), gradient-scale audit, "
+                             "and the determinism hazards that void "
+                             "bit-identical resume; ALL paths are "
+                             "linted as one set so the cross-mesh "
+                             "HVD505 diff sees every pairing; "
+                             "composes with --hlo/--shard/--sched "
+                             "over the same dumps")
     parser.add_argument("--hlo-step", default=None, metavar="PROGRAM",
                         choices=("lm", "resnet_block", "lm_sharded",
                                  "lm_runtime"),
@@ -372,21 +408,17 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.list_rules:
         from horovod_tpu.analysis import env_rule as env_mod
-        from horovod_tpu.analysis import hlo_rules, sched_rules, shard_rules
         reg = dict(registry())
         reg[env_mod.RULE_ID] = (env_mod.DESCRIPTION, None)
         reg[HVD000] = ("suppression comment lacks a rationale", None)
-        for rule_id, (desc, _check) in hlo_rules.RULES.items():
-            reg[rule_id] = (f"[--hlo] {desc}", None)
-        for rule_id, (desc, _check) in shard_rules.RULES.items():
-            reg[rule_id] = (f"[--shard] {desc}", None)
-        for rule_id, (desc, _check) in sched_rules.RULES.items():
-            reg[rule_id] = (f"[--sched] {desc}", None)
+        for flag, family in family_registries().items():
+            for rule_id, (desc, _check) in family.items():
+                reg[rule_id] = (f"[{flag}] {desc}", None)
         for rule_id in sorted(reg):
             print(f"{rule_id}  {reg[rule_id][0]}")
         return 0
 
-    hlo_mode = (args.hlo or args.shard or args.sched
+    hlo_mode = (args.hlo or args.shard or args.sched or args.num
                 or args.hlo_step is not None)
     if not args.paths and not args.hlo_step:
         parser.error("no paths given (try: horovod_tpu/ examples/)")
@@ -401,16 +433,17 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
     ignore = [s.strip() for s in args.ignore.split(",") if s.strip()]
     if hlo_mode:
         from horovod_tpu.analysis import hlo as hlo_mod
+        from horovod_tpu.analysis import numerics as num_mod
         from horovod_tpu.analysis import schedule as sched_mod
         from horovod_tpu.analysis import shard as shard_mod
         findings = []
         try:
             # File mode: --hlo runs HVD2xx, --shard runs HVD3xx,
-            # --sched runs HVD4xx; the flags compose over the same
-            # dumps. A bare --hlo-step adds no file findings (paths
-            # empty).
+            # --sched runs HVD4xx, --num runs HVD5xx; the flags
+            # compose over the same dumps. A bare --hlo-step adds no
+            # file findings (paths empty).
             if args.hlo or (args.paths and not args.shard
-                            and not args.sched):
+                            and not args.sched and not args.num):
                 findings.extend(hlo_mod.lint_files(
                     args.paths, select=select, ignore=ignore))
             if args.shard:
@@ -419,7 +452,10 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
             if args.sched:
                 findings.extend(sched_mod.lint_files(
                     args.paths, select=select, ignore=ignore))
-            if (args.hlo + args.shard + args.sched) > 1:
+            if args.num:
+                findings.extend(num_mod.lint_files(
+                    args.paths, select=select, ignore=ignore))
+            if (args.hlo + args.shard + args.sched + args.num) > 1:
                 findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
             if args.hlo_step in ("lm_sharded", "lm_runtime"):
                 # The 2-D-mesh gates lint BOTH textual forms: the
@@ -455,6 +491,14 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                 findings.extend(sched_mod.lint_text(
                     texts["hlo"], path=base[:-1] + ":spmd>",
                     select=select, ignore=ignore))
+                # The HVD5xx numerics rules also read the post-SPMD
+                # form (real replica groups + the scale constants XLA
+                # actually folded). The default programs accumulate in
+                # f32 with group-sized scaling, so `make num-lint`
+                # gates them against the empty baseline.
+                findings.extend(num_mod.lint_text(
+                    texts["hlo"], path=base[:-1] + ":spmd>",
+                    select=select, ignore=ignore))
                 findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
             elif args.hlo_step is not None:
                 # Lowering failures must fail the gate loudly — a CI
@@ -469,6 +513,10 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                 findings.extend(hlo_mod.lint_text(
                     text, path=hlo_mod.step_path(args.hlo_step),
                     select=select, ignore=ignore))
+                if args.num:
+                    findings.extend(num_mod.lint_text(
+                        text, path=hlo_mod.step_path(args.hlo_step),
+                        select=select, ignore=ignore))
                 findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
         except ValueError as e:
             # A malformed knob (HOROVOD_HLO_LINT_HBM_BUDGET=16GiB)
@@ -476,7 +524,9 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
             # the driver's error convention is one line + exit 2
             # (lowering failures, unreadable baselines), never a
             # traceback that exits 1 as if findings were found.
-            name = ("hvdsched" if args.sched and not args.shard
+            name = ("hvdnum" if args.num
+                    and not (args.sched or args.shard)
+                    else "hvdsched" if args.sched and not args.shard
                     else "hvdshard" if args.shard or args.hlo_step
                     in ("lm_sharded", "lm_runtime") else "hvdhlo")
             print(f"{name}: {e}", file=sys.stderr)
@@ -485,16 +535,21 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
         findings = lint_paths(args.paths, select=select, ignore=ignore,
                               root=root, env_rule=not args.no_env)
     matched = 0
-    # A step-mode run narrowed to the HVD4xx family (make sched-lint)
-    # reports as hvdsched too, so the gate's clean line names the tool
-    # that actually judged the program.
+    # A step-mode run narrowed to one family (make sched-lint /
+    # make num-lint) reports as that family too, so the gate's clean
+    # line names the tool that actually judged the program.
     sel_all_sched = bool(select) and all(
         re.fullmatch(r"HVD4\d\d", r.strip().upper()) for r in select)
+    sel_all_num = bool(select) and all(
+        re.fullmatch(r"HVD5\d\d", r.strip().upper()) for r in select)
     sched_only = ((args.sched or sel_all_sched)
-                  and not (args.hlo or args.shard))
+                  and not (args.hlo or args.shard or args.num))
+    num_only = ((args.num or sel_all_num)
+                and not (args.hlo or args.shard or args.sched))
     shard_mode = args.shard or args.hlo_step in ("lm_sharded",
                                                  "lm_runtime")
-    name = ("hvdsched" if sched_only
+    name = ("hvdnum" if num_only
+            else "hvdsched" if sched_only
             else "hvdshard" if shard_mode
             else "hvdhlo" if hlo_mode else "hvdlint")
     if args.baseline is not None:
@@ -508,20 +563,26 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
         findings, matched = apply_baseline(findings, baseline)
     if hlo_mode:
         from horovod_tpu.analysis import hlo as hlo_mod
+        from horovod_tpu.analysis import numerics as num_mod
         from horovod_tpu.analysis import schedule as sched_mod
         from horovod_tpu.analysis import shard as shard_mod
         # Each family owns its metric: HVD3xx ->
         # hvdshard_findings_total, HVD4xx -> hvdsched_findings_total,
-        # the rest -> hvdhlo_findings_total.
+        # HVD5xx -> hvdnum_findings_total, the rest ->
+        # hvdhlo_findings_total.
         shard_f = [f for f in findings
                    if re.fullmatch(r"HVD3\d\d", f.rule_id)]
         sched_f = [f for f in findings
                    if re.fullmatch(r"HVD4\d\d", f.rule_id)]
+        num_f = [f for f in findings
+                 if re.fullmatch(r"HVD5\d\d", f.rule_id)]
         hlo_mod.record_metrics([f for f in findings
                                 if f not in shard_f
-                                and f not in sched_f])
+                                and f not in sched_f
+                                and f not in num_f])
         shard_mod.record_metrics(shard_f)
         sched_mod.record_metrics(sched_f)
+        num_mod.record_metrics(num_f)
     else:
         _record_metrics(findings)
     if args.fmt == "json":

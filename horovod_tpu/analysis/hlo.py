@@ -92,6 +92,10 @@ class HloOp:
     result_types: Tuple[Optional[TensorType], ...]
     attrs: str                    # raw remainder text for attr regexes
     scope: str                    # enclosing function / computation name
+    #: Scalar value of a ``constant`` op's literal (both textual forms,
+    #: incl. scientific notation, typed ``bf16[] 8`` spellings and MLIR
+    #: ``dense<>`` splats); None for non-constants and non-scalars.
+    literal: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -209,6 +213,51 @@ def _operand_names(segment: str) -> Tuple[str, ...]:
                  for m in _SSA_RE.finditer(segment))
 
 
+# Constant literals, both textual forms. XLA prints scalars plain
+# (``constant(8)``), in scientific notation (``constant(1.25e-05)``)
+# and — for the narrow dtypes — typed (``constant(bf16[] 8)``,
+# ``constant(f8e4m3fn[] 1.5e-2)``); StableHLO prints ``dense<>`` attrs
+# (``dense<1.250000e-01>``). The number grammar must cover all of them:
+# a literal the parser cannot read is a silently skipped operand, and
+# the HVD503 divisor extraction then misses the baked scale constant.
+_LITERAL_NUM_RE = re.compile(
+    r"[-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?")
+_MLIR_DENSE_RE = re.compile(r"dense<(.*)>\s*$", re.DOTALL)
+
+
+def parse_literal(text: str) -> Optional[float]:
+    """Scalar value of one constant literal, or None when the literal
+    is non-scalar (array/tuple braces, hex-encoded dense blobs) — the
+    caller must then skip the value rather than guess."""
+    s = text.strip()
+    m = _MLIR_DENSE_RE.match(s)
+    if m:
+        s = m.group(1).strip()
+    # typed scalar literal: a leading `dtype[]` token before the value
+    tm = _HLO_SHAPE_RE.match(s)
+    if tm and tm.start() == 0:
+        if tm.group(2).strip():
+            return None  # shaped literal: `f32[2] {1, 2}` is not scalar
+        s = s[tm.end():].strip()
+    if not s or s[0] in "{[\"":
+        return None  # array / tuple / hex-string literal
+    low = s.lower()
+    if low in ("true", "false"):
+        return 1.0 if low == "true" else 0.0
+    if low in ("inf", "+inf", "-inf", "nan"):
+        return float(low)
+    if _LITERAL_NUM_RE.fullmatch(s):
+        return float(s)
+    return None
+
+
+def constant_value(op: "HloOp") -> Optional[float]:
+    """The scalar a ``constant`` op defines; None for anything else.
+    The HVD503 gradient-scale rules resolve explicit divide/multiply
+    scale factors through this accessor."""
+    return op.literal if op.opcode == "constant" else None
+
+
 # StableHLO op header: `%23 = "stablehlo.all_reduce"(%22) <{...}> ({`
 # or `%0 = stablehlo.dot_general %arg0, %arg1, ... : (T, T) -> T`
 # or `stablehlo.return %25 : tensor<f32>` / `return %1 : tensor<...>`.
@@ -316,7 +365,8 @@ def _parse_stablehlo(text: str, path: str) -> HloProgram:
         elif typesig:
             result_types = tuple(_mlir_types(typesig))
         op = HloOp(lineno, result, opcode, _operand_names(body),
-                   operand_types, result_types, rest.strip(), scope)
+                   operand_types, result_types, rest.strip(), scope,
+                   parse_literal(body) if opcode == "constant" else None)
         ops.append(op)
         # `({` with no matching `})` on the same line opens a region
         if rest.count("({") > rest.count("})"):
@@ -384,7 +434,9 @@ def _parse_hlo_text(text: str, path: str) -> HloProgram:
             opcode = opcode.replace("-", "_")
             op = HloOp(lineno, result, opcode, _operand_names(args),
                        tuple(_hlo_types(args)), tuple(_hlo_types(typetext)),
-                       attrs.strip(", "), scope)
+                       attrs.strip(", "), scope,
+                       parse_literal(args) if opcode == "constant"
+                       else None)
             ops.append(op)
             if opcode == "parameter":
                 pm = re.match(r"\s*(\d+)", args)

@@ -77,6 +77,7 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.ckpt.async_ckpt",
     "horovod_tpu.observability.perfboard",
     "horovod_tpu.analysis.schedule",
+    "horovod_tpu.analysis.numerics",
 )
 
 _LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))

@@ -123,6 +123,10 @@ TRACKED: Dict[str, int] = {
     # bytes creeping above measurement when the cost model and the
     # comms_by_axis classifier drift apart.
     "comms_model.predicted_vs_measured": +1,
+    # HVD5xx findings on the compiled gspmd step: 0 today (the num-lint
+    # gate keeps it there), so any upward step is a numerics regression
+    # — a new low-precision accumulation or a gradient-scale drift.
+    "numerics.findings": +1,
 }
 
 #: The conv sections — the ROADMAP item 2 MFU campaign rides these.

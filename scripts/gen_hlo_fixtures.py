@@ -485,6 +485,194 @@ ENTRY main {
 """
 
 
+# ---------------------------------------------------- HVD5xx (hvdnum)
+
+def _dot_text(widen):
+    """bf16 matmul accumulating in bf16 (HVD501 positive) vs the free
+    fix: preferred_element_type=f32 keeps MXU inputs narrow and
+    accumulates wide (clean twin)."""
+    if widen:
+        f = jax.jit(lambda x, w: jnp.matmul(
+            x, w, preferred_element_type=jnp.float32))
+    else:
+        f = jax.jit(lambda x, w: x @ w)
+    return f.lower(jnp.ones((128, 256), jnp.bfloat16),
+                   jnp.ones((256, 128), jnp.bfloat16)).as_text()
+
+
+def hvd501_bf16_dot():
+    return _dot_text(widen=False)
+
+
+def hvd501_f32_accum():
+    return _dot_text(widen=True)
+
+
+def _downcast_reduce_text(downcast_first):
+    """Gradient downcast on the WRONG side of its all-reduce: casting
+    to bf16 before the psum rounds every summand first (HVD502
+    positive); reducing in f32 and downcasting the single result is
+    the clean twin — one rounding, after the sum."""
+    mesh, n = _mesh()
+
+    def local(g):
+        if downcast_first:
+            return lax.psum(g.astype(jnp.bfloat16), "hvd")
+        return lax.psum(g, "hvd").astype(jnp.bfloat16)
+
+    f = jax.shard_map(local, mesh=mesh, in_specs=P(),
+                      out_specs=P(), check_vma=False)
+    return jax.jit(f).lower(
+        jnp.ones((512, 512), jnp.float32)).as_text()
+
+
+def hvd502_downcast_then_reduce():
+    return _downcast_reduce_text(downcast_first=True)
+
+
+def hvd502_reduce_then_downcast():
+    return _downcast_reduce_text(downcast_first=False)
+
+
+def _grad_scale_text(divisor):
+    """Hand-authored post-SPMD text (deterministic, no lowering): a
+    4-member-group gradient all-reduce followed by an explicit divide.
+    Dividing by the WORLD size 8 (printed in scientific notation, as
+    XLA does — the literal-parser satellite) is the baked-constant
+    HVD503 positive: stale the moment an elastic rescale changes the
+    group. Dividing by the reducing group's own size 4 is the true
+    mean, the clean twin."""
+    return """HloModule grad_scale, num_partitions=8
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %p0), replica_groups={{0,1,2,3},{4,5,6,7}}, use_global_device_ids=true, channel_id=1, to_apply=%add
+  %c = f32[] constant(@DIV@)
+  %bc = f32[64]{0} broadcast(f32[] %c), dimensions={}
+  ROOT %d = f32[64]{0} divide(f32[64]{0} %ar, f32[64]{0} %bc)
+}
+""".replace("@DIV@", divisor)
+
+
+def hvd503_baked_world_divisor():
+    return _grad_scale_text("8e0")
+
+
+def hvd503_group_mean():
+    return _grad_scale_text("4")
+
+
+def hvd504_hazards():
+    """Hand-authored: all three HVD504 determinism hazards in one
+    module — a fused two-operand fp all-reduce (combining order across
+    the fused buffers is schedule-dependent), replica groups of
+    unequal sizes 6 and 2 (per-device combining trees differ in
+    shape), and a keyless ``rng`` op (implicit per-device generator
+    state does not survive a restore)."""
+    return """HloModule determinism_hazards, num_partitions=8
+
+%sum2 (a: f32[], b: f32[], c: f32[], d: f32[]) -> (f32[], f32[]) {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  %c = f32[] parameter(2)
+  %d = f32[] parameter(3)
+  %s0 = f32[] add(f32[] %a, f32[] %c)
+  %s1 = f32[] add(f32[] %b, f32[] %d)
+  ROOT %t = (f32[], f32[]) tuple(f32[] %s0, f32[] %s1)
+}
+
+ENTRY %main (p0: f32[64], p1: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %p1 = f32[64]{0} parameter(1)
+  %ar = (f32[64]{0}, f32[64]{0}) all-reduce(f32[64]{0} %p0, f32[64]{0} %p1), replica_groups={{0,1,2,3,4,5},{6,7}}, use_global_device_ids=true, channel_id=1, to_apply=%sum2
+  %g0 = f32[64]{0} get-tuple-element((f32[64]{0}, f32[64]{0}) %ar), index=0
+  %g1 = f32[64]{0} get-tuple-element((f32[64]{0}, f32[64]{0}) %ar), index=1
+  %lo = f32[] constant(0)
+  %hi = f32[] constant(1)
+  %noise = f32[64]{0} rng(f32[] %lo, f32[] %hi), distribution=rng_uniform
+  %s = f32[64]{0} add(f32[64]{0} %g0, f32[64]{0} %g1)
+  ROOT %out = f32[64]{0} add(f32[64]{0} %s, f32[64]{0} %noise)
+}
+"""
+
+
+def hvd504_keyed_clean():
+    """The clean twin: one tensor per all-reduce, equal-size groups,
+    and randomness drawn through ``rng-bit-generator`` — which threads
+    its state explicitly and so IS restore-deterministic (pins the
+    HVD504 rng exemption)."""
+    return """HloModule determinism_clean, num_partitions=8
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[64], p1: f32[64], state: u64[2]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %p1 = f32[64]{0} parameter(1)
+  %state = u64[2]{0} parameter(2)
+  %ar0 = f32[64]{0} all-reduce(f32[64]{0} %p0), replica_groups={{0,1,2,3},{4,5,6,7}}, use_global_device_ids=true, channel_id=1, to_apply=%add
+  %ar1 = f32[64]{0} all-reduce(f32[64]{0} %p1), replica_groups={{0,1,2,3},{4,5,6,7}}, use_global_device_ids=true, channel_id=2, to_apply=%add
+  %rbg = (u64[2]{0}, u32[64]{0}) rng-bit-generator(u64[2]{0} %state), algorithm=rng_default
+  %bits = u32[64]{0} get-tuple-element((u64[2]{0}, u32[64]{0}) %rbg), index=1
+  ROOT %out = f32[64]{0} add(f32[64]{0} %ar0, f32[64]{0} %ar1)
+}
+"""
+
+
+def _mesh_restore_text(n, mean):
+    """One half of the different-mesh-restore pair: the same step
+    lowered for an n-device mesh. The bare-sum halves disagree on the
+    effective multiplier (4 vs 8 — HVD505 fires when the pair is
+    linted as one set); the mean halves each divide by their OWN
+    group size, so the invariant holds under any mesh (clean twins).
+    Each half alone is HVD503-clean: a bare sum is legitimate Sum
+    semantics in-program, and the mean's divisor matches its group."""
+    groups = "{" + ",".join(str(i) for i in range(n)) + "}"
+    scale = """  %c = f32[] constant(@N@)
+  %bc = f32[64]{0} broadcast(f32[] %c), dimensions={}
+  ROOT %d = f32[64]{0} divide(f32[64]{0} %ar, f32[64]{0} %bc)""" \
+        if mean else "  ROOT %out = f32[64]{0} add(f32[64]{0} %ar, f32[64]{0} %ar)"
+    return """HloModule mesh@N@_step, num_partitions=@N@
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %p0), replica_groups={@G@}, use_global_device_ids=true, channel_id=1, to_apply=%add
+@SCALE@
+}
+""".replace("@SCALE@", scale).replace("@G@", groups).replace("@N@", str(n))
+
+
+def hvd505_mesh4_sum():
+    return _mesh_restore_text(4, mean=False)
+
+
+def hvd505_mesh8_sum():
+    return _mesh_restore_text(8, mean=False)
+
+
+def hvd505_mesh4_mean():
+    return _mesh_restore_text(4, mean=True)
+
+
+def hvd505_mesh8_mean():
+    return _mesh_restore_text(8, mean=True)
+
+
 FIXTURES = {
     "hvd201_giant_allreduce": hvd201_giant_allreduce,
     "hvd201_bucketed": hvd201_bucketed,
@@ -514,6 +702,18 @@ FIXTURES = {
     "hvd404_flat_allreduce": hvd404_flat_allreduce,
     "hvd404_staged_allreduce": hvd404_staged_allreduce,
     "comms_degenerate_group": comms_degenerate_group,
+    "hvd501_bf16_dot": hvd501_bf16_dot,
+    "hvd501_f32_accum": hvd501_f32_accum,
+    "hvd502_downcast_then_reduce": hvd502_downcast_then_reduce,
+    "hvd502_reduce_then_downcast": hvd502_reduce_then_downcast,
+    "hvd503_baked_world_divisor": hvd503_baked_world_divisor,
+    "hvd503_group_mean": hvd503_group_mean,
+    "hvd504_hazards": hvd504_hazards,
+    "hvd504_keyed_clean": hvd504_keyed_clean,
+    "hvd505_mesh4_sum": hvd505_mesh4_sum,
+    "hvd505_mesh8_sum": hvd505_mesh8_sum,
+    "hvd505_mesh4_mean": hvd505_mesh4_mean,
+    "hvd505_mesh8_mean": hvd505_mesh8_mean,
 }
 
 

@@ -377,6 +377,33 @@ def _check_sharded_section(name: str, val: dict) -> list:
                 "model and the measured comms_by_axis disagree on "
                 "what the program moves (wire-factor regression or a "
                 "group-classification split)")
+    num = val.get("numerics")
+    if not isinstance(num, dict):
+        errs.append(f"{name}: numerics stamp missing — accumulation "
+                    "dtypes and the gradient-scale table no longer "
+                    "ride beside the comms stamps "
+                    "(analysis/numerics.stamp)")
+    else:
+        if not isinstance(num.get("accum_dtypes"), list) \
+                or not num["accum_dtypes"]:
+            errs.append(f"{name}: numerics.accum_dtypes missing/empty "
+                        "— the compiled step reports no accumulation "
+                        "precision")
+        gs = num.get("grad_scale")
+        if not isinstance(gs, list) or not gs:
+            errs.append(f"{name}: numerics.grad_scale missing/empty — "
+                        "the gradient reductions lost their scale "
+                        "table (sum-vs-mean drift is now invisible)")
+        else:
+            for i, ent in enumerate(gs):
+                if not isinstance(ent, dict) or not isinstance(
+                        ent.get("group_size"), int):
+                    errs.append(f"{name}: numerics.grad_scale[{i}] "
+                                "carries no group_size")
+        if not isinstance(num.get("findings"), int):
+            errs.append(f"{name}: numerics.findings missing — the "
+                        "HVD5xx finding count can no longer be "
+                        "tracked across rounds")
     return errs
 
 

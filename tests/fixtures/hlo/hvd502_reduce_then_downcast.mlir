@@ -1,0 +1,19 @@
+module @jit_local attributes {mhlo.num_partitions = 8 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<512x512xf32>) -> (tensor<512x512xbf16> {jax.result_info = ""}) {
+    %0 = stablehlo.custom_call @Sharding(%arg0) {backend_config = "", mhlo.sharding = "{replicated}"} : (tensor<512x512xf32>) -> tensor<512x512xf32>
+    %1 = stablehlo.custom_call @SPMDFullToShardShape(%0) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<512x512xf32>) -> tensor<512x512xf32>
+    %2 = call @shmap_body(%1) : (tensor<512x512xf32>) -> tensor<512x512xbf16>
+    %3 = stablehlo.custom_call @Sharding(%2) {backend_config = "", mhlo.sharding = "{manual}"} : (tensor<512x512xbf16>) -> tensor<512x512xbf16>
+    %4 = stablehlo.custom_call @SPMDShardToFullShape(%3) {backend_config = "", mhlo.sharding = "{replicated}"} : (tensor<512x512xbf16>) -> tensor<512x512xbf16>
+    return %4 : tensor<512x512xbf16>
+  }
+  func.func private @shmap_body(%arg0: tensor<512x512xf32>) -> (tensor<512x512xbf16> {jax.result_info = "[None, None]"}) {
+    %0 = "stablehlo.all_reduce"(%arg0) <{channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>, replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>, use_global_device_ids}> ({
+    ^bb0(%arg1: tensor<f32>, %arg2: tensor<f32>):
+      %2 = stablehlo.add %arg1, %arg2 : tensor<f32>
+      stablehlo.return %2 : tensor<f32>
+    }) : (tensor<512x512xf32>) -> tensor<512x512xf32>
+    %1 = stablehlo.convert %0 : (tensor<512x512xf32>) -> tensor<512x512xbf16>
+    return %1 : tensor<512x512xbf16>
+  }
+}

@@ -1,0 +1,119 @@
+"""shard.group_axis_label edge cases (ISSUE 19 satellite).
+
+The ONE group-classification helper is now load-bearing three ways:
+``shard.comms_by_axis`` (the bench wire-traffic split),
+``schedule.comms_model`` (the HVD4xx analytic cost model), and the
+hvdnum gradient-scale stamp (``numerics.stamp`` axis attribution).
+These parametrized pins cover the shapes the inline callers only hit
+incidentally: degenerate single-device groups, groups spanning ALL
+mesh axes, V2 iota attrs (with and without a transpose), and the
+unparseable/unmatched fallbacks.
+"""
+
+import pytest
+
+from horovod_tpu.analysis import shard
+
+#: dp=2 x tp=4 over 8 flat C-order device ids: dp stride 4, tp stride 1.
+AXES_2D = [("dp", 2), ("tp", 4)]
+
+#: The 3-D hybrid layout with a dead pp axis: size-1 axes must never
+#: appear in a label.
+AXES_3D = [("dp", 2), ("pp", 1), ("tp", 2)]
+
+
+@pytest.mark.parametrize("groups,label", [
+    # single-axis partitions of the 2x4 mesh
+    ([[0, 1, 2, 3], [4, 5, 6, 7]], "tp"),
+    ([[0, 4], [1, 5], [2, 6], [3, 7]], "dp"),
+    # one group spanning ALL axes: the joined label, outermost first
+    ([list(range(8))], "dp+tp"),
+    # degenerate single-device groups: no wire moves, caller must skip
+    ([[d] for d in range(8)], None),
+    ([[3]], None),
+    ([], None),
+    # unparseable replica groups land under "other"
+    (None, "other"),
+    # real groups matching no axis partition land under "other"
+    ([[0, 2], [1, 3]], "other"),
+    # a PARTIAL axis cover is not that axis (half the tp rows only)
+    ([[0, 1, 2, 3]], "other"),
+    # mixed degenerate + real groups: the size-1 sets are dropped and
+    # the remainder is no canonical partition
+    ([[0], [1, 2]], "other"),
+])
+def test_group_axis_label_2d(groups, label):
+    partitions = shard._axis_partitions(AXES_2D)
+    assert shard.group_axis_label(groups, partitions) == label
+
+
+@pytest.mark.parametrize("groups,label", [
+    ([[0, 1], [2, 3]], "tp"),            # tp stride 1
+    ([[0, 2], [1, 3]], "dp"),            # dp stride 2 (pp collapsed)
+    ([list(range(4))], "dp+tp"),         # pp (size 1) never labeled
+    ([[d] for d in range(4)], None),
+])
+def test_group_axis_label_skips_dead_axes(groups, label):
+    partitions = shard._axis_partitions(AXES_3D)
+    assert shard.group_axis_label(groups, partitions) == label
+
+
+def test_axis_partitions_flat_c_order():
+    parts = shard._axis_partitions(AXES_2D)
+    # tp: contiguous runs; dp: stride-4 pairs; dp+tp: the full mesh
+    assert parts[frozenset({frozenset({0, 1, 2, 3}),
+                            frozenset({4, 5, 6, 7})})] == "tp"
+    assert parts[frozenset({frozenset({0, 4}), frozenset({1, 5}),
+                            frozenset({2, 6}), frozenset({3, 7})})] \
+        == "dp"
+    assert parts[frozenset({frozenset(range(8))})] == "dp+tp"
+    # size-1 axes contribute nothing
+    assert all("pp" not in lbl
+               for lbl in shard._axis_partitions(AXES_3D).values())
+
+
+# ------------------------------------------------------- V2 iota attrs
+
+def test_iota_v2_groups_parse_and_classify():
+    # [2,4]<=[8]: iota order, 2 groups of 4 — the tp rows of the 2x4
+    # mesh
+    groups = shard._parse_replica_groups("replica_groups=[2,4]<=[8]", 8)
+    assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    partitions = shard._axis_partitions(AXES_2D)
+    assert shard.group_axis_label(groups, partitions) == "tp"
+    # [4,2]<=[8]: 4 groups of 2 — no partition of the 2x4 mesh
+    groups = shard._parse_replica_groups("replica_groups=[4,2]<=[8]", 8)
+    assert groups == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert shard.group_axis_label(groups, partitions) == "other"
+
+
+def test_iota_v2_transpose_crosses_the_mesh():
+    # [4,2]<=[2,4]T(1,0): transpose the 2x4 iota, then split into 4
+    # groups of 2 — exactly the dp pairs of the 2x4 mesh
+    groups = shard._parse_replica_groups(
+        "replica_groups=[4,2]<=[2,4]T(1,0)", 8)
+    assert groups == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    partitions = shard._axis_partitions(AXES_2D)
+    assert shard.group_axis_label(groups, partitions) == "dp"
+
+
+@pytest.mark.parametrize("attrs", [
+    # bad permutation: not a permutation of the reshape dims
+    "replica_groups=[4,2]<=[2,4]T(0,0)",
+    # shape product mismatch
+    "replica_groups=[3,3]<=[8]",
+])
+def test_iota_v2_malformed_is_unparseable_not_wrong(attrs):
+    groups = shard._parse_replica_groups(attrs, 8)
+    assert groups is None
+    # and unparseable classifies as "other", never silently dropped
+    partitions = shard._axis_partitions(AXES_2D)
+    assert shard.group_axis_label(groups, partitions) == "other"
+
+
+def test_empty_and_absent_groups_are_full_mesh():
+    partitions = shard._axis_partitions(AXES_2D)
+    for attrs in ("replica_groups={}", "channel_id=1"):
+        groups = shard._parse_replica_groups(attrs, 8)
+        assert groups == [list(range(8))]
+        assert shard.group_axis_label(groups, partitions) == "dp+tp"

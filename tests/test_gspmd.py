@@ -491,9 +491,18 @@ def test_perf_gate_sharded_section_checks():
                                 "tier": "ici"}},
             "predicted_vs_measured": 1.37,
         },
+        "numerics": {
+            "accum_dtypes": ["f32"],
+            "grad_scale": [{"opcode": "all_reduce", "dtype": "f32",
+                            "group_size": 2, "bytes": 8 << 20,
+                            "divisor": 2.0, "multiplier": 1.0,
+                            "axis": "dp"}],
+            "findings": 0, "clean": True,
+        },
     }
     assert pg._check_sharded_section("gspmd_hybrid", good) == []
-    for missing in ("mesh", "scaling", "comms_by_axis", "comms_model"):
+    for missing in ("mesh", "scaling", "comms_by_axis", "comms_model",
+                    "numerics"):
         bad = {k: v for k, v in good.items() if k != missing}
         errs = pg._check_sharded_section("gspmd_hybrid", bad)
         assert errs and missing in " ".join(errs)
@@ -517,6 +526,21 @@ def test_perf_gate_sharded_section_checks():
         "predicted_vs_measured": 1.0}
     errs = pg._check_sharded_section("gspmd_hybrid", bad)
     assert any("wire_bytes_per_step" in e for e in errs)
+    # ISSUE 19: the hvdnum stamp is STRUCTURALLY required too — accum
+    # dtypes, a non-empty gradient-scale table, and the finding count
+    bad = dict(good)
+    bad["numerics"] = {"accum_dtypes": [], "grad_scale": [],
+                       "findings": 0}
+    errs = pg._check_sharded_section("gspmd_hybrid", bad)
+    assert any("accum_dtypes missing/empty" in e for e in errs)
+    assert any("grad_scale missing/empty" in e for e in errs)
+    bad = dict(good)
+    bad["numerics"] = {"accum_dtypes": ["f32"],
+                       "grad_scale": [{"opcode": "all_reduce"}],
+                       "findings": "n/a"}
+    errs = pg._check_sharded_section("gspmd_hybrid", bad)
+    assert any("group_size" in e for e in errs)
+    assert any("numerics.findings" in e for e in errs)
     # check_bench routes gspmd sections through the sharded checks
     doc = {"extra": {"gspmd_hybrid": {k: v for k, v in good.items()
                                       if k != "scaling"}}}

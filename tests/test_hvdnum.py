@@ -1,0 +1,554 @@
+"""hvdnum suite (ISSUE 19 tentpole): static numerics &
+reduction-semantics verification (HVD5xx).
+
+The golden fixtures under ``tests/fixtures/hlo/`` (regenerate with
+``scripts/gen_hlo_fixtures.py``) pin every rule both ways hermetically:
+the bf16-accumulating dot vs its preferred_element_type=f32 twin
+(HVD501), downcast-then-reduce vs reduce-then-downcast (HVD502), the
+baked world-size divisor vs the true group mean (HVD503 — the stale
+elastic-scale footgun), all three determinism hazards vs the keyed
+clean twin (HVD504), and the different-mesh-restore pair whose bare
+sums disagree on the effective multiplier while the mean twins agree
+(HVD505, armed only when the pair is linted as ONE set). The literal
+parser satellite (scientific-notation + typed narrow-dtype constants)
+is pinned directly: a literal the parser cannot read is a silently
+missed HVD503 divisor.
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.analysis import hlo, numerics, num_rules
+from horovod_tpu.analysis.driver import Finding, run_cli
+
+HERE = os.path.dirname(__file__)
+FIXDIR = os.path.join(HERE, "fixtures", "hlo")
+
+#: The 2-D mesh the HVD503 fixture's groups live on: 4-member
+#: contiguous rows are the tp axis of a dp=2 x tp=4 layout.
+AXES_2D = [("dp", 2), ("tp", 4)]
+
+
+def fixture_text(name):
+    for ext in ("mlir", "hlo"):
+        p = os.path.join(FIXDIR, f"{name}.{ext}")
+        if os.path.exists(p):
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+    raise FileNotFoundError(name)
+
+
+def fixture_path(name):
+    for ext in ("mlir", "hlo"):
+        p = os.path.join(FIXDIR, f"{name}.{ext}")
+        if os.path.exists(p):
+            return p
+    raise FileNotFoundError(name)
+
+
+def rules_of(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+# ------------------------------------ literal parsing (satellite fix)
+
+@pytest.mark.parametrize("text,value", [
+    ("8", 8.0),
+    ("-3", -3.0),
+    ("0.125", 0.125),
+    ("8e0", 8.0),                      # scientific notation, no dot
+    ("1.25e-05", 1.25e-05),
+    ("-2.5E+2", -250.0),
+    (".5", 0.5),
+    ("bf16[] 8", 8.0),                 # typed narrow-dtype literal
+    ("f8e4m3fn[] 1.5e-2", 0.015),
+    ("f32[] -0.25", -0.25),
+    ("dense<1.250000e-01>", 0.125),    # StableHLO attr form
+    ("dense<8>", 8.0),
+    ("true", 1.0),
+    ("false", 0.0),
+    ("inf", float("inf")),
+])
+def test_parse_literal_scalars(text, value):
+    assert hlo.parse_literal(text) == value
+
+
+def test_parse_literal_nan():
+    got = hlo.parse_literal("nan")
+    assert got != got  # NaN compares unequal to itself
+
+
+@pytest.mark.parametrize("text", [
+    "f32[2] {1, 2}",                   # shaped: not a scalar
+    "{1, 2, 3}",
+    "dense<[1.0, 2.0]>",
+    '"hex blob"',
+    "u8[4] \"\\000\\001\\002\\003\"",
+    "",
+    "%operand",
+])
+def test_parse_literal_non_scalars_are_none(text):
+    assert hlo.parse_literal(text) is None
+
+
+def test_literal_captured_in_both_textual_forms():
+    p = hlo.parse("""HloModule m
+ENTRY main {
+  c = f32[] constant(1.25e-05)
+  ROOT r = f32[] add(c, c)
+}
+""", "<t>")
+    (c,) = [op for op in p.ops if op.opcode == "constant"]
+    assert c.literal == 1.25e-05
+    assert hlo.constant_value(c) == 1.25e-05
+    # non-constants never report a value
+    (add,) = [op for op in p.ops if op.opcode == "add"]
+    assert hlo.constant_value(add) is None
+    p = hlo.parse("""module @jit_f {
+  func.func public @main() -> (tensor<f32>) {
+    %cst = stablehlo.constant dense<2.500000e-01> : tensor<f32>
+    return %cst : tensor<f32>
+  }
+}
+""", "<t>")
+    (c,) = [op for op in p.ops if op.opcode == "constant"]
+    assert c.literal == 0.25
+
+
+# --------------------------------------------- dtype-flow propagation
+
+def _flow_of(np_, result):
+    (op,) = [o for o in np_.prog.ops if o.result == result]
+    return np_.flow[(op.scope, op.result)]
+
+
+def test_flow_tracks_narrowing_convert():
+    np_ = numerics.analyze_text("""HloModule m
+ENTRY %main (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %n = bf16[64]{0} convert(f32[64]{0} %p0)
+  ROOT %w = f32[64]{0} convert(bf16[64]{0} %n)
+}
+""")
+    narrow = _flow_of(np_, "%n")
+    assert narrow.dtype == "bf16" and narrow.width == 2
+    assert narrow.max_width == 4
+    assert narrow.narrowed_at is not None
+    # re-widening keeps the narrowing event: precision is already lost
+    wide = _flow_of(np_, "%w")
+    assert wide.dtype == "f32" and wide.width == 4
+    assert wide.narrowed_at is not None
+
+
+def test_flow_native_narrow_is_not_narrowed():
+    np_ = numerics.analyze_text("""HloModule m
+ENTRY %main (p0: bf16[64]) -> bf16[64] {
+  %p0 = bf16[64]{0} parameter(0)
+  ROOT %s = bf16[64]{0} add(bf16[64]{0} %p0, bf16[64]{0} %p0)
+}
+""")
+    f = _flow_of(np_, "%s")
+    assert f.dtype == "bf16" and f.narrowed_at is None
+
+
+# ------------------------------------------- the gradient-scale table
+
+#: Dividing a reduced gradient by a runtime value (the allreduced live
+#: group size) — the elastic-correct pattern the static scale rules
+#: must not second-guess.
+_DYNAMIC_SCALE_TEXT = """HloModule live_mean, num_partitions=8
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[64], live: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %live = f32[64]{0} parameter(1)
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %p0), replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, channel_id=1, to_apply=%add
+  ROOT %d = f32[64]{0} divide(f32[64]{0} %ar, f32[64]{0} %live)
+}
+"""
+
+
+def test_reduction_table_sum_mean_dynamic():
+    sum_prog = numerics.analyze_text(
+        fixture_text("hvd505_mesh8_sum"), "sum")
+    (r,) = sum_prog.reductions
+    assert r.group_size == 8 and r.divisor is None and not r.dynamic
+    assert r.multiplier == 8.0
+
+    mean_prog = numerics.analyze_text(
+        fixture_text("hvd505_mesh8_mean"), "mean")
+    (r,) = mean_prog.reductions
+    assert r.divisor == 8.0 and r.multiplier == 1.0
+
+    # divide by a runtime value (allreduced live group size — the
+    # elastic-correct pattern): dynamic, multiplier unknowable
+    dyn = numerics.analyze_text(_DYNAMIC_SCALE_TEXT)
+    (r,) = dyn.reductions
+    assert r.dynamic and r.multiplier is None
+
+
+def test_reciprocal_multiply_is_a_divisor():
+    np_ = numerics.analyze_text("""HloModule m, num_partitions=8
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %p0), replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, channel_id=1, to_apply=%add
+  %c = f32[] constant(0.125)
+  %bc = f32[64]{0} broadcast(f32[] %c), dimensions={}
+  ROOT %m = f32[64]{0} multiply(f32[64]{0} %ar, f32[64]{0} %bc)
+}
+""")
+    (r,) = np_.reductions
+    assert r.divisor == pytest.approx(8.0)
+    assert r.multiplier == pytest.approx(1.0)
+
+
+def test_integer_reductions_are_exempt():
+    np_ = numerics.analyze_text("""HloModule m, num_partitions=8
+add {
+  x = s32[] parameter(0)
+  y = s32[] parameter(1)
+  ROOT s = s32[] add(x, y)
+}
+ENTRY main {
+  p0 = s32[64]{0} parameter(0)
+  ROOT ar = s32[64]{0} all-reduce(p0), replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, channel_id=1, to_apply=add
+}
+""")
+    assert np_.reductions == []
+
+
+# ------------------------------------------------------------- HVD501
+
+def test_hvd501_bf16_dot_trips():
+    fs = numerics.lint_text(fixture_text("hvd501_bf16_dot"), "dot",
+                            select=["HVD501"])
+    assert rules_of(fs) == ["HVD501"]
+    msg = fs[0].message
+    assert "accumulates in bf16" in msg
+    assert "preferred_element_type=f32" in msg
+
+
+def test_hvd501_f32_accum_twin_clean():
+    assert numerics.lint_text(fixture_text("hvd501_f32_accum"),
+                              "widened", select=["HVD501"]) == []
+
+
+def test_hvd501_allow_accum_knob(monkeypatch):
+    monkeypatch.setenv("HOROVOD_NUM_ALLOW_ACCUM", "bf16")
+    assert numerics.lint_text(fixture_text("hvd501_bf16_dot"), "dot",
+                              select=["HVD501"]) == []
+    monkeypatch.setenv("HOROVOD_NUM_ALLOW_ACCUM", "f16")
+    assert numerics.lint_text(fixture_text("hvd501_bf16_dot"), "dot",
+                              select=["HVD501"]) != []
+
+
+def test_hvd501_allow_accum_typo_is_loud(monkeypatch):
+    monkeypatch.setenv("HOROVOD_NUM_ALLOW_ACCUM", "bfloat16")
+    with pytest.raises(ValueError, match="HOROVOD_NUM_ALLOW_ACCUM"):
+        numerics.lint_text(fixture_text("hvd501_bf16_dot"), "dot",
+                           select=["HVD501"])
+
+
+# ------------------------------------------------------------- HVD502
+
+def test_hvd502_downcast_then_reduce_trips():
+    fs = numerics.lint_text(
+        fixture_text("hvd502_downcast_then_reduce"), "downcast",
+        select=["HVD502"])
+    assert rules_of(fs) == ["HVD502"]
+    msg = fs[0].message
+    assert "downcast-then-reduce" in msg
+    assert "8-way" in msg  # names the reduction width
+    assert "convert at line" in msg
+
+
+def test_hvd502_reduce_then_downcast_twin_clean():
+    assert numerics.lint_text(
+        fixture_text("hvd502_reduce_then_downcast"), "post",
+        select=["HVD502"]) == []
+
+
+def test_hvd502_payload_floor(monkeypatch):
+    monkeypatch.setenv("HOROVOD_NUM_MIN_REDUCE_BYTES", "1G")
+    assert numerics.lint_text(
+        fixture_text("hvd502_downcast_then_reduce"), "downcast",
+        select=["HVD502"]) == []
+
+
+def test_hvd502_malformed_floor_is_loud(monkeypatch):
+    monkeypatch.setenv("HOROVOD_NUM_MIN_REDUCE_BYTES", "lots")
+    with pytest.raises(ValueError, match="HOROVOD_NUM_MIN_REDUCE_BYTES"):
+        numerics.lint_text(
+            fixture_text("hvd502_downcast_then_reduce"), "downcast",
+            select=["HVD502"])
+
+
+# ------------------------------------------------------------- HVD503
+
+def test_hvd503_baked_world_divisor_trips():
+    fs = numerics.lint_text(
+        fixture_text("hvd503_baked_world_divisor"), "baked",
+        select=["HVD503"])
+    assert rules_of(fs) == ["HVD503"]
+    msg = fs[0].message
+    assert "4-member group" in msg
+    assert "divides by 8" in msg
+    assert "elastic rescale" in msg
+    assert "0.5x" in msg  # the effective-LR shift, k/divisor
+
+
+def test_hvd503_group_mean_twin_clean():
+    assert numerics.lint_text(fixture_text("hvd503_group_mean"),
+                              "mean", select=["HVD503"]) == []
+
+
+def test_hvd503_arbitrary_constant_is_not_a_world_size():
+    # dividing by 100 (a 0.01 learning rate, folded) matches no
+    # structural count of the program: legitimate math, not a stale
+    # group size
+    text = fixture_text("hvd503_baked_world_divisor").replace(
+        "constant(8e0)", "constant(100)")
+    assert numerics.lint_text(text, "lr", select=["HVD503"]) == []
+
+
+def test_hvd503_bare_sum_is_legitimate_in_program():
+    for name in ("hvd505_mesh4_sum", "hvd505_mesh8_sum"):
+        assert numerics.lint_text(fixture_text(name), name,
+                                  select=["HVD503"]) == []
+
+
+def test_hvd503_scale_tol_knob(monkeypatch):
+    # 7.95 is "the world size 8" under a 2% tolerance (XLA folds
+    # divides into printed-decimal reciprocals) and an arbitrary
+    # constant under a tight one
+    text = fixture_text("hvd503_baked_world_divisor").replace(
+        "constant(8e0)", "constant(7.95)")
+    monkeypatch.setenv("HOROVOD_NUM_SCALE_TOL", "0.02")
+    assert numerics.lint_text(text, "t", select=["HVD503"]) != []
+    monkeypatch.setenv("HOROVOD_NUM_SCALE_TOL", "1e-6")
+    assert numerics.lint_text(text, "t", select=["HVD503"]) == []
+
+
+def test_hvd503_malformed_tol_is_loud(monkeypatch):
+    monkeypatch.setenv("HOROVOD_NUM_SCALE_TOL", "tight")
+    with pytest.raises(ValueError, match="HOROVOD_NUM_SCALE_TOL"):
+        numerics.lint_text(fixture_text("hvd503_baked_world_divisor"),
+                           "baked", select=["HVD503"])
+
+
+# ------------------------------------------------------------- HVD504
+
+def test_hvd504_all_three_hazards_trip():
+    fs = numerics.lint_text(fixture_text("hvd504_hazards"), "hazards",
+                            select=["HVD504"])
+    assert rules_of(fs) == ["HVD504"]
+    msgs = " | ".join(f.message for f in fs)
+    assert "multi-operand fp reduction" in msgs
+    assert "reduction-tree shape divergence" in msgs
+    assert "[2, 6]" in msgs  # names the diverging group sizes
+    assert "keyless rng" in msgs
+    assert len(fs) == 3
+
+
+def test_hvd504_keyed_clean_twin():
+    # one tensor per reduce, equal groups, rng-bit-generator (explicit
+    # state) — restore-deterministic
+    assert numerics.lint_text(fixture_text("hvd504_keyed_clean"),
+                              "keyed", select=["HVD504"]) == []
+
+
+# ------------------------------------------------------------- HVD505
+
+def test_hvd505_sum_pair_trips_as_one_set():
+    fs = numerics.lint_files(
+        [fixture_path("hvd505_mesh4_sum"),
+         fixture_path("hvd505_mesh8_sum")], select=["HVD505"])
+    assert rules_of(fs) == ["HVD505"]
+    msg = fs[0].message
+    assert "multiplier 8" in msg and "(group 4)" in msg
+    assert "2x" in msg  # the effective-LR change on restore
+    assert "hvd505_mesh4_sum" in msg  # names the mesh twin
+
+
+def test_hvd505_mean_pair_invariant_holds():
+    assert numerics.lint_files(
+        [fixture_path("hvd505_mesh4_mean"),
+         fixture_path("hvd505_mesh8_mean")], select=["HVD505"]) == []
+
+
+def test_hvd505_vacuous_on_single_program():
+    assert numerics.lint_files([fixture_path("hvd505_mesh4_sum")],
+                               select=["HVD505"]) == []
+
+
+def test_hvd505_different_reduction_counts_not_a_pair():
+    # a program with 0 reductions next to one with 1: not a lowering
+    # pair of the same step, no diff
+    fs = numerics.lint_files(
+        [fixture_path("hvd505_mesh4_sum"),
+         fixture_path("hvd501_bf16_dot")], select=["HVD505"])
+    assert fs == []
+
+
+def test_hvd505_dynamic_scale_is_skipped():
+    nprogs = [numerics.analyze_text(fixture_text("hvd505_mesh4_sum"),
+                                    "sum4"),
+              numerics.analyze_text(_DYNAMIC_SCALE_TEXT, "dyn")]
+    assert numerics.lint_programs(nprogs, select=["HVD505"]) == []
+
+
+# --------------------------------------------------- the bench stamp
+
+def test_stamp_structure_and_axis_attribution():
+    st = numerics.stamp(fixture_text("hvd503_group_mean"),
+                        axis_sizes=AXES_2D, path="mean")
+    assert st["clean"] is True and st["findings"] == 0
+    assert "f32" in st["accum_dtypes"]
+    (ent,) = st["grad_scale"]
+    assert ent["opcode"] == "all_reduce"
+    assert ent["group_size"] == 4
+    assert ent["divisor"] == 4.0
+    assert ent["multiplier"] == 1.0
+    # the 4-member contiguous rows are the tp axis of the 2x4 mesh —
+    # classified by the SAME shard.group_axis_label the comms stamps use
+    assert ent["axis"] == "tp"
+
+
+def test_stamp_counts_findings_by_rule():
+    st = numerics.stamp(fixture_text("hvd503_baked_world_divisor"),
+                        path="baked")
+    assert st["clean"] is False
+    assert st["findings"] == 1
+    assert st["rules"] == {"HVD503": 1}
+    (ent,) = st["grad_scale"]
+    assert ent["multiplier"] == 0.5
+    assert "axis" not in ent  # no axis_sizes given
+
+
+def test_stamp_reports_low_precision_accum():
+    st = numerics.stamp(fixture_text("hvd501_bf16_dot"), path="dot")
+    assert st["accum_dtypes"] == ["bf16"]
+    assert st["rules"] == {"HVD501": 1}
+
+
+# --------------------------------------------------------- driver CLI
+
+def test_cli_num_fires_and_twin_clean(capsys):
+    rc = run_cli(["--num", fixture_path("hvd503_baked_world_divisor")])
+    assert rc == 1
+    assert "HVD503" in capsys.readouterr().out
+    rc = run_cli(["--num", fixture_path("hvd503_group_mean")])
+    assert rc == 0
+    assert "hvdnum: clean" in capsys.readouterr().out
+
+
+def test_cli_num_select_filters_family(capsys):
+    baked = fixture_path("hvd503_baked_world_divisor")
+    assert run_cli(["--num", baked, "--select", "HVD501"]) == 0
+    capsys.readouterr()
+    assert run_cli(["--num", baked, "--select", "HVD503"]) == 1
+    assert "HVD503" in capsys.readouterr().out
+
+
+def test_cli_num_pair_is_one_set(capsys):
+    rc = run_cli(["--num", fixture_path("hvd505_mesh4_sum"),
+                  fixture_path("hvd505_mesh8_sum"),
+                  "--select", "HVD505"])
+    assert rc == 1
+    assert "HVD505" in capsys.readouterr().out
+
+
+def test_cli_num_json_and_baselines(tmp_path, capsys):
+    rc = run_cli(["--num", fixture_path("hvd503_baked_world_divisor"),
+                  "--format", "json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["count"] == 1
+    assert doc["findings"][0]["rule"] == "HVD503"
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps(doc))
+    assert run_cli(["--num",
+                    fixture_path("hvd503_baked_world_divisor"),
+                    "--baseline", str(base)]) == 0
+    # the checked-in baseline is EMPTY: any finding fails the gate
+    assert run_cli(["--num",
+                    fixture_path("hvd503_baked_world_divisor"),
+                    "--baseline",
+                    os.path.join(HERE, "..", "scripts",
+                                 "hvdnum_baseline.json")]) == 1
+    capsys.readouterr()
+
+
+def test_cli_num_composes_with_sched(capsys):
+    # one invocation, two families, findings sorted into one stream
+    rc = run_cli(["--num", "--sched",
+                  fixture_path("hvd503_baked_world_divisor"),
+                  "--select", "HVD503,HVD401"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "HVD503" in out
+
+
+def test_cli_list_rules_covers_hvd5xx(capsys):
+    assert run_cli(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("HVD501", "HVD502", "HVD503", "HVD504", "HVD505"):
+        assert rid in out
+        line = next(ln for ln in out.splitlines() if ln.startswith(rid))
+        assert "[--num]" in line
+
+
+def test_cli_malformed_num_env_exits_2(monkeypatch, capsys):
+    monkeypatch.setenv("HOROVOD_NUM_ALLOW_ACCUM", "bogus")
+    rc = run_cli(["--num", fixture_path("hvd501_bf16_dot")])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "hvdnum" in err and "HOROVOD_NUM_ALLOW_ACCUM" in err
+
+
+def test_every_documented_rule_is_registered():
+    """The satellite contract: every HVD\\d{3} id the docs mention is
+    derivable from the driver — its own AST registry, a registered
+    HLO-rule family (driver.HLO_RULE_FAMILIES, which feeds
+    --list-rules), or the two structural ids (HVD000 suppression
+    hygiene, HVD999 unreadable input)."""
+    import re as _re
+    from horovod_tpu.analysis import driver
+    doc = os.path.join(HERE, "..", "docs", "static_analysis.md")
+    with open(doc, encoding="utf-8") as f:
+        documented = set(_re.findall(r"HVD\d{3}", f.read()))
+    assert documented  # the doc exists and names rules
+    registered = set(driver.registry()) | {driver.HVD000, "HVD999"}
+    for fam in driver.family_registries().values():
+        registered |= set(fam)
+    missing = documented - registered
+    assert not missing, f"documented but unregistered: {sorted(missing)}"
+    # and the new family is part of the derivation, not hand-listed
+    assert {"HVD501", "HVD502", "HVD503", "HVD504",
+            "HVD505"} <= registered
+
+
+# ------------------------------------------------------------ metrics
+
+def test_record_metrics_counts_by_rule():
+    from horovod_tpu.observability import metrics as m
+    numerics.record_metrics([])  # clean run still registers the family
+    fam = m.registry().peek("hvdnum_findings_total")
+    assert fam is not None and fam.kind == "counter"
+    numerics.record_metrics([Finding("p", 1, "HVD503", "x"),
+                             Finding("p", 2, "HVD503", "y")])
+    assert fam.labels(rule="HVD503").value >= 2

@@ -585,6 +585,14 @@ def _gspmd_section():
                                 "tier": "ici"}},
             "predicted_vs_measured": 1.0,
         },
+        "numerics": {
+            "accum_dtypes": ["f32"],
+            "grad_scale": [{"opcode": "all_reduce", "dtype": "f32",
+                            "group_size": 2, "bytes": 1,
+                            "divisor": None, "multiplier": 2.0,
+                            "axis": "dp"}],
+            "findings": 0, "clean": True,
+        },
     }, **_ckpt_section()}
 
 
