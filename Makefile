@@ -30,6 +30,12 @@
 #                    — SIGKILL one replica under continuous load; zero
 #                    accepted requests dropped, p99 bounded through the
 #                    failover, hvddoctor names the dead replica
+#   make trace-smoke hvdtrace causal tracing (docs/observability.md):
+#                    span model / cross-process propagation / doctor
+#                    join unit suite plus the traced serving e2e — a
+#                    requeued-after-SIGKILL request's trace must carry
+#                    BOTH dispatch attempts, and the slowest request
+#                    must split into queue/dispatch/device time
 #   make ckpt-smoke  async checkpointing + exactly-once elastic resume
 #                    (docs/checkpointing.md): the manifest/commit-
 #                    protocol + sharded-snapshot + AsyncCheckpointer +
@@ -83,9 +89,9 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest -q
 
-.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline sched-lint sched-lint-baseline num-lint num-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke
+.PHONY: test test-fast test-unit test-multiprocess test-e2e chaos entry native bench lint lint-baseline hlo-lint hlo-lint-baseline shard-lint shard-lint-baseline sched-lint sched-lint-baseline num-lint num-lint-baseline gspmd-smoke metrics race doctor-smoke serve-smoke trace-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke
 
-test: lint hlo-lint shard-lint sched-lint num-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke entry
+test: lint hlo-lint shard-lint sched-lint num-lint gspmd-smoke test-unit test-multiprocess test-e2e chaos doctor-smoke serve-smoke trace-smoke watch-smoke ckpt-smoke kv-ha-smoke fusion-smoke conv-smoke perf-gate perfboard-smoke entry
 
 test-fast:
 	$(PYTEST) tests/ --ignore=tests/test_multiprocess.py \
@@ -132,6 +138,15 @@ watch-smoke:
 serve-smoke:
 	$(PYTEST) tests/test_serve.py
 	$(PYTEST) tests/test_serve_e2e.py --run-faults -m faults
+
+# hvdtrace causal tracing (docs/observability.md): the span-model /
+# propagation / doctor-join unit suite runs in tier 1 too; the traced
+# 2-process serving e2e (faults marker — requeue-after-SIGKILL must
+# carry both dispatch attempts) only here.
+trace-smoke:
+	$(PYTEST) tests/test_tracing.py
+	$(PYTEST) tests/test_serve_e2e.py --run-faults -m faults \
+	    -k trace
 
 # Async checkpointing + exactly-once elastic resume
 # (docs/checkpointing.md): the deterministic unit suite runs in tier 1
@@ -324,7 +339,7 @@ race:
 	env HOROVOD_RACE_CHECK=1 $(PYTEST) tests/test_race.py \
 	    tests/test_timeline.py tests/test_metrics.py \
 	    tests/test_flight.py tests/test_perfscope.py \
-	    tests/test_watch.py \
+	    tests/test_tracing.py tests/test_watch.py \
 	    tests/test_elastic.py tests/test_runner.py tests/test_secret.py \
 	    tests/test_hvdlint.py tests/test_hvdnum.py \
 	    tests/test_group_axis_label.py \

@@ -828,6 +828,42 @@ def bench_bert_adasum(on_cpu, steps=10, warmup=3):
     return out
 
 
+def _serving_trace_stamp():
+    """hvdtrace evidence for the serving section: the loopback bench
+    runs every plane in one process, so the in-process tracer holds the
+    full client → frontend → batcher → pool → replica → engine span
+    tree. Join it with the doctor's own analyzer and stamp the slowest
+    request's queue/dispatch/device split — perf_gate requires this
+    block structurally (a serving bench without trace evidence is an
+    observability regression, not just a perf one)."""
+    from horovod_tpu.observability import doctor, tracing
+    tr = tracing.get()
+    stats = tr.stats()
+    report = doctor.analyze_traces([tr.payload()]) or {}
+    slowest = report.get("slowest") or []
+    pick = next((e for e in slowest if e.get("complete")),
+                slowest[0] if slowest else None)
+
+    def ms(v):
+        return round(v * 1e3, 3) if isinstance(v, (int, float)) else None
+
+    return {
+        "version": tracing.TRACE_VERSION,
+        "sampled": stats.get("started", 0),
+        "finished": stats.get("finished", 0),
+        "requests_joined": report.get("requests", 0),
+        "complete": report.get("complete", 0),
+        "slowest": {
+            "trace_id": pick.get("trace_id"),
+            "rid": pick.get("rid"),
+            "total_ms": ms(pick.get("total_s")),
+            "queue_ms": ms(pick.get("queue_s")),
+            "dispatch_ms": ms(pick.get("dispatch_s")),
+            "device_ms": ms(pick.get("device_s")),
+        } if pick else None,
+    }
+
+
 def bench_serving(on_cpu, duration=None, threads=8):
     """Serving tier under load (docs/serving.md): an in-process
     loopback replica pool — frontend → continuous batcher → per-bucket
@@ -841,6 +877,7 @@ def bench_serving(on_cpu, duration=None, threads=8):
     both replicas share device 0, so chips=1 in the per-chip rate."""
     import threading as th
 
+    from horovod_tpu.observability import tracing
     from horovod_tpu.runner import secret as secret_mod
     from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
     from horovod_tpu.serve.batching import ContinuousBatcher
@@ -848,6 +885,13 @@ def bench_serving(on_cpu, duration=None, threads=8):
     from horovod_tpu.serve.frontend import Frontend, ServeClient
     from horovod_tpu.serve.pool import ReplicaPool
     from horovod_tpu.serve.replica import ReplicaServer
+
+    # Force hvdtrace on for this section (restored below): the stamped
+    # `trace` block must be deterministic regardless of the caller's
+    # environment, because perf_gate fails the round without it.
+    prev_trace_env = os.environ.get(tracing.TRACE_ENV)
+    os.environ[tracing.TRACE_ENV] = "1"
+    tracing.reset_for_tests()
 
     duration = duration or (2.0 if on_cpu else 6.0)
     # lane-aligned dims: the engine's own hvdhlo stamp (HVD204) holds
@@ -954,6 +998,7 @@ def bench_serving(on_cpu, duration=None, threads=8):
             "load_threads": threads,
             "hlo_lint": replicas[0].engine.hlo_lint() or None,
             "client_errors": errors[:5] or None,
+            "trace": _serving_trace_stamp(),
         }
     finally:
         stop_load.set()
@@ -964,6 +1009,11 @@ def bench_serving(on_cpu, duration=None, threads=8):
         for rep in replicas:
             rep.stop()
         rdv.stop()
+        if prev_trace_env is None:
+            os.environ.pop(tracing.TRACE_ENV, None)
+        else:
+            os.environ[tracing.TRACE_ENV] = prev_trace_env
+        tracing.reset_for_tests()
 
 
 # --------------------------------------------------------------------------

@@ -65,6 +65,7 @@ DEFAULT_MODULES: Tuple[str, ...] = (
     "horovod_tpu.profiler.perfscope",
     "horovod_tpu.observability.metrics",
     "horovod_tpu.observability.flight",
+    "horovod_tpu.observability.tracing",
     "horovod_tpu.observability.watch",
     "horovod_tpu.elastic.driver",
     "horovod_tpu.runner.rendezvous",

@@ -66,6 +66,18 @@ def _require_secret(secret: Optional[bytes]) -> bytes:
 
 def _send_frame(sock: socket.socket, obj: Any,
                 secret: Optional[bytes]) -> None:
+    # hvdtrace context propagation: when a sampled trace is ambient on
+    # this thread, the frame object is wrapped so the causal identifier
+    # crosses the process boundary. No wire-format change — the whole
+    # object is pickled either way, and _recv_frame unwraps
+    # transparently (observability/tracing.py).
+    try:
+        from horovod_tpu.observability import tracing
+        ctx = tracing.current_context()
+        if ctx is not None and not tracing.suppressed():
+            obj = {"__hvdtrace__": ctx, "o": obj}
+    except Exception:
+        pass  # tracing must never break the data plane
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     digest = (secret_mod.compute_digest(secret, "FRAME", "data", payload)
               .encode() if secret else b"")
@@ -99,7 +111,19 @@ def _recv_frame(sock: socket.socket, secret: Optional[bytes]) -> Any:
         if not secret_mod.check_digest(secret, "FRAME", "data", payload,
                                        digest.decode() if digest else None):
             raise DataServiceError("bad or missing frame HMAC")
-    return pickle.loads(payload)
+    obj = pickle.loads(payload)
+    if isinstance(obj, dict) and "__hvdtrace__" in obj and "o" in obj:
+        # A trace context rode this frame: make it the receiving
+        # thread's ambient parent, then hand the caller the original
+        # object. Server loops clear the ambient context after each
+        # handled request (_serve) so it cannot leak across requests.
+        try:
+            from horovod_tpu.observability import tracing
+            tracing.adopt(obj["__hvdtrace__"])
+        except Exception:
+            pass
+        obj = obj["o"]
+    return obj
 
 
 def _routable_local_addr(peer: Tuple[str, int]) -> str:
@@ -153,6 +177,15 @@ def _serve(handler: Callable[[Any], Any], secret: Optional[bytes],
                     _send_frame(self.request, resp, secret)
                 except (ConnectionError, OSError):
                     return
+                finally:
+                    # A traced request's adopted context must not leak
+                    # into the NEXT request on this persistent
+                    # connection (the reply above still rides it).
+                    try:
+                        from horovod_tpu.observability import tracing
+                        tracing.clear()
+                    except Exception:
+                        pass
 
     srv = _FrameServer(("0.0.0.0", port), H)
     t = threading.Thread(target=srv.serve_forever, daemon=True)

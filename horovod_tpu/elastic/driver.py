@@ -609,11 +609,12 @@ def run_elastic(args, command: List[str], extra_env: Dict[str, str]) -> int:
         # operator at the doctor when the job failed. The perfscope
         # step-time summaries ride the same exit path (doctor's perf
         # section, profiler/perfscope.py).
-        from horovod_tpu.observability import flight, watch
+        from horovod_tpu.observability import flight, tracing, watch
         from horovod_tpu.profiler import perfscope
         tails = flight.persist_kv_tails(rdv)
         perfscope.persist_kv_summaries(rdv)
         watch.persist_kv_records(rdv)
+        tracing.persist_kv_spans(rdv)
         flight_dir = os.environ.get(flight.FLIGHT_DIR_ENV, "")
         if rc != 0 and flight_dir and (
                 tails or os.path.isdir(flight_dir)):

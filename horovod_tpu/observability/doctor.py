@@ -378,6 +378,108 @@ def load_watch_kv(addr: str, port: int, max_ranks: int = 256,
                     max_ranks=max_ranks, max_rounds=max_rounds)
 
 
+def _parse_trace(raw: bytes, source: str) -> Optional[Dict[str, Any]]:
+    """Parse one hvdtrace fragment payload (observability/tracing.py):
+    a local ``trace-*.json`` dump, a persisted ``trace-kv-*.json`` tail,
+    or a live ``trace/`` KV record. Version-gated and sanitized at the
+    boundary like every other doctor input."""
+    try:
+        body = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not (isinstance(body, dict)
+            and isinstance(body.get("traces"), list)
+            and body.get("version") is not None
+            and "stats" in body):
+        return None
+    from horovod_tpu.observability.tracing import TRACE_VERSION
+    try:
+        version = int(body["version"])
+    except (TypeError, ValueError):
+        version = TRACE_VERSION + 1
+    if version > TRACE_VERSION:
+        print(f"doctor: {source}: trace fragment version "
+              f"{body.get('version')} is newer than this tool "
+              f"understands; skipping", file=sys.stderr)
+        return None
+    clean = []
+    for t in body["traces"]:
+        if not isinstance(t, dict) or not t.get("tid") \
+                or not isinstance(t.get("spans"), list):
+            continue
+        spans = []
+        for sp in t["spans"]:
+            if not isinstance(sp, dict) or not sp.get("tid") \
+                    or not sp.get("sid"):
+                continue
+            try:
+                sp["t0"] = float(sp.get("t0", 0.0))
+                sp["dur"] = float(sp.get("dur", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if not isinstance(sp.get("attrs"), dict):
+                sp["attrs"] = {}
+            sp["status"] = str(sp.get("status", "ok"))
+            spans.append(sp)
+        if spans:
+            clean.append({**t, "spans": spans})
+    body["traces"] = clean
+    return body
+
+
+def load_trace_dir(d: str) -> List[Dict[str, Any]]:
+    """Parse the hvdtrace fragments on disk: per-process atexit/exit
+    dumps (``trace-<rank|pid>[.rN].json``) and the KV tails the
+    launcher persisted (``trace-kv-*.json``)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("trace-") or not name.endswith(".json") \
+                or ".tmp" in name:
+            continue
+        try:
+            with open(os.path.join(d, name), "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        rec = _parse_trace(raw, name)
+        if rec is not None:
+            out.append(rec)
+    return out
+
+
+def load_trace_kv(addr: str, port: int, max_ranks: int = 256,
+                  max_rounds: int = 64) -> List[Dict[str, Any]]:
+    """Scrape `trace/rank-<r>.r<round>` span tails from a live
+    rendezvous server."""
+    from horovod_tpu.observability.tracing import SCOPE as TRACE_SCOPE
+    return _scan_kv(addr, port, TRACE_SCOPE, _parse_trace,
+                    max_ranks=max_ranks, max_rounds=max_rounds)
+
+
+def dedupe_trace(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One fragment payload per (process, round) — keep the one
+    carrying the most spans (payloads are cumulative snapshots of the
+    same bounded store, so more spans = later/fuller)."""
+    best: Dict[Tuple, Tuple[int, Dict[str, Any]]] = {}
+    for r in records:
+        key = (str(r.get("hostname") or ""), r.get("pid"),
+               int(r.get("round", 0) or 0))
+        n = sum(len(t["spans"]) for t in r.get("traces", []))
+        cur = best.get(key)
+        if cur is None or n > cur[0]:
+            best[key] = (n, r)
+    ranked = sorted(best.values(),
+                    key=lambda p: (p[1].get("rank")
+                                   if p[1].get("rank") is not None
+                                   else 1 << 30,
+                                   int(p[1].get("round", 0) or 0)))
+    return [r for _, r in ranked]
+
+
 def dedupe_watch(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """One record per (rank, round) — keep the one carrying the most
     anomalies (records are cumulative, so more = later)."""
@@ -638,6 +740,142 @@ def analyze_serve(dumps: List[RankDump]) -> Optional[Dict[str, Any]]:
         "replicas": [replicas[k] for k in sorted(replicas)],
         "deaths": sorted(deaths, key=lambda x: x["time"]),
         "other_events": other[:10],
+    }
+
+
+def analyze_traces(records: List[Dict[str, Any]],
+                   perf: Optional[Dict[str, Any]] = None,
+                   serve: Optional[Dict[str, Any]] = None,
+                   slowest: int = 5) -> Optional[Dict[str, Any]]:
+    """The [traces] section: join per-process hvdtrace fragments into
+    whole causal traces (observability/tracing.py).
+
+    Fragments are joined by trace id — the client's ``serve.client``
+    span, the frontend's ``serve.request``/``serve.queue``, the pool's
+    per-attempt ``serve.dispatch`` + shared ``serve.batch``, and the
+    replica's ``replica.infer_batch``/``engine.execute`` all carry the
+    same id. Each reconstructed request names its
+    queue-vs-dispatch-vs-device split; a request that shared its batch
+    with another trace resolves its device time through the batch span
+    its dispatch named (the ``links`` stitch). Requests are
+    cross-referenced against the report's own perf stragglers and
+    [serve] replica deaths — a requeued request whose failed attempt
+    hit a known-dead replica says so."""
+    records = dedupe_trace(records)
+    if not records:
+        return None
+    # Join fragments by trace id; dedupe spans by span id (the same
+    # span can arrive via both a dump and a persisted KV tail).
+    spans_by_trace: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for rec in records:
+        for t in rec.get("traces", []):
+            cur = spans_by_trace.setdefault(str(t["tid"]), {})
+            for sp in t["spans"]:
+                old = cur.get(sp["sid"])
+                if old is None or sp["dur"] > old["dur"]:
+                    cur[sp["sid"]] = sp
+    # Device time per batch-execution span: engine.execute is a child
+    # of replica.infer_batch, whose parent IS the serve.batch span id.
+    device_by_batch: Dict[str, float] = {}
+    for spans in spans_by_trace.values():
+        for sp in spans.values():
+            if sp.get("name") == "replica.infer_batch" and sp.get("psid"):
+                dev = sp["dur"]
+                for ch in spans.values():
+                    if ch.get("psid") == sp["sid"] \
+                            and ch.get("name") == "engine.execute":
+                        dev = ch["dur"]
+                        break
+                device_by_batch[sp["psid"]] = max(
+                    device_by_batch.get(sp["psid"], 0.0), dev)
+    death_by_replica: Dict[str, Dict[str, Any]] = {}
+    replica_rank: Dict[str, int] = {}
+    for info in (serve or {}).get("replicas", []):
+        replica_rank[f"{info['host']}:{info['pid']}"] = info["rank"]
+    for dd in (serve or {}).get("deaths", []):
+        death_by_replica[f"{dd['host']}:{dd['pid']}"] = dd
+    straggler_phase: Dict[int, str] = {}
+    for s in (perf or {}).get("stragglers", []):
+        straggler_phase[int(s["rank"])] = str(s.get("dominant_phase"))
+    requests: List[Dict[str, Any]] = []
+    train_steps = 0
+    for tid, spans in spans_by_trace.items():
+        by_name: Dict[str, List[Dict[str, Any]]] = {}
+        for sp in spans.values():
+            by_name.setdefault(str(sp.get("name")), []).append(sp)
+        if "train.step" in by_name:
+            train_steps += 1
+        roots = by_name.get("serve.request")
+        if not roots:
+            continue
+        root = max(roots, key=lambda s: s["dur"])
+        queue = by_name.get("serve.queue")
+        attempts = sorted(by_name.get("serve.dispatch", []),
+                          key=lambda s: s["t0"])
+        device_s = None
+        eng = by_name.get("engine.execute")
+        if eng:
+            # This trace is the batch's primary: the replica fragment
+            # joined it directly.
+            device_s = max(s["dur"] for s in eng)
+        else:
+            # Linked request: its device time lives under the primary's
+            # trace — resolve through the batch id its dispatch named.
+            for a in reversed(attempts):
+                b = a["attrs"].get("batch")
+                if b in device_by_batch:
+                    device_s = device_by_batch[b]
+                    break
+        entry: Dict[str, Any] = {
+            "trace_id": tid,
+            "rid": root["attrs"].get("rid"),
+            "status": root.get("status", "ok"),
+            "requeues": int(root["attrs"].get("requeues", 0) or 0),
+            "total_s": root["dur"],
+            "queue_s": sum(s["dur"] for s in queue) if queue else None,
+            "dispatch_s": sum(s["dur"] for s in attempts)
+            if attempts else None,
+            "device_s": device_s,
+            "attempts": [{
+                "replica": a["attrs"].get("replica"),
+                "attempt": a["attrs"].get("attempt"),
+                "status": a.get("status", "ok"),
+                "dur_s": a["dur"],
+            } for a in attempts],
+            # The acceptance bar: every hop of the cross-process path
+            # reconstructed — queue, at least one dispatch, device.
+            "complete": bool(queue) and bool(attempts)
+            and device_s is not None,
+        }
+        notes: List[str] = []
+        for a in entry["attempts"]:
+            repl = a.get("replica")
+            if a.get("status") != "ok" and repl in death_by_replica:
+                notes.append(
+                    f"attempt {a.get('attempt')} hit replica death "
+                    f"(rank {death_by_replica[repl]['rank']}, "
+                    f"pid {death_by_replica[repl]['pid']})")
+        served_by = next((a for a in reversed(entry["attempts"])
+                          if a.get("status") == "ok"), None)
+        if served_by is not None:
+            r = replica_rank.get(served_by.get("replica"))
+            if r in straggler_phase:
+                notes.append(f"served by perf straggler rank {r} "
+                             f"({straggler_phase[r]})")
+        entry["corroborated_by"] = notes
+        requests.append(entry)
+    if not requests and not train_steps:
+        return None
+    requests.sort(key=lambda e: -(e["total_s"] or 0.0))
+    return {
+        "requests": len(requests),
+        "train_steps": train_steps,
+        "complete": sum(1 for e in requests if e["complete"]),
+        "slowest": requests[:slowest],
+        "errored": [e for e in requests
+                    if e["status"] != "ok"][:slowest],
+        "requeued": [e for e in requests
+                     if e["requeues"] > 0][:slowest],
     }
 
 
@@ -941,7 +1179,9 @@ def analyze_group(round_id: int, gid: int, dumps: List[RankDump]
 
 def merge(dumps: List[RankDump], tail: int = 8,
           perf: Optional[List[Dict[str, Any]]] = None,
-          watch: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+          watch: Optional[List[Dict[str, Any]]] = None,
+          traces: Optional[List[Dict[str, Any]]] = None
+          ) -> Dict[str, Any]:
     size = max((d.size for d in dumps if d.size), default=None)
     seen_ranks: set = set()
     for d in dumps:
@@ -976,6 +1216,9 @@ def merge(dumps: List[RankDump], tail: int = 8,
     }
     report["anomalies"] = analyze_anomalies(
         watch or [], perf=report["perf"], groups=groups)
+    report["traces"] = analyze_traces(
+        traces or [], perf=report["perf"],
+        serve=report["serve"]) if traces else None
     for d in dumps:
         info: Dict[str, Any] = {
             "rank": d.rank,
@@ -1047,6 +1290,16 @@ def _trajectory_lines(traj: Dict[str, Any]) -> List[str]:
     out.append("  full report: python -m "
                "horovod_tpu.observability.perfboard")
     return out
+
+
+def _trace_split(e: Dict[str, Any]) -> str:
+    """'queue X ms, dispatch Y ms, device Z ms' with '?' for hops the
+    joined fragments did not cover."""
+    def ms(v: Optional[float]) -> str:
+        return "?" if v is None else f"{v * 1e3:.1f} ms"
+    return (f"queue {ms(e.get('queue_s'))}, "
+            f"dispatch {ms(e.get('dispatch_s'))}, "
+            f"device {ms(e.get('device_s'))}")
 
 
 def render(report: Dict[str, Any], tail: int = 8) -> str:
@@ -1155,6 +1408,38 @@ def render(report: Dict[str, Any], tail: int = 8) -> str:
                 f"survivors")
         if not serve["deaths"]:
             add("  no replica deaths recorded")
+        add("")
+    traces = report.get("traces")
+    if traces:
+        add("[traces] hvdtrace request/step causality "
+            "(observability/tracing.py; docs/observability.md)")
+        add(f"  {traces['requests']} request trace(s) joined "
+            f"({traces['complete']} complete cross-process), "
+            f"{traces['train_steps']} train-step trace(s)")
+        for e in traces["slowest"]:
+            add(f"  SLOWEST request rid={e['rid']} "
+                f"trace={e['trace_id']}: "
+                f"{(e['total_s'] or 0) * 1e3:.1f} ms total "
+                f"({_trace_split(e)})")
+            for n in e.get("corroborated_by", []):
+                add(f"    — {n}")
+        for e in traces["requeued"]:
+            add(f"  REQUEUED request rid={e['rid']} "
+                f"trace={e['trace_id']}: {len(e['attempts'])} dispatch "
+                f"attempt(s) across replicas")
+            for a in e["attempts"]:
+                add(f"    attempt {a.get('attempt')} -> replica "
+                    f"{a.get('replica')}: {a.get('status')} "
+                    f"({(a.get('dur_s') or 0) * 1e3:.1f} ms)")
+            for n in e.get("corroborated_by", []):
+                add(f"    — {n}")
+        for e in traces["errored"]:
+            if e["requeues"] > 0:
+                continue  # already rendered above
+            add(f"  {e['status'].upper()} request rid={e['rid']} "
+                f"trace={e['trace_id']}: "
+                f"{(e['total_s'] or 0) * 1e3:.1f} ms "
+                f"({_trace_split(e)})")
         add("")
     ck = report.get("ckpt")
     if ck:
@@ -1266,9 +1551,15 @@ def render(report: Dict[str, Any], tail: int = 8) -> str:
 
 # ---------------------------------------------------------------- trace
 
-def export_trace(dumps: List[RankDump], path: str) -> None:
-    """Perfetto/about:tracing export: one track (pid) per process,
-    every flight event as an instant at its wall-clock time."""
+def export_trace(dumps: List[RankDump], path: str,
+                 traces: Optional[List[Dict[str, Any]]] = None) -> None:
+    """Perfetto/about:tracing export: one track (pid) per process —
+    every flight event as an instant at its wall-clock time, and (when
+    hvdtrace fragments are present) every span as a duration slice.
+    Span nesting gets DISTINCT thread tracks (tid = nesting depth, with
+    thread_name metadata) instead of one flat track, and cross-process
+    flow events (``ph:"s"``/``"f"``) stitch each request's dispatch
+    slice into the batch-execution slice it shared on the replica."""
     events: List[dict] = []
     for i, d in enumerate(dumps):
         # One track per PROCESS: rank numbers are reused across elastic
@@ -1289,6 +1580,86 @@ def export_trace(dumps: List[RankDump], path: str) -> None:
                 "cat": ev[2],
                 "args": {"seq": ev[0]},
             })
+    # hvdtrace span fragments: pid tracks continue after the dump ones.
+    emitted: List[Dict[str, Any]] = []
+    pid = len(dumps)
+    for rec in dedupe_trace(traces or []):
+        spans: List[Dict[str, Any]] = []
+        seen_sids: set = set()
+        for t in rec.get("traces", []):
+            for sp in t["spans"]:
+                if sp["sid"] in seen_sids:
+                    continue
+                seen_sids.add(sp["sid"])
+                spans.append(sp)
+        if not spans:
+            continue
+        label = (f"hvdtrace rank {rec['rank']}"
+                 if rec.get("rank") is not None
+                 else f"hvdtrace pid {rec.get('pid')}")
+        if rec.get("round"):
+            label += f" (round {rec['round']})"
+        if rec.get("hostname"):
+            label += f" @ {rec['hostname']}"
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": label}})
+        # tid = nesting depth within this process's fragments, so
+        # parent and child slices land on separate thread tracks.
+        by_sid = {sp["sid"]: sp for sp in spans}
+
+        def depth_of(sp: Dict[str, Any]) -> int:
+            d_, cur, hops = 0, sp, 0
+            while cur.get("psid") in by_sid and hops < 64:
+                nxt = by_sid[cur["psid"]]
+                if nxt is cur:
+                    break
+                d_, cur, hops = d_ + 1, nxt, hops + 1
+            return d_
+        depths_used: set = set()
+        for sp in spans:
+            depth = depth_of(sp)
+            depths_used.add(depth)
+            events.append({
+                "ph": "X", "pid": pid, "tid": depth,
+                "ts": sp["t0"] * 1e6, "dur": max(1.0, sp["dur"] * 1e6),
+                "name": str(sp.get("name")),
+                "cat": "hvdtrace",
+                "args": {"trace": sp["tid"], "span": sp["sid"],
+                         "status": sp.get("status", "ok"),
+                         **sp.get("attrs", {})},
+            })
+            emitted.append({**sp, "_pid": pid, "_tid": depth})
+        for depth in sorted(depths_used):
+            events.append({"ph": "M", "pid": pid, "tid": depth,
+                           "name": "thread_name",
+                           "args": {"name": f"span depth {depth}"}})
+        pid += 1
+    # Flow events: one arrow per (batch, request trace) pair, from the
+    # request's dispatch slice to the replica's batch-execution slice
+    # (falling back to the pool's serve.batch slice when the replica
+    # fragment never arrived). Ids are per-pair so N requests sharing
+    # one batch each get their own stitch.
+    targets: Dict[str, Dict[str, Any]] = {}
+    by_sid_all: Dict[str, Dict[str, Any]] = {}
+    for sp in emitted:
+        by_sid_all.setdefault(sp["sid"], sp)
+        if sp.get("name") == "replica.infer_batch" and sp.get("psid"):
+            targets.setdefault(sp["psid"], sp)
+    for sp in emitted:
+        if sp.get("name") != "serve.dispatch":
+            continue
+        batch = sp.get("attrs", {}).get("batch")
+        tgt = targets.get(batch) or by_sid_all.get(batch)
+        if tgt is None or tgt is sp:
+            continue
+        fid = f"{batch}:{sp['tid']}"
+        common = {"name": "batch", "cat": "hvdtrace.flow", "id": fid}
+        events.append({**common, "ph": "s", "pid": sp["_pid"],
+                       "tid": sp["_tid"],
+                       "ts": (sp["t0"] + sp["dur"] / 2) * 1e6})
+        events.append({**common, "ph": "f", "bp": "e",
+                       "pid": tgt["_pid"], "tid": tgt["_tid"],
+                       "ts": (tgt["t0"] + tgt["dur"] / 2) * 1e6})
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump({"displayTimeUnit": "ms", "traceEvents": events}, f)
@@ -1333,10 +1704,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     loaded: List[RankDump] = []
     perf: List[Dict[str, Any]] = []
     watch: List[Dict[str, Any]] = []
+    traces: List[Dict[str, Any]] = []
     if args.dir:
         loaded.extend(load_dir(args.dir))
         perf.extend(load_perf_dir(args.dir))
         watch.extend(load_watch_dir(args.dir))
+        traces.extend(load_trace_dir(args.dir))
     if args.kv:
         from horovod_tpu.runner.rendezvous import (
             HOROVOD_RENDEZVOUS_ADDRS, parse_endpoints)
@@ -1358,6 +1731,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         loaded.extend(load_kv(addr, port, max_ranks=args.max_ranks))
         perf.extend(load_perf_kv(addr, port, max_ranks=args.max_ranks))
         watch.extend(load_watch_kv(addr, port, max_ranks=args.max_ranks))
+        traces.extend(load_trace_kv(addr, port, max_ranks=args.max_ranks))
     trajectory = None
     if args.rounds:
         # Lazy import: doctor must stay usable on hosts without the
@@ -1382,16 +1756,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         build_parser().print_help(sys.stderr)
         return 2
     dumps = dedupe(loaded)
-    if not dumps and not perf and not watch:
+    if not dumps and not perf and not watch and not traces:
         print("doctor: no flight dumps found (is HOROVOD_FLIGHT_DIR set "
               "on the job, or the rendezvous server still up?)",
               file=sys.stderr)
         return 2
-    report = merge(dumps, tail=args.tail, perf=perf, watch=watch)
+    report = merge(dumps, tail=args.tail, perf=perf, watch=watch,
+                   traces=traces)
     if trajectory is not None:
         report["trajectory"] = trajectory
     if args.trace:
-        export_trace(dumps, args.trace)
+        export_trace(dumps, args.trace, traces=traces)
         print(f"doctor: wrote merged trace to {args.trace}",
               file=sys.stderr)
     if args.json:

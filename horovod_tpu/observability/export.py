@@ -169,6 +169,15 @@ class MetricsExporter:
                 perfscope.push_summary()
             except Exception:
                 pass
+            # Same cadence for the hvdtrace span tail
+            # (observability/tracing.py): the launcher persists the
+            # trace/ scope at job end so the doctor can join a
+            # SIGKILL'd worker's fragments offline.
+            try:
+                from horovod_tpu.observability import tracing
+                tracing.push_tail()
+            except Exception:
+                pass
             # hvdwatch detection pass (observability/watch.py): the
             # anomaly detectors consume the perfscope samples and
             # registry series accumulated since the last tick, escalate

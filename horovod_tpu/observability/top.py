@@ -13,10 +13,12 @@ rendezvous server (no new worker-side machinery):
 
 * the read-only ``GET /metrics`` Prometheus route (PR 2) — job-wide
   gauges/counters with a ``rank`` label per series,
-* the ``perf`` / ``flight`` / ``watch`` KV scopes — per-rank perfscope
-  summaries (wall percentiles, phase split, MFU), flight-recorder tails
-  (elastic round, last event) and hvdwatch anomaly records, scraped
-  with the same round-bounded probing ``hvddoctor --kv`` uses.
+* the ``perf`` / ``flight`` / ``watch`` / ``trace`` KV scopes —
+  per-rank perfscope summaries (wall percentiles, phase split, MFU),
+  flight-recorder tails (elastic round, last event), hvdwatch anomaly
+  records and hvdtrace span tails (sampled request/step traces with
+  the slowest trace's duration), scraped with the same round-bounded
+  probing ``hvddoctor --kv`` uses.
 
 ``--once --json`` emits the merged snapshot as machine-readable JSON
 for scripting (the watch-smoke e2e drives it this way). KV reads are
@@ -122,6 +124,12 @@ def snapshot(addr: str, port: int, max_ranks: int = 256) -> Dict[str, Any]:
     except Exception as e:
         tails = []
         snap["errors"].append(f"flight scope: {e}")
+    try:
+        traces = doctor.dedupe_trace(
+            doctor.load_trace_kv(addr, port, max_ranks=max_ranks))
+    except Exception as e:
+        traces = []
+        snap["errors"].append(f"trace scope: {e}")
 
     ranks: Dict[int, Dict[str, Any]] = {}
 
@@ -131,7 +139,7 @@ def snapshot(addr: str, port: int, max_ranks: int = 256) -> Dict[str, Any]:
     # The current round per rank is the highest any source reports —
     # earlier rounds' records are history, not state.
     latest: Dict[int, int] = {}
-    for rec in perf + watch:
+    for rec in perf + watch + traces:
         if rec.get("rank") is None:
             continue
         r, rnd = int(rec["rank"]), int(rec.get("round", 0) or 0)
@@ -170,6 +178,26 @@ def snapshot(addr: str, port: int, max_ranks: int = 256) -> Dict[str, Any]:
         info = row(int(rec["rank"]))
         info["anomalies"] = rec.get("counts") or {}
         info["active_anomalies"] = rec.get("active") or []
+    for rec in traces:
+        if rec.get("rank") is None \
+                or int(rec.get("round", 0) or 0) \
+                != latest.get(int(rec["rank"]), 0):
+            continue
+        info = row(int(rec["rank"]))
+        ts_list = rec.get("traces") or []
+        done = [t for t in ts_list if t.get("done")]
+        slowest = max((float(t.get("dur") or 0.0) for t in done),
+                      default=None)
+        errored = sum(1 for t in ts_list
+                      for sp in t.get("spans", [])
+                      if sp.get("status") != "ok")
+        info["traces"] = {
+            "sampled": len(ts_list),
+            "done": len(done),
+            "errored_spans": errored,
+            "slowest_ms": (slowest * 1e3
+                           if slowest is not None else None),
+        }
     for d in tails:
         if d.rank is None or d.round != latest.get(d.rank, 0):
             continue
@@ -254,6 +282,16 @@ def render(snap: Dict[str, Any]) -> str:
                              sorted(frac.items(), key=lambda kv: -kv[1])
                              if v >= 0.01)
             add(f"{'':>9}{split}")
+        tr = info.get("traces") or {}
+        if tr.get("sampled"):
+            slow = tr.get("slowest_ms")
+            line = (f"{'':>9}traces: {tr['sampled']} sampled "
+                    f"({tr.get('done', 0)} done)")
+            if isinstance(slow, (int, float)):
+                line += f", slowest {slow:.1f} ms"
+            if tr.get("errored_spans"):
+                line += f", {tr['errored_spans']} errored span(s)"
+            add(line)
         if info.get("last_event"):
             add(f"{'':>9}last: {info['last_event']}")
     for e in snap.get("errors") or []:

@@ -55,6 +55,7 @@ from horovod_tpu.common.exceptions import (DuplicateNameError,
 from horovod_tpu.core import topology
 from horovod_tpu.core.process_sets import ProcessSet, global_process_set
 from horovod_tpu.observability import flight as _flight
+from horovod_tpu.observability import tracing as _tracing
 from horovod_tpu.profiler import perfscope as _pscope
 
 _AXIS = "hvd"
@@ -1709,6 +1710,11 @@ def _consistency(desc: str, ps: ProcessSet,
     # per dispatched collective, reusing the descriptor this choke point
     # already formatted — the always-on black box the doctor merges.
     _flight.record_collective(ps.process_set_id, desc, name or "")
+    # hvdtrace ordering marker: an instant span under the ambient step
+    # trace for dispatches whose duration the host cannot see (the
+    # compiled path). Gated to a few loads when no trace is ambient.
+    if _tracing.active():
+        _tracing.record_dispatch(desc, name or "")
     from horovod_tpu.core import consistency as _cc
     from horovod_tpu.analysis import verifier as _vf
     checker = _cc.get()
@@ -1907,6 +1913,21 @@ class _instrument:
             # window is `comms` time, minus nested re-attributions.
             nested = self.ps.attributed_marker() - self.attr_mark
             self.ps.attribute("comms", dt - nested)
+            if _tracing.active():
+                # Per-collective child span under the ambient step
+                # trace (observability/tracing.py) — the measured eager
+                # dispatch window, with bytes when they are computable
+                # without lifting anything.
+                nbytes = None
+                try:
+                    if self.arrays:
+                        nbytes = float(sum(a.nbytes for a in self.arrays))
+                    elif self.nbytes_fn is not None:
+                        nbytes = float(self.nbytes_fn())
+                except Exception:
+                    nbytes = None
+                _tracing.collective_span(self.name, self.activity, dt,
+                                         nbytes)
         if self.enabled:
             _record(self.activity, self.arrays, self.nbytes_fn,
                     self.ntensors, dt, self.tl, axis=self.axis)

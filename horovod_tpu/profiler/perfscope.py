@@ -251,6 +251,8 @@ class PerfScope:
             # close the implicit interval so its time is not lost.
             self._record(st, self._clock())
         self._tls.step = _StepState(self._clock(), implicit, weight)
+        from horovod_tpu.observability import tracing
+        tracing.step_begin()
         return True
 
     def _step_end(self) -> None:
@@ -266,6 +268,8 @@ class PerfScope:
         somewhere."""
         if getattr(self._tls, "step", None) is None:
             self._tls.step = _StepState(self._clock(), True, 1.0)
+            from horovod_tpu.observability import tracing
+            tracing.step_begin()
 
     def step_boundary(self) -> None:
         """DistributedOptimizer hook (exit): an optimizer step ends one
@@ -278,6 +282,8 @@ class PerfScope:
         now = self._clock()
         self._record(st, now)
         self._tls.step = _StepState(now, True, 1.0)
+        from horovod_tpu.observability import tracing
+        tracing.step_begin()
 
     # ----------------------------------------------------------- phases
     def phase(self, name: str) -> Any:
@@ -326,6 +332,11 @@ class PerfScope:
 
     # ----------------------------------------------------------- record
     def _record(self, st: _StepState, now: float) -> None:
+        # Close the step's hvdtrace span (observability/tracing.py):
+        # _record is the single completion sink for every step path
+        # (explicit end, boundary rollover, explicit takeover).
+        from horovod_tpu.observability import tracing
+        tracing.step_end()
         st.flush(now)
         wall = now - st.t0
         if wall <= 0.0:
@@ -384,6 +395,8 @@ class PerfScope:
         step, so a stale implicit step left open by earlier optimizer
         calls cannot pollute the next section's first sample."""
         self._tls.step = None
+        from horovod_tpu.observability import tracing
+        tracing.step_end()
         with self._lock:
             self._recent.clear()
             self._steps = 0

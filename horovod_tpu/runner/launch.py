@@ -618,11 +618,12 @@ def launch_static(np: int, host_spec: str, command: List[str],
         # launcher's memory (observability/flight.py). The perfscope
         # step-time summaries ride the same exit path so the doctor's
         # perf section works offline (profiler/perfscope.py).
-        from horovod_tpu.observability import flight, watch
+        from horovod_tpu.observability import flight, tracing, watch
         from horovod_tpu.profiler import perfscope
         flight.persist_kv_tails(rdv)
         perfscope.persist_kv_summaries(rdv)
         watch.persist_kv_records(rdv)
+        tracing.persist_kv_spans(rdv)
         rdv.stop()
         if nkv is not None:
             nkv.stop()

@@ -89,15 +89,25 @@ class Request:
     # _hvdrace_token: requests are high-churn, and hvdrace falls back
     # to recycled id() identity on slotted classes — the slot lets the
     # detector stamp its never-reused token (analysis/race.py).
-    __slots__ = ("rid", "payload", "t_enqueue", "event", "result",
-                 "error", "requeues", "shape_key", "_decide",
-                 "_hvdrace_token")
+    __slots__ = ("rid", "payload", "t_enqueue", "t_dequeue", "t_done",
+                 "event", "result", "error", "requeues", "shape_key",
+                 "trace", "_decide", "_clock", "_hvdrace_token")
 
     def __init__(self, payload: Any, now: float,
-                 shape_key: Tuple = ()) -> None:
+                 shape_key: Tuple = (),
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.rid = next(_rid)
         self.payload = payload
         self.t_enqueue = now
+        # Lifecycle stamps on the SAME clock as t_enqueue (the
+        # batcher's injectable clock, so tests pin them): when the
+        # request left the queue in a formed batch, and when its
+        # outcome was decided.
+        self.t_dequeue: Optional[float] = None
+        self.t_done: Optional[float] = None
+        # hvdtrace context ({"t": trace_id, "s": request span id,
+        # "p": client span id}) or None when the trace was unsampled.
+        self.trace: Optional[dict] = None
         self.event = threading.Event()
         # Outcome decision must be an atomic test-and-set: the frontend
         # timeout thread and a dispatch thread can decide concurrently,
@@ -107,6 +117,7 @@ class Request:
         self.error: Optional[str] = None  # guarded-by: _decide (until event)
         self.requeues = 0
         self.shape_key = shape_key
+        self._clock = clock
 
     def complete(self, result: Any) -> bool:
         """First outcome wins: a request the frontend already timed out
@@ -116,6 +127,7 @@ class Request:
             if self.event.is_set():
                 return False
             self.result = result
+            self.t_done = self._clock()
             self.event.set()
             return True
 
@@ -124,6 +136,7 @@ class Request:
             if self.event.is_set():
                 return False
             self.error = error
+            self.t_done = self._clock()
             self.event.set()
             return True
 
@@ -223,7 +236,8 @@ class ContinuousBatcher:
         # Payload conversion + Request construction need no shared
         # state — keep the admission critical section (shared with
         # every poll/requeue) down to the checks and the append.
-        req = Request(payload, now, shape_key=shape_key_of(payload))
+        req = Request(payload, now, shape_key=shape_key_of(payload),
+                      clock=self.clock)
         with self._cv:
             # _drain rejects too, atomically with the drain flag: an
             # admission racing the drain watcher past the frontend's
@@ -310,6 +324,8 @@ class ContinuousBatcher:
                     due = (now - group[0].t_enqueue) >= self.max_wait_s
                     if full or due or self._drain:
                         take = group[:self.max_batch]
+                        for r in take:
+                            r.t_dequeue = now
                         taken = set(id(r) for r in take)
                         self._pending = deque(r for r in self._pending
                                               if id(r) not in taken)
@@ -322,6 +338,8 @@ class ContinuousBatcher:
         if batch is not None:
             mx = telemetry.handles()
             mx["batch_size"].observe(len(batch.requests))
+            for r in batch.requests:
+                mx["queue_wait"].observe(max(0.0, now - r.t_enqueue))
             if batch.padding:
                 mx["padded_items"].inc(batch.padding)
         return batch

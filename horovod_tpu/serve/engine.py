@@ -160,11 +160,19 @@ class InferenceEngine:
         import jax
         import numpy as np
 
+        from horovod_tpu.observability import tracing
         from horovod_tpu.profiler import perfscope
         arr = np.asarray(batch)
         exe = self.compile_for(arr.shape, arr.dtype)
         scope = perfscope.get()
-        with scope.phase("device_compute"):
-            out = exe(self.params, arr)
-            out = jax.block_until_ready(out)
+        # Ambient-gated trace span: records the device time with the
+        # bucket/padded-shape attributes when a sampled trace rode the
+        # batch RPC; an untraced call (warmup) records nothing.
+        with tracing.span("engine.execute",
+                          attrs={"bucket": int(arr.shape[0]),
+                                 "padded_shape": list(arr.shape),
+                                 "dtype": str(arr.dtype)}):
+            with scope.phase("device_compute"):
+                out = exe(self.params, arr)
+                out = jax.block_until_ready(out)
         return np.asarray(out)

@@ -12,9 +12,14 @@ against the zero-drop guarantee the pool maintains for accepted ones.
 
 Protocol (request → response):
 
-  ("infer", payload)  → ("ok", result) | ("rejected", why) | ("error", why)
+  ("infer", payload[, trace_ctx])
+                      → ("ok", result) | ("rejected", why) | ("error", why)
   ("stats",)          → ("ok", {...})
   ("shutdown",)       → ("ok", None)      # begin drain; launcher finishes
+
+The optional third ``infer`` element is the hvdtrace context dict
+(``observability/tracing.py``) — older clients simply omit it, so the
+protocol is backward compatible in both directions.
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ from typing import Any, Dict, Optional, Tuple
 from horovod_tpu.common.config import _env_float
 from horovod_tpu.data.service import (_recv_frame, _require_secret,
                                       _send_frame, _serve)
+from horovod_tpu.observability import tracing
 
 HOROVOD_SERVE_PORT = "HOROVOD_SERVE_PORT"
 HOROVOD_SERVE_PORT_FILE = "HOROVOD_SERVE_PORT_FILE"
@@ -89,7 +95,7 @@ class Frontend:
     def _handle(self, req):
         kind = req[0]
         if kind == "infer":
-            return self._infer(req[1])
+            return self._infer(req[1], req[2] if len(req) > 2 else None)
         if kind == "stats":
             return ("ok", self.stats())
         if kind == "shutdown":
@@ -103,7 +109,7 @@ class Frontend:
             return ("ok", None)
         return ("error", f"unknown request {kind!r}")
 
-    def _infer(self, payload) -> Tuple[str, Any]:
+    def _infer(self, payload, ctx=None) -> Tuple[str, Any]:
         from horovod_tpu.serve import telemetry
         mx = telemetry.handles()
         t0 = time.perf_counter()
@@ -127,6 +133,11 @@ class Frontend:
             why = "service draining" if self.drain_requested.is_set() \
                 else "queue full"
             return ("rejected", why)
+        # Admission-time trace context: adopt the client's (when one
+        # rode the RPC) or head-sample a fresh trace. The request's
+        # span id is pre-allocated here so the queue/dispatch children
+        # recorded by other threads already parent on it.
+        r.trace = tracing.get().request_context(ctx)
         with self._lock:
             self.accepted += 1
         if not r.event.wait(self.request_timeout):
@@ -142,6 +153,7 @@ class Frontend:
             mx["request_seconds"].observe(time.perf_counter() - t0)
             with self._lock:
                 self.failed += 1
+            _record_request_trace(r, "timeout")
             return ("error", "request timed out")
         dt = time.perf_counter() - t0
         mx["request_seconds"].observe(dt)
@@ -149,10 +161,12 @@ class Frontend:
         if err is not None:
             with self._lock:
                 self.failed += 1
+            _record_request_trace(r, "error", error=err)
             return ("error", err)
         mx["request_status"]["completed"].inc()
         with self._lock:
             self.completed += 1
+        _record_request_trace(r, "ok")
         return ("ok", r.result)  # hvdlint: disable=HVD101 -- published by event.set(); event.wait() above gives the happens-before
 
     def stats(self) -> Dict[str, Any]:
@@ -163,6 +177,46 @@ class Frontend:
                       "rejected": self.rejected}
         counts["queue_depth"] = self.batcher.depth_now()
         return counts
+
+
+def _record_request_trace(r, status: str,
+                          error: Optional[str] = None) -> None:
+    """Turn a decided request's lifecycle stamps into spans (the
+    request lifecycle crosses threads, so spans are recorded
+    retroactively — observability/tracing.py). The serve.request span
+    claims the pre-allocated id from admission and is the local root:
+    its end runs the tail-keep decision (error/timeout/requeued
+    fragments survive ring eviction)."""
+    ctx = r.trace
+    if not ctx:
+        return
+    try:
+        tr = tracing.get()
+        # Request stamps are on the batcher's (monotonic, injectable)
+        # clock; spans live on the wall clock so cross-process
+        # fragments align — anchor the conversion at "now".
+        now_m = r._clock()
+        now_w = time.time()
+
+        def wall(m: float) -> float:
+            return now_w - (now_m - m)
+
+        tid = ctx[tracing.CTX_TRACE]
+        sid = ctx[tracing.CTX_SPAN]
+        if r.t_dequeue is not None:
+            tr.add_span("serve.queue", wall(r.t_enqueue),
+                        max(0.0, r.t_dequeue - r.t_enqueue),
+                        trace_id=tid, parent_id=sid)
+        end_m = r.t_done if r.t_done is not None else now_m
+        attrs: Dict[str, Any] = {"rid": r.rid, "requeues": r.requeues}
+        if error:
+            attrs["error"] = error
+        tr.add_span("serve.request", wall(r.t_enqueue),
+                    max(0.0, end_m - r.t_enqueue), trace_id=tid,
+                    span_id=sid, parent_id=ctx.get("p"), status=status,
+                    attrs=attrs, root=True)
+    except Exception:
+        pass  # tracing must never fail a request
 
 
 class ServeClient:
@@ -196,15 +250,27 @@ class ServeClient:
     def infer(self, payload) -> Any:
         """Submit one example; returns the result or raises on
         rejection/error (caller decides whether to retry a rejection)."""
-        st = self._call(("infer", payload))
+        st = self.infer_raw(payload)
         if st[0] == "ok":
             return st[1]
         raise ServeRequestError(st[0], str(st[1]))
 
     def infer_raw(self, payload):
         """The raw (status, value) pair — load generators that count
-        rejections separately from failures use this."""
-        return self._call(("infer", payload))
+        rejections separately from failures use this. Opens the
+        client-side root span and rides its context on the request so
+        the service's spans join the same trace."""
+        sp = tracing.start_trace("serve.client")
+        ctx = sp.context()
+        req = ("infer", payload, ctx) if ctx else ("infer", payload)
+        try:
+            st = self._call(req)
+        except BaseException as e:
+            sp.end("error", error=f"{type(e).__name__}: {e}")
+            raise
+        sp.end("ok" if st and st[0] == "ok" else "error",
+               outcome=st[0] if st else "?")
+        return st
 
     def stats(self) -> Dict[str, Any]:
         st = self._call(("stats",))

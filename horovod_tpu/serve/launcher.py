@@ -68,7 +68,7 @@ def run_serve(args, command: List[str],
                                                HostManager)
     from horovod_tpu.elastic.driver import (ElasticDriver,
                                             drive_elastic_loop)
-    from horovod_tpu.observability import flight
+    from horovod_tpu.observability import flight, tracing
     from horovod_tpu.profiler import perfscope
     from horovod_tpu.runner import safe_exec
     from horovod_tpu.runner import secret as secret_mod
@@ -159,7 +159,9 @@ def run_serve(args, command: List[str],
         # the KV disappears, then point the operator at the doctor.
         tails = flight.persist_kv_tails(rdv)
         perfscope.persist_kv_summaries(rdv)
+        tracing.persist_kv_spans(rdv)
         flight.dump("serve_exit", push_kv=False)
+        tracing.dump("serve_exit", push_kv=False)
         flight_dir = os.environ.get(flight.FLIGHT_DIR_ENV, "")
         if rc != 0 and flight_dir and (
                 tails or os.path.isdir(flight_dir)):

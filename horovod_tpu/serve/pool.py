@@ -258,15 +258,28 @@ class ReplicaPool:
         from horovod_tpu.serve import telemetry
         mx = telemetry.handles()
         t0 = time.perf_counter()
+        w0 = time.time()
+        ctx = _batch_trace_context(batch)
         try:
             s = rep.connect(self.replica_timeout)
             s.settimeout(self.replica_timeout)
-            _send_frame(s, ("infer_batch", batch.stacked()), self._secret)
+            msg = ("infer_batch", batch.stacked(), ctx) if ctx \
+                else ("infer_batch", batch.stacked())
+            _send_frame(s, msg, self._secret)
             st = _recv_frame(s, self._secret)
         except Exception as e:
+            # Record the failed attempt BEFORE the requeue so a
+            # requeued request's trace carries BOTH dispatch attempts
+            # (the requeue bumps r.requeues, which numbers the next
+            # attempt's span).
+            _record_batch_trace(batch, rep, ctx, w0, time.time() - w0,
+                                "error", error=f"{type(e).__name__}: {e}")
             self._on_replica_death(rep, batch, e)
             return
+        dur = time.time() - w0
         if st[0] != "ok":
+            _record_batch_trace(batch, rep, ctx, w0, dur, "error",
+                                error=str(st[1]))
             # The replica is alive but the program failed (user infer_fn
             # bug): fail the batch's requests — requeueing a
             # deterministic failure would poison every replica in turn.
@@ -274,6 +287,7 @@ class ReplicaPool:
                 if r.fail(f"replica {rep.label()}: {st[1]}"):
                     mx["request_status"]["failed"].inc()
             return
+        _record_batch_trace(batch, rep, ctx, w0, dur, "ok")
         out = st[1]
         for i, r in enumerate(batch.requests):
             r.complete(out[i])
@@ -372,3 +386,71 @@ class ReplicaPool:
             reps = list(self._replicas.values())
         for rep in reps:
             rep.close()
+
+
+def _batch_trace_context(batch):
+    """Cross-process trace context for a dispatched batch. The batch
+    executes ONCE for every request in it, so there is exactly one
+    batch-execution span: it joins the PRIMARY (first sampled) request's
+    trace with a pre-allocated span id, and carries the other sampled
+    requests' trace ids as links so the doctor and the Perfetto flow
+    events can stitch their shared device time back to each of them.
+    None when no request in the batch is sampled (the replica then
+    records nothing — its span helpers are ambient-gated)."""
+    from horovod_tpu.observability import tracing
+    try:
+        sampled = [r.trace for r in batch.requests if r.trace]
+        if not sampled:
+            return None
+        primary = sampled[0]
+        ctx = {tracing.CTX_TRACE: primary[tracing.CTX_TRACE],
+               tracing.CTX_SPAN: tracing._new_id(),
+               "p": primary[tracing.CTX_SPAN]}
+        links = [c[tracing.CTX_TRACE] for c in sampled[1:]]
+        if links:
+            ctx[tracing.CTX_LINKS] = links
+        return ctx
+    except Exception:
+        return None
+
+
+def _record_batch_trace(batch, rep, ctx, w0: float, dur: float,
+                        status: str, error: Optional[str] = None) -> None:
+    """Retroactively record one dispatch attempt: a per-request
+    ``serve.dispatch`` child span (parented on that request's
+    pre-allocated admission span) plus the shared ``serve.batch`` span
+    the replica's fragment nests under. Called once per ATTEMPT — a
+    requeued request accumulates one dispatch span per replica tried,
+    numbered by its ``attempt`` attribute."""
+    if ctx is None:
+        return
+    from horovod_tpu.observability import tracing
+    try:
+        tr = tracing.get()
+        label = f"{rep.host}:{rep.pid}"
+        for r in batch.requests:
+            rctx = r.trace
+            if not rctx:
+                continue
+            attrs = {"replica": label, "attempt": r.requeues,
+                     "batch": ctx[tracing.CTX_SPAN]}
+            if error:
+                attrs["error"] = error
+            tr.add_span("serve.dispatch", w0, dur,
+                        trace_id=rctx[tracing.CTX_TRACE],
+                        parent_id=rctx[tracing.CTX_SPAN],
+                        status=status, attrs=attrs)
+        battrs: Dict[str, Any] = {"replica": label,
+                                  "size": len(batch.requests)}
+        links = ctx.get(tracing.CTX_LINKS)
+        if links:
+            battrs["links"] = links
+        if error:
+            battrs["error"] = error
+        tr.add_span("serve.batch", w0, dur,
+                    trace_id=ctx[tracing.CTX_TRACE],
+                    span_id=ctx[tracing.CTX_SPAN],
+                    parent_id=ctx.get("p"),
+                    status=status, attrs=battrs)
+    except Exception:
+        pass  # tracing must never fail a dispatch

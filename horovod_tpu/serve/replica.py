@@ -117,13 +117,14 @@ class ReplicaServer:
         self.kv.put(SCOPE, self._reg_key(), json.dumps(body).encode())
 
     def _heartbeat_loop(self) -> None:
-        from horovod_tpu.observability import flight
+        from horovod_tpu.observability import flight, tracing
         from horovod_tpu.profiler import perfscope
         while not self._stop.is_set():
             try:
                 self._register()
                 perfscope.push_summary()
                 flight.push_tail()
+                tracing.push_tail()
             except Exception:
                 pass  # launcher restarting; next tick retries
             self._stop.wait(HEARTBEAT_INTERVAL)
@@ -171,19 +172,35 @@ class ReplicaServer:
     def _handle(self, req):
         kind = req[0]
         if kind == "infer_batch":
-            return self._infer_batch(req[1])
+            # Optional third element: the pool's hvdtrace batch context
+            # (observability/tracing.py) — absent from older pools.
+            return self._infer_batch(req[1],
+                                     req[2] if len(req) > 2 else None)
         if kind == "ping":
             return ("ok", self.ident["pid"])
         return ("error", f"unknown request {kind!r}")
 
-    def _infer_batch(self, batch) -> Tuple[str, Any]:
+    def _infer_batch(self, batch, ctx=None) -> Tuple[str, Any]:
+        from horovod_tpu.observability import tracing
         from horovod_tpu.profiler import perfscope
         from horovod_tpu.serve import telemetry
         mx = telemetry.handles()
         t0 = time.perf_counter()
+        # Adopt the pool's batch context (present iff some request in
+        # the batch was sampled) so this fragment nests under the
+        # serve.batch span; replica.infer_batch is this process's local
+        # root, and the engine's execute span becomes its ambient child.
+        tok = tracing.adopt(ctx)
+        sp = tracing.get().start_span("replica.infer_batch", root=True) \
+            if tok is not None else tracing.NOOP_SPAN
         scope = perfscope.get()
-        with scope.step():
-            out = self.engine.infer(batch)
+        try:
+            with sp:
+                with scope.step():
+                    out = self.engine.infer(batch)
+        finally:
+            if tok is not None:
+                tracing.clear(tok)
         dt = time.perf_counter() - t0
         with self._lock:
             self.batches += 1

@@ -39,6 +39,12 @@ def handles():
             "queue_depth": reg.gauge(
                 "horovod_serve_queue_depth",
                 "Requests accepted but not yet dispatched in a batch"),
+            "queue_wait": reg.histogram(
+                "horovod_serve_queue_wait_seconds",
+                "Time a request spent in the batching queue "
+                "(t_enqueue to t_dequeue) — the queue share of "
+                "request latency, visible without a trace",
+                buckets=m.TIME_BUCKETS),
             "batches": reg.counter(
                 "horovod_serve_batches_total",
                 "Batches dispatched to replicas"),

@@ -80,6 +80,13 @@ SHARDED_SECTIONS = ("gspmd_hybrid",)
 CKPT_SECTION = "checkpointing"
 CKPT_MAX_OVERHEAD = 0.05
 
+#: The serving bench section (docs/serving.md) and its hvdtrace
+#: structural contract (docs/observability.md): the loopback bench
+#: traces its own request path end to end and stamps the joined
+#: evidence — a serving number whose slowest request cannot be split
+#: into queue/dispatch/device time is unattributable.
+SERVE_SECTION = "serving"
+
 
 # ----------------------------------------------------------------- emit
 
@@ -437,6 +444,39 @@ def _check_ckpt_section(name: str, val: dict) -> list:
     return errs
 
 
+def _check_serving_section(name: str, val: dict) -> list:
+    """The hvdtrace stamp a serving section must carry
+    (docs/observability.md): the bench forces the tracer on for its
+    loopback run, joins the spans with the doctor's analyzer, and
+    stamps the slowest request's queue/dispatch/device split. All
+    structural — runs on any host, no numerics involved."""
+    errs = []
+    tr = val.get("trace")
+    if not isinstance(tr, dict):
+        errs.append(f"{name}: trace stamp missing — the serving bench "
+                    "no longer carries hvdtrace evidence "
+                    "(observability/tracing.py)")
+        return errs
+    if not isinstance(tr.get("version"), int):
+        errs.append(f"{name}: trace.version missing/non-int — the "
+                    "stamp cannot be version-gated")
+    sampled = tr.get("sampled")
+    if not isinstance(sampled, (int, float)) or sampled < 1:
+        errs.append(f"{name}: trace.sampled missing or < 1 — the "
+                    "tracer saw none of the bench's requests")
+    slow = tr.get("slowest")
+    if not isinstance(slow, dict):
+        errs.append(f"{name}: trace.slowest missing — no request "
+                    "trace survived to attribute the tail latency")
+    else:
+        for k in ("total_ms", "queue_ms", "dispatch_ms", "device_ms"):
+            if not isinstance(slow.get(k), (int, float)):
+                errs.append(f"{name}: trace.slowest.{k} missing/"
+                            "non-numeric — the queue/dispatch/device "
+                            "split is incomplete")
+    return errs
+
+
 def check_bench(doc: dict) -> list:
     """Structure-check every perfscope-stamped section of a bench.py
     JSON line (the StepProfile acceptance: phases cover >=90% of wall),
@@ -454,6 +494,8 @@ def check_bench(doc: dict) -> list:
             errs.extend(_check_sharded_section(sec, val))
         if sec == CKPT_SECTION:
             errs.extend(_check_ckpt_section(sec, val))
+        if sec == SERVE_SECTION:
+            errs.extend(_check_serving_section(sec, val))
         if "perfscope" not in val:
             continue
         prof = val["perfscope"]
@@ -484,6 +526,12 @@ def check_bench(doc: dict) -> list:
             "the async-save overhead is no longer measured; its "
             "overhead/phase-split stamps are structurally required "
             "(docs/checkpointing.md)")
+    if not isinstance(extra.get(SERVE_SECTION), dict):
+        errs.append(
+            f"{SERVE_SECTION}: serving bench section missing — the "
+            "serving tier was not measured (or was dropped); its "
+            "hvdtrace `trace` stamp is structurally required "
+            "(docs/observability.md)")
     return errs
 
 

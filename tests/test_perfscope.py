@@ -565,11 +565,25 @@ def _ckpt_section(overhead=0.01):
     }}
 
 
+def _serving_section():
+    """A minimal valid serving section (ISSUE 20): check_bench
+    requires its PRESENCE with the hvdtrace `trace` stamp carrying the
+    slowest request's queue/dispatch/device split."""
+    return {"serving": {
+        "requests": 100, "requests_per_sec": 50.0,
+        "trace": {"version": 1, "sampled": 100, "finished": 100,
+                  "requests_joined": 8, "complete": 8,
+                  "slowest": {"trace_id": "ab" * 8, "rid": 7,
+                              "total_ms": 12.0, "queue_ms": 3.0,
+                              "dispatch_ms": 8.5, "device_ms": 4.0}},
+    }}
+
+
 def _gspmd_section():
     """A minimal valid sharded section (ISSUE 14) plus the ISSUE 15
-    checkpointing section: check_bench requires the PRESENCE of both
-    with their stamps, so the synthetic docs below carry them to
-    isolate what each test actually checks."""
+    checkpointing and ISSUE 20 serving sections: check_bench requires
+    the PRESENCE of all three with their stamps, so the synthetic docs
+    below carry them to isolate what each test actually checks."""
     return {"gspmd_hybrid": {
         "mesh": {"spec": "dp=2,tp=4", "devices": 8,
                  "shape": {"dp": 2, "tp": 4}},
@@ -593,7 +607,7 @@ def _gspmd_section():
                             "axis": "dp"}],
             "findings": 0, "clean": True,
         },
-    }, **_ckpt_section()}
+    }, **_ckpt_section(), **_serving_section()}
 
 
 def test_perf_gate_bench_mode(fresh):

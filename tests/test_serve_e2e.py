@@ -238,3 +238,125 @@ def test_serving_survives_replica_sigkill_under_load(tmp_path):
     text = doctor.render(report)
     assert "SERVE REPLICA DEATH" in text, text
     assert "127.0.0.1" in text and str(victim_pid) in text, text
+
+
+@pytest.mark.faults
+def test_serving_trace_reconstruction_across_sigkill(tmp_path, capsys):
+    """hvdtrace acceptance (ISSUE 20): after a real 2-replica serving
+    run with a mid-flight SIGKILL, `hvddoctor --json` joins the
+    per-process span fragments (frontend/pool dump + replica KV tails)
+    into complete cross-process traces — the slowest sampled request
+    names its queue/dispatch/device split, and a requeued request's
+    trace carries BOTH dispatch attempts (the failed one on the dead
+    replica and the retry on the survivor)."""
+    from horovod_tpu.observability import doctor
+    from horovod_tpu.serve.frontend import (ServeClient,
+                                            wait_for_port_file)
+
+    ckpt_path = _save_checkpoint(tmp_path)
+    proc, hosts_file, port_file, flight_dir, pid_dir = \
+        _start_service(tmp_path, ckpt_path)
+    _write_hosts(hosts_file, "localhost:1,127.0.0.1:1")
+    try:
+        port = wait_for_port_file(str(port_file), timeout=90)
+        addr = ("127.0.0.1", port)
+        probe = ServeClient(addr, secret=SECRET.encode())
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                if len(os.listdir(pid_dir)) >= 2:
+                    out = probe.infer(
+                        np.full((FEATURES,), 1.0, np.float32))
+                    assert abs(float(out) - _expected(1.0)) < 1e-4
+                    break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            pytest.fail("replicas never came up; output:\n"
+                        + (proc.stdout.read() if proc.stdout else ""))
+
+        failures = []
+        stop_load = threading.Event()
+
+        def load_worker(tid):
+            c = ServeClient(addr, secret=SECRET.encode())
+            i = 0
+            try:
+                while not stop_load.is_set():
+                    v = float(tid * 10000 + i)
+                    try:
+                        c.infer(np.full((FEATURES,), v, np.float32))
+                    except Exception as e:
+                        failures.append((v, repr(e)))
+                        return
+                    i += 1
+                    time.sleep(0.01)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=load_worker, args=(t,),
+                                    daemon=True) for t in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        with open(os.path.join(pid_dir, "127.0.0.1")) as f:
+            victim_pid = int(f.read().strip())
+        os.kill(victim_pid, signal.SIGKILL)
+        _write_hosts(hosts_file, "localhost:1")
+        time.sleep(3.0)  # keep load on so requeues land on the survivor
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        assert not failures, failures
+
+        probe.shutdown()
+        probe.close()
+        _finish(proc)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+    # --- acceptance: the doctor reconstructs the cross-process traces
+    names = sorted(os.listdir(flight_dir))
+    assert any(n.startswith("trace-") for n in names), names
+    perfetto = tmp_path / "perfetto.json"
+    assert doctor.main(["--dir", str(flight_dir), "--json",
+                        "--trace", str(perfetto)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    tr = report["traces"]
+    assert tr is not None, sorted(report)
+    assert tr["requests"] > 0
+    assert tr["complete"] >= 1, tr
+
+    # the slowest COMPLETE request names its queue/dispatch/device split
+    complete = [e for e in tr["slowest"] if e["complete"]]
+    assert complete, tr["slowest"]
+    slow = complete[0]
+    for hop in ("queue_s", "dispatch_s", "device_s"):
+        assert isinstance(slow[hop], float) and slow[hop] >= 0.0, slow
+    assert slow["total_s"] > 0.0 and slow["rid"] is not None
+
+    # a requeued request's trace carries BOTH dispatch attempts
+    assert tr["requeued"], tr
+    rq = next((e for e in tr["requeued"] if len(e["attempts"]) >= 2),
+              None)
+    assert rq is not None, tr["requeued"]
+    attempts = sorted(rq["attempts"], key=lambda a: a["attempt"] or 0)
+    assert any(a["status"] != "ok" for a in attempts), attempts
+    assert attempts[-1]["status"] == "ok", attempts
+    replicas = {a["replica"] for a in attempts}
+    assert len(replicas) >= 2, attempts  # died + survivor, not a retry loop
+
+    # the Perfetto export stitched request spans into batch slices
+    with open(perfetto) as f:
+        evs = json.load(f)["traceEvents"]
+    assert any(e.get("ph") == "X" and e.get("cat") == "hvdtrace"
+               for e in evs)
+    starts = [e for e in evs if e.get("ph") == "s"
+              and e.get("cat") == "hvdtrace.flow"]
+    finishes = [e for e in evs if e.get("ph") == "f"
+                and e.get("cat") == "hvdtrace.flow"]
+    assert starts and finishes
+    assert {e["id"] for e in starts} & {e["id"] for e in finishes}
